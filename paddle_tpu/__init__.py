@@ -1,0 +1,68 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability surface, built ground-up on JAX/XLA/Pallas.
+
+Top-level namespace mirrors `paddle`: tensor ops live here, `nn`, `optimizer`,
+`amp`, `io`, `jit`, `static`, `distributed`, `incubate`, `vision` are
+submodules.  See SURVEY.md for the reference layer map this design answers.
+"""
+
+from __future__ import annotations
+
+# dtypes / state first (no deps)
+from paddle_tpu.core.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    int8, int16, int32, int64, uint8,
+)
+from paddle_tpu.core.state import (  # noqa: F401
+    get_default_dtype, seed, set_default_dtype,
+)
+from paddle_tpu.core.tensor import (  # noqa: F401
+    Parameter, Tensor, enable_grad, is_grad_enabled, no_grad, set_grad_enabled,
+)
+
+# op surface → top level (paddle parity)
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.creation import to_tensor  # noqa: F401
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.logic import *  # noqa: F401,F403
+from paddle_tpu.ops.search import *  # noqa: F401,F403
+from paddle_tpu.ops.stat import *  # noqa: F401,F403
+from paddle_tpu.ops.random import (  # noqa: F401
+    bernoulli, multinomial, normal, poisson, rand, rand_like, randint,
+    randint_like, randn, randn_like, randperm, standard_normal, uniform,
+)
+
+# method/dunder installation (must come after ops import)
+import paddle_tpu.core.tensor_methods  # noqa: F401,E402
+
+# submodules
+from paddle_tpu import amp  # noqa: F401,E402
+from paddle_tpu import autograd  # noqa: F401,E402
+from paddle_tpu import device  # noqa: F401,E402
+from paddle_tpu import distributed  # noqa: F401,E402
+from paddle_tpu import framework  # noqa: F401,E402
+from paddle_tpu import io  # noqa: F401,E402
+from paddle_tpu import jit  # noqa: F401,E402
+from paddle_tpu import metric  # noqa: F401,E402
+from paddle_tpu import nn  # noqa: F401,E402
+from paddle_tpu import optimizer  # noqa: F401,E402
+from paddle_tpu import profiler  # noqa: F401,E402
+from paddle_tpu import static  # noqa: F401,E402
+from paddle_tpu import utils  # noqa: F401,E402
+from paddle_tpu import vision  # noqa: F401,E402
+from paddle_tpu.device import get_device, set_device  # noqa: F401,E402
+from paddle_tpu.framework.io_ import load, save  # noqa: F401,E402
+from paddle_tpu.autograd import grad  # noqa: F401,E402
+from paddle_tpu.flags import get_flags, set_flags  # noqa: F401,E402
+
+from paddle_tpu.version import __version__  # noqa: F401,E402
+
+# paddle-parity helpers
+def in_dynamic_mode():
+    import jax.core
+    return True
+
+
+CPUPlace = str

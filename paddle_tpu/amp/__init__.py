@@ -1,0 +1,1 @@
+"""placeholder — populated in later milestones this round."""

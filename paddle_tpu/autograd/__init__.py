@@ -1,0 +1,130 @@
+"""Autograd user API (parity: python/paddle/autograd/).
+
+backward/grad drive the eager tape (autograd/backward_engine.py); PyLayer is
+the custom-VJP extension point (reference: autograd/py_layer.py:29), lowered
+here to jax.custom_vjp when used functionally and to direct tape nodes when
+used eagerly."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+
+from paddle_tpu.autograd.backward_engine import calc_gradients, run_backward
+from paddle_tpu.core.dispatch import unwrap, wrap_like
+from paddle_tpu.core.tensor import (GradNode, Tensor, enable_grad,
+                                    is_grad_enabled, no_grad, set_grad_enabled)
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled", "hessian",
+           "jacobian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True on the eager tape is not supported; use the "
+            "functional API (paddle_tpu.incubate.autograd / jax.grad) for "
+            "higher-order derivatives.")
+    retain = bool(retain_graph) if retain_graph is not None else False
+    return calc_gradients(outputs, inputs, grad_outputs, retain_graph=retain,
+                          allow_unused=allow_unused)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """User-defined forward/backward (reference: python/paddle/autograd/py_layer.py:29).
+
+    Subclass with @staticmethod forward(ctx, *args) and backward(ctx, *grads).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        diff = [t for t in tensor_args if not t.stop_gradient]
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        if not (is_grad_enabled() and diff):
+            return out
+
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        avals = [(o._data.shape, o._data.dtype) for o in outs]
+        treedef = jax.tree.structure([0] * len(outs))
+
+        def vjp_fn(cotangents):
+            grads = cls.backward(ctx, *[wrap_like(c) for c in cotangents])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grads = [None if g is None else unwrap(g) for g in grads]
+            if len(grads) != len(diff):
+                # user returns one grad per forward tensor input; filter to diff
+                if len(grads) == len(tensor_args):
+                    grads = [g for g, t in zip(grads, tensor_args)
+                             if not t.stop_gradient]
+                else:
+                    raise RuntimeError(
+                        f"PyLayer.backward returned {len(grads)} grads, "
+                        f"expected {len(diff)}")
+            return grads
+
+        node = GradNode(vjp_fn, diff, avals, treedef, name=cls.__name__)
+        wrapped = []
+        for i, o in enumerate(outs):
+            t = Tensor._wrap(o._data, stop_gradient=False, node=node, out_index=i)
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Functional jacobian on eager tensors via jax.jacrev (stateless)."""
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.jacobian on a pure function.")
+
+
+def hessian(ys, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.hessian on a pure function.")

@@ -1,0 +1,185 @@
+"""Tape traversal: the eager backward pass.
+
+Parity target: the reference's RunBackward
+(/root/reference/paddle/fluid/eager/backward.cc:104) — a topological queue over
+GradNodes with per-tensor gradient accumulation (GradTensorHolder).  Here each
+GradNode holds a jax.vjp pullback, so "running" a node is one pullback call.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import GradNode, Tensor
+
+__all__ = ["run_backward", "calc_gradients"]
+
+
+def _topo_order(roots: List[GradNode]):
+    """Reverse-topological order over the node graph (outputs first)."""
+    indeg = defaultdict(int)  # node -> number of consumers discovered
+    seen = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for t in node.inputs:
+            parent = t._grad_node
+            if parent is not None:
+                indeg[id(parent)] += 1
+                stack.append(parent)
+    return indeg, seen
+
+
+def _accumulate(store, key, value):
+    cur = store.get(key)
+    store[key] = value if cur is None else cur + value
+
+
+def run_backward(tensors: List[Tensor], grad_tensors=None, retain_graph=False):
+    """Standard .backward(): writes .grad on leaf tensors (and on tensors that
+    called retain_grads())."""
+    grads = _backward_impl(tensors, grad_tensors, retain_graph,
+                           accumulate_into_grad=True, wanted=None)
+    return grads
+
+
+def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
+                   allow_unused=False):
+    """paddle.grad parity: return grads of outputs wrt inputs, no .grad writes."""
+    wanted = {id(t): t for t in inputs}
+    grads = _backward_impl(outputs, grad_outputs, retain_graph,
+                           accumulate_into_grad=False, wanted=wanted)
+    result = []
+    for t in inputs:
+        g = grads.get(id(t))
+        if g is None and not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been "
+                "used in the graph. Set allow_unused=True if this is desired.")
+        result.append(None if g is None else Tensor._wrap(g))
+    return result
+
+
+def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
+                   wanted):
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    # Pending cotangents per (node, out_index); plus leaf grads keyed by id(tensor)
+    node_cots = {}
+    leaf_grads = {}
+    tensor_by_id = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError("backward() on a tensor with stop_gradient=True "
+                               "and no grad history")
+        if g is None:
+            if t.numel() != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None or t._retain_grads or (wanted and id(t) in wanted):
+            _accumulate(leaf_grads, id(t), g_arr)
+            tensor_by_id[id(t)] = t
+        if node is not None:
+            key = id(node)
+            if key not in node_cots:
+                node_cots[key] = [None] * node.n_outputs
+                roots.append(node)
+            slot = node_cots[key]
+            cur = slot[t._out_index]
+            slot[t._out_index] = g_arr if cur is None else cur + g_arr
+
+    indeg, reachable = _topo_order(roots)
+    # ready queue: nodes whose consumers (within reachable set) are all done.
+    ready = [n for n in roots if indeg[id(n)] == 0]
+    nodes_by_id = {id(n): n for n in roots}
+    done = set()
+
+    # BFS with dependency counting (Kahn) — same structure as RunBackward's
+    # node_in_degree_map loop in the reference.
+    # We must discover nodes lazily: a node becomes known when a cotangent
+    # reaches it.
+    while ready:
+        node = ready.pop()
+        if id(node) in done:
+            continue
+        done.add(id(node))
+        cots = node_cots.pop(id(node), [None] * node.n_outputs)
+        in_grads = node.apply(cots)
+        if not retain_graph:
+            node.vjp_fn = None  # free saved activations
+        for t, g in zip(node.inputs, in_grads):
+            parent = t._grad_node
+            if g is not None and (parent is None or t._retain_grads
+                                  or (wanted and id(t) in wanted)):
+                _accumulate(leaf_grads, id(t), g)
+                tensor_by_id[id(t)] = t
+            if parent is not None:
+                key = id(parent)
+                if key not in done:
+                    if key not in node_cots:
+                        node_cots[key] = [None] * parent.n_outputs
+                        nodes_by_id[key] = parent
+                    if g is not None:
+                        slot = node_cots[key]
+                        cur = slot[t._out_index]
+                        slot[t._out_index] = g if cur is None else cur + g
+                    indeg[key] -= 1
+                    if indeg[key] <= 0:
+                        ready.append(parent)
+
+    # Any remaining nodes with pending cotangents but unresolved indegree
+    # (diamond patterns where some consumers were unreachable): flush them.
+    while node_cots:
+        progressed = False
+        for key in list(node_cots):
+            if key in done:
+                node_cots.pop(key)
+                continue
+            node = nodes_by_id[key]
+            done.add(key)
+            cots = node_cots.pop(key)
+            in_grads = node.apply(cots)
+            if not retain_graph:
+                node.vjp_fn = None
+            progressed = True
+            for t, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                parent = t._grad_node
+                if parent is None or t._retain_grads or (wanted and id(t) in wanted):
+                    _accumulate(leaf_grads, id(t), g)
+                    tensor_by_id[id(t)] = t
+                if parent is not None and id(parent) not in done:
+                    if id(parent) not in node_cots:
+                        node_cots[id(parent)] = [None] * parent.n_outputs
+                        nodes_by_id[id(parent)] = parent
+                    slot = node_cots[id(parent)]
+                    cur = slot[t._out_index]
+                    slot[t._out_index] = g if cur is None else cur + g
+            break
+        if not progressed:
+            break
+
+    if accumulate_into_grad:
+        for tid, g in leaf_grads.items():
+            t = tensor_by_id[tid]
+            if t.stop_gradient and t._grad_node is not None:
+                continue
+            if t._grad is None:
+                t._grad = Tensor._wrap(g)
+            else:
+                t._grad = Tensor._wrap(t._grad._data + g)
+    return leaf_grads

@@ -1,0 +1,121 @@
+"""Dual-mode op dispatch: every op is ONE pure jax function.
+
+  * Called with raw jax values (inside jit / vmap / grad traces) it runs
+    directly — zero overhead, fully fusible by XLA.
+  * Called with eager ``Tensor`` objects it routes through ``dispatch``: the
+    differentiable float inputs become jax.vjp primals, the pullback lands on
+    the tape (core/tensor.py:GradNode).
+
+This replaces the reference's four generated layers (C++ API / ad_func /
+GradNode / pybind _C_ops — see SURVEY.md §3.1) with a single Python dispatcher,
+because XLA + jax.vjp supply kernel selection and per-op gradients for free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes as _dtypes
+from paddle_tpu.core.tensor import GradNode, Tensor, is_grad_enabled
+
+__all__ = ["dispatch", "eager_op", "unwrap", "wrap_like"]
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _tree_unwrap(tree):
+    return jax.tree.map(unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def wrap_like(arr, stop_gradient=True):
+    return Tensor._wrap(arr, stop_gradient=stop_gradient)
+
+
+def _collect_tensors(tree):
+    out = []
+    jax.tree.map(lambda x: out.append(x) if isinstance(x, Tensor) else None,
+                 tree, is_leaf=lambda x: isinstance(x, Tensor))
+    return out
+
+
+def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
+    """Run pure fn over (args, kwargs); handle Tensor inputs + tape recording.
+
+    fn receives raw jax values in place of Tensors.
+    Returns Tensors if any input was a Tensor, else fn's raw result.
+    """
+    tensors = _collect_tensors((args, kwargs))
+    if not tensors:
+        return fn(*args, **kwargs)
+
+    diff = [t for t in tensors
+            if not t.stop_gradient and _dtypes.is_floating(t._data.dtype)]
+    if not (is_grad_enabled() and diff):
+        rargs, rkwargs = _tree_unwrap((args, kwargs))
+        out = fn(*rargs, **rkwargs)
+        return jax.tree.map(wrap_like, out)
+
+    # Substitute primal placeholders for the differentiable tensors; close over
+    # everything else.  id()-keyed because the same Tensor may appear twice.
+    diff_ids = {}
+    primal_list = []
+    for t in diff:
+        if id(t) not in diff_ids:
+            diff_ids[id(t)] = len(primal_list)
+            primal_list.append(t._data)
+    uniq_diff = [None] * len(primal_list)
+    for t in diff:
+        uniq_diff[diff_ids[id(t)]] = t
+
+    def sub(x, primals):
+        if isinstance(x, Tensor):
+            i = diff_ids.get(id(x))
+            return x._data if i is None else primals[i]
+        return x
+
+    def closure(*primals):
+        rargs, rkwargs = jax.tree.map(
+            lambda x: sub(x, primals), (args, kwargs),
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return fn(*rargs, **rkwargs)
+
+    out, vjp_fn = jax.vjp(closure, *primal_list)
+
+    flat_out, treedef = jax.tree.flatten(out)
+    avals = [(o.shape, o.dtype) for o in flat_out]
+    node = GradNode(vjp_fn, uniq_diff, avals, treedef,
+                    name=op_name or getattr(fn, "__name__", "op"))
+    wrapped = []
+    for i, o in enumerate(flat_out):
+        sg = not _dtypes.is_floating(o.dtype)
+        t = Tensor._wrap(o, stop_gradient=sg,
+                         node=None if sg else node, out_index=i)
+        wrapped.append(t)
+    return jax.tree.unflatten(treedef, wrapped)
+
+
+def eager_op(fn: Callable = None, *, name: str = None):
+    """Decorator: make a pure-jax op callable with Tensors (tape-aware) or raw
+    jax values (direct). ``name=`` kwarg of the op itself (paddle API parity)
+    is swallowed before dispatch."""
+
+    def deco(f):
+        opname = name or f.__name__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            kwargs.pop("name", None)
+            return dispatch(f, *args, op_name=opname, **kwargs)
+
+        wrapper.__wrapped_pure__ = f
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco

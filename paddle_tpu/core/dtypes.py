@@ -1,0 +1,85 @@
+"""Dtype table and conversions (parity with paddle dtype strings).
+
+Reference: paddle/phi/common/data_type.h + python/paddle/framework/dtype.py.
+On TPU the preferred compute dtype is bfloat16; float64 is supported by jax
+only with x64 enabled, which we deliberately leave off (TPU-native default).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_NAME_TO_JAX = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "uint8": jnp.uint8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+ALL_DTYPE_NAMES = frozenset(_NAME_TO_JAX)
+
+FLOATING = frozenset({"float16", "bfloat16", "float32", "float64",
+                      "float8_e4m3fn", "float8_e5m2"})
+COMPLEX = frozenset({"complex64", "complex128"})
+INTEGER = frozenset({"int8", "uint8", "int16", "int32", "int64",
+                     "uint16", "uint32", "uint64"})
+
+# Exposed as module-level dtype objects: paddle_tpu.float32 is the string name;
+# simple and serializable, matching how users spell dtypes in paddle.
+float32 = "float32"
+float64 = "float64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+int8 = "int8"
+uint8 = "uint8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+bool_ = "bool"
+complex64 = "complex64"
+complex128 = "complex128"
+
+
+def to_jax(dtype):
+    """Accept dtype name str / np dtype / jnp dtype → jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+        if name in _NAME_TO_JAX:
+            return _NAME_TO_JAX[name]
+        raise ValueError(f"Unknown dtype: {dtype}")
+    return jnp.dtype(dtype)
+
+
+def from_jax(jdt) -> str:
+    name = np.dtype(jdt).name if not hasattr(jdt, "name") else jdt.name
+    if name == "bool":
+        return "bool"
+    return name
+
+
+def is_floating(dtype) -> bool:
+    if dtype is None:
+        return False
+    name = dtype if isinstance(dtype, str) else from_jax(dtype)
+    return name in FLOATING or name in COMPLEX
+
+
+def default_float_dtype() -> str:
+    from paddle_tpu.core import state
+    return state.get_default_dtype()

@@ -1,0 +1,63 @@
+"""Process-global framework state: default dtype, global seed / RNG stream.
+
+The reference keeps the global generator per device (paddle.seed fans out,
+python/paddle/framework/random.py).  JAX RNG is functional; for the eager API
+we keep a mutable key that splits on every draw — the jitted training path
+threads keys explicitly instead (idiomatic jax)."""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_default_dtype = "float32"
+_key = jax.random.key(0)
+_seed = 0
+
+
+def set_default_dtype(dtype: str):
+    global _default_dtype
+    from paddle_tpu.core import dtypes
+    if isinstance(dtype, str):
+        name = dtype.replace("paddle.", "")
+    else:
+        name = dtypes.from_jax(dtype)
+    if name not in dtypes.FLOATING:
+        raise ValueError(f"default dtype must be floating, got {dtype}")
+    _default_dtype = name
+
+
+def get_default_dtype() -> str:
+    return _default_dtype
+
+
+def seed(s: int):
+    global _key, _seed
+    with _lock:
+        _seed = int(s)
+        _key = jax.random.key(_seed)
+    return _seed
+
+
+def get_seed() -> int:
+    return _seed
+
+
+def next_key():
+    """Split the global eager key and return a fresh subkey."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def get_rng_state():
+    return jax.random.key_data(_key)
+
+
+def set_rng_state(data):
+    global _key
+    with _lock:
+        _key = jax.random.wrap_key_data(data)

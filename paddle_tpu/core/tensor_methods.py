@@ -1,0 +1,99 @@
+"""Attach the op surface onto Tensor as methods/dunders.
+
+The reference generates Tensor methods from the YAML op registry
+(python/paddle/tensor/__init__.py tensor_method_func list + monkey-patching in
+python/paddle/framework/framework.py).  We do the same in one place: a single
+table mapping method name → op function, applied at import."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import dispatch
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops import (creation, linalg, logic, manipulation, math,
+                            search, stat)
+
+
+def _binop(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return op
+
+
+_DUNDERS = {
+    "__add__": _binop(math.add),
+    "__radd__": _binop(math.add, True),
+    "__sub__": _binop(math.subtract),
+    "__rsub__": _binop(math.subtract, True),
+    "__mul__": _binop(math.multiply),
+    "__rmul__": _binop(math.multiply, True),
+    "__truediv__": _binop(math.divide),
+    "__rtruediv__": _binop(math.divide, True),
+    "__floordiv__": _binop(math.floor_divide),
+    "__rfloordiv__": _binop(math.floor_divide, True),
+    "__mod__": _binop(math.remainder),
+    "__rmod__": _binop(math.remainder, True),
+    "__pow__": _binop(math.pow),
+    "__rpow__": _binop(math.pow, True),
+    "__matmul__": _binop(linalg.matmul),
+    "__rmatmul__": _binop(linalg.matmul, True),
+    "__neg__": lambda self: math.neg(self),
+    "__abs__": lambda self: math.abs(self),
+    "__invert__": lambda self: logic.logical_not(self) if self.dtype == "bool"
+                  else logic.bitwise_not(self),
+    "__eq__": _binop(logic.equal),
+    "__ne__": _binop(logic.not_equal),
+    "__lt__": _binop(logic.less_than),
+    "__le__": _binop(logic.less_equal),
+    "__gt__": _binop(logic.greater_than),
+    "__ge__": _binop(logic.greater_equal),
+    "__and__": lambda s, o: logic.logical_and(s, o) if s.dtype == "bool"
+               else logic.bitwise_and(s, o),
+    "__or__": lambda s, o: logic.logical_or(s, o) if s.dtype == "bool"
+              else logic.bitwise_or(s, o),
+    "__xor__": lambda s, o: logic.logical_xor(s, o) if s.dtype == "bool"
+               else logic.bitwise_xor(s, o),
+    "__lshift__": _binop(logic.bitwise_left_shift),
+    "__rshift__": _binop(logic.bitwise_right_shift),
+}
+
+_METHOD_SOURCES = [math, linalg, manipulation, logic, search, stat]
+
+# names that clash with Tensor internals or builtins we must not override
+_SKIP = {"is_tensor", "where"}
+
+_EXTRA_METHODS = {
+    "zeros_like": creation.zeros_like,
+    "ones_like": creation.ones_like,
+    "full_like": creation.full_like,
+    "tril": creation.tril,
+    "triu": creation.triu,
+    "diag": creation.diag,
+    "where": manipulation.where,
+}
+
+
+def _install():
+    for name, fn in _DUNDERS.items():
+        setattr(Tensor, name, fn)
+    for mod in _METHOD_SOURCES:
+        for name in getattr(mod, "__all__", []):
+            if name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or hasattr(Tensor, name):
+                continue
+            setattr(Tensor, name, fn)
+    for name, fn in _EXTRA_METHODS.items():
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+    # reductions with paddle method-style defaults already match fn signatures
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: self.ndim
+    Tensor.element_size = lambda self: jnp.dtype(self._data.dtype).itemsize
+
+
+_install()

@@ -1,0 +1,95 @@
+"""Device management (parity: python/paddle/device/).
+
+TPU-native: one logical backend (XLA). set_device accepts 'tpu'/'cpu'/'gpu'
+spellings; device queries map to jax.devices()."""
+
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def set_device(device: str):
+    global _current
+    _current = device
+    return device
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    backend = jax.default_backend()
+    return f"{backend}:0"
+
+
+def get_all_custom_device_type():
+    return ["tpu"] if jax.default_backend() == "tpu" else []
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return jax.default_backend() == "tpu"
+
+
+class Stream:
+    """Parity shim: XLA owns stream scheduling on TPU; we expose the API shape
+    (reference: python/paddle/device/cuda/streams.py) as ordered no-ops."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        for d in jax.devices():
+            pass
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        pass
+
+
+def synchronize(device=None):
+    """Block until all queued work on the device is complete."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+cuda = None  # no CUDA in the build, by design (BASELINE.md constraint)

@@ -1,0 +1,77 @@
+"""Global runtime flags (parity: paddle/phi/core/flags.cc ~95 FLAGS_* +
+paddle.set_flags / python/paddle/fluid/framework.py:7472).
+
+Flags read their default from the FLAGS_<name> environment variable at import,
+and can be changed at runtime via set_flags.  Consumers read through
+`flags.get()` so runtime changes are visible."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_lock = threading.Lock()
+_registry: Dict[str, dict] = {}
+
+
+def _parse(value: str, default):
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default: Any, help_str: str = ""):
+    env = os.environ.get(f"FLAGS_{name}")
+    value = _parse(env, default) if env is not None else default
+    with _lock:
+        _registry[name] = {"value": value, "default": default, "help": help_str}
+    return value
+
+
+def get(name: str):
+    entry = _registry.get(name)
+    if entry is None:
+        raise KeyError(f"Unknown flag: {name}")
+    return entry["value"]
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for k, v in flags.items():
+            key = k[6:] if k.startswith("FLAGS_") else k
+            if key not in _registry:
+                _registry[key] = {"value": v, "default": v, "help": ""}
+            else:
+                cur = _registry[key]["default"]
+                _registry[key]["value"] = _parse(v, cur) if isinstance(v, str) and not isinstance(cur, str) else v
+
+
+def get_flags(flags=None):
+    with _lock:
+        if flags is None:
+            return {f"FLAGS_{k}": v["value"] for k, v in _registry.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        out = {}
+        for k in flags:
+            key = k[6:] if k.startswith("FLAGS_") else k
+            out[f"FLAGS_{key}"] = get(key)
+        return out
+
+
+# ---- core flag set (the subset of the reference's ~95 that applies on TPU) --
+define_flag("check_nan_inf", False, "scan op outputs for NaN/Inf (debugging)")
+define_flag("check_nan_inf_level", 0, "0: error on nan/inf; >=1: log only")
+define_flag("benchmark", False, "sync after ops for timing")
+define_flag("use_deterministic_ops", False, "force deterministic XLA lowering")
+define_flag("default_matmul_precision", "default",
+            "jax matmul precision: default|float32|bfloat16_3x|highest")
+define_flag("allocator_strategy", "auto_growth",
+            "kept for API parity; XLA owns HBM allocation on TPU")
+define_flag("eager_delete_tensor_gb", 0.0, "parity no-op")
+define_flag("log_level", 0, "VLOG-style verbosity")

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load parity (reference: python/paddle/framework/io.py:646,888).
+
+Serialization format: pickle of nested containers with Tensors converted to
+numpy (same interchange idea as the reference's pickle-compatible state
+dicts).  Sharded / async distributed checkpointing lives in
+paddle_tpu.framework.checkpoint (orbax-backed)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Parameter, Tensor
+
+_MAGIC = b"PDTPU001"
+
+
+def _to_storable(obj):
+    if isinstance(obj, Parameter):
+        return {"__paddle_tpu_param__": True, "data": np.asarray(obj._data),
+                "trainable": obj.trainable, "name": obj.name}
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_storable(v) for v in obj)
+    return obj
+
+
+def _from_storable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_param__"):
+            if return_numpy:
+                return obj["data"]
+            p = Parameter(obj["data"], trainable=obj["trainable"], name=obj["name"])
+            return p
+        if obj.get("__paddle_tpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(obj["data"], stop_gradient=obj["stop_gradient"])
+            t.name = obj.get("name")
+            return t
+        return {k: _from_storable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_storable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _from_storable(obj, return_numpy=return_numpy)

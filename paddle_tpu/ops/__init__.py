@@ -1,0 +1,5 @@
+"""Pure-op modules. Each op is a single jax-level function that is
+Tensor/tape-aware when handed eager Tensors (see core/dispatch.py)."""
+
+from paddle_tpu.ops import (creation, linalg, logic, manipulation, math,  # noqa: F401
+                            random, search, stat)

@@ -1,0 +1,178 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import dtypes as _dtypes
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import eager_op, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "diag", "diagflat", "tril", "triu", "meshgrid", "assign",
+    "clone", "tril_indices", "triu_indices", "complex", "polar",
+]
+
+
+def _resolve_dtype(dtype, default_float=True):
+    if dtype is None:
+        return _dtypes.to_jax(_state.get_default_dtype()) if default_float else None
+    return _dtypes.to_jax(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype)
+        t.stop_gradient = stop_gradient
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _resolve_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _resolve_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = unwrap(fill_value)
+    if dtype is None and isinstance(fill, (bool,)):
+        dt = jnp.bool_
+    elif dtype is None and isinstance(fill, int):
+        dt = jnp.int64
+    else:
+        dt = _resolve_dtype(dtype)
+    return Tensor._wrap(jnp.full(_shape(shape), fill, dt))
+
+
+@eager_op
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dtypes.to_jax(dtype))
+
+
+@eager_op
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dtypes.to_jax(dtype))
+
+
+@eager_op
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dtypes.to_jax(dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+@eager_op
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dtypes.to_jax(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) or (hasattr(v, "dtype") and
+               _dtypes.is_floating(v.dtype)) for v in (start, end, step)):
+            dt = _resolve_dtype(None)
+        else:
+            dt = jnp.int64
+    else:
+        dt = _dtypes.to_jax(dtype)
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor._wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                                     dtype=_resolve_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                                     base=unwrap(base), dtype=_resolve_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor._wrap(jnp.eye(int(num_rows),
+                                None if num_columns is None else int(num_columns),
+                                dtype=_resolve_dtype(dtype)))
+
+
+@eager_op
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1:
+        d = jnp.diag(x, k=offset)
+        if padding_value != 0:
+            mask = jnp.diag(jnp.ones(x.shape[0], dtype=bool), k=offset)
+            d = jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return d
+    return jnp.diag(x, k=offset)
+
+
+@eager_op
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@eager_op
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@eager_op
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and
+            isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor._wrap(o) for o in outs]
+
+
+@eager_op
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@eager_op
+def clone(x):
+    return x + jnp.zeros((), x.dtype)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor._wrap(jnp.stack([r, c]).astype(_dtypes.to_jax(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor._wrap(jnp.stack([r, c]).astype(_dtypes.to_jax(dtype)))
+
+
+@eager_op(name="complex")
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+@eager_op
+def polar(abs, angle):
+    return jax.lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))

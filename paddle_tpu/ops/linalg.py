@@ -1,0 +1,241 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py; reference
+matmul at linalg.py:139 → _C_ops.matmul).  On TPU these are THE MXU ops —
+all lower straight to XLA dot_general/conv."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+@eager_op
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@eager_op
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@eager_op
+def bmm(x, y):
+    return jax.lax.batch_matmul(x, y)
+
+
+@eager_op
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@eager_op
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@eager_op
+def dist(x, y, p=2.0):
+    d = jnp.abs(x - y).ravel()
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    if jnp.isinf(p):
+        return jnp.max(d) if p > 0 else jnp.min(d)
+    return jnp.sum(d ** p) ** (1.0 / p)
+
+
+@eager_op
+def norm(x, p=None, axis=None, keepdim=False):
+    if isinstance(axis, (list, tuple)) and len(axis) == 1:
+        axis = axis[0]
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2.0
+    if p == "fro":
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sqrt(jnp.sum(jnp.square(jnp.abs(x)), axis=ax, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1, keepdims=keepdim)
+    if isinstance(axis, (list, tuple)):
+        return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    if jnp.isinf(p):
+        f = jnp.max if p > 0 else jnp.min
+        return f(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keepdim) ** (1.0 / p)
+
+
+@eager_op
+def cross(x, y, axis=9):
+    if axis == 9:  # paddle default: first axis with dim 3
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@eager_op
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@eager_op
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@eager_op
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.lax.linalg.triangular_solve(
+        x, y, left_side=True, lower=not upper,
+        transpose_a=transpose, unit_diagonal=unitriangular)
+
+
+@eager_op
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@eager_op
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@eager_op
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@eager_op
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@eager_op
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@eager_op
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabs])
+
+
+@eager_op
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@eager_op
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@eager_op
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@eager_op
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@eager_op
+def eig(x):
+    # TPU/XLA has no nonsymmetric eig; compute on host (CPU callback-free:
+    # eager-only op, like reference dynamic ops)
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@eager_op
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, symmetrize_input=True)
+
+
+@eager_op
+def eigvals(x):
+    import numpy as np
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@eager_op
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+@eager_op
+def lu(x, pivot=True, get_infos=False):
+    lu_, piv, perm = jax.lax.linalg.lu(x)
+    # pack piv 1-indexed like LAPACK/paddle
+    pivots = piv + 1
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], jnp.int32)
+        return lu_, pivots, info
+    return lu_, pivots
+
+
+@eager_op
+def multi_dot(tensors):
+    return jnp.linalg.multi_dot(list(tensors))
+
+
+@eager_op
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False):
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(input.ravel(), bins=bins, range=(lo, hi),
+                            weights=None if weight is None else weight.ravel(),
+                            density=density)
+    return hist
+
+
+@eager_op
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)
+
+
+@eager_op
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@eager_op
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@eager_op
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)
+
+
+@eager_op
+def tensordot(x, y, axes=2):
+    if hasattr(axes, "__len__") and not isinstance(axes, int):
+        axes = tuple(tuple(a) if hasattr(a, "__len__") else a for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+# Public surface: only ops defined in this module (tape-aware wrappers carry
+# __wrapped_pure__; plain helpers must be defined here, not imported).
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

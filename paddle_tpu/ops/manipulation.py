@@ -1,0 +1,403 @@
+"""Shape / layout manipulation ops (parity: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import eager_op, unwrap
+from paddle_tpu.core.tensor import Tensor
+
+
+def _static_ints(v):
+    if isinstance(v, Tensor):
+        return [int(s) for s in np.asarray(v._data)]
+    if isinstance(v, (int, np.integer)):
+        return [int(v)]
+    return [int(unwrap(s)) for s in v]
+
+
+@eager_op
+def reshape(x, shape):
+    return jnp.reshape(x, _static_ints(shape))
+
+
+@eager_op
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    sa = start_axis % nd
+    ea = stop_axis % nd
+    new_shape = x.shape[:sa] + (-1,) + x.shape[ea + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+@eager_op
+def transpose(x, perm):
+    return jnp.transpose(x, _static_ints(perm))
+
+
+@eager_op
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@eager_op
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@eager_op
+def t(x):
+    if x.ndim <= 1:
+        return x
+    return jnp.swapaxes(x, -1, -2) if x.ndim == 2 else jnp.transpose(x)
+
+
+@eager_op
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = _static_ints(axis) if not isinstance(axis, int) else [axis]
+    axes = [a % x.ndim for a in axes]
+    axes = [a for a in axes if x.shape[a] == 1]
+    return jnp.squeeze(x, axis=tuple(axes)) if axes else x
+
+
+@eager_op
+def unsqueeze(x, axis):
+    axes = _static_ints(axis) if not isinstance(axis, int) else [axis]
+    out = x
+    nd = x.ndim + len(axes)
+    axes = sorted(a % nd for a in axes)
+    for a in axes:
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@eager_op
+def concat(x, axis=0):
+    if isinstance(axis, (jnp.ndarray, np.ndarray)):
+        axis = int(axis)
+    return jnp.concatenate(list(x), axis=int(axis))
+
+
+@eager_op
+def stack(x, axis=0):
+    return jnp.stack(list(x), axis=int(axis))
+
+
+@eager_op
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis)
+            for s in jnp.split(x, n, axis=axis)]
+
+
+@eager_op
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return list(jnp.split(x, num_or_sections, axis=axis))
+    secs = _static_ints(num_or_sections)
+    total = x.shape[axis]
+    if any(s == -1 for s in secs):
+        known = sum(s for s in secs if s != -1)
+        secs = [total - known if s == -1 else s for s in secs]
+    idx = np.cumsum(secs)[:-1].tolist()
+    return list(jnp.split(x, idx, axis=axis))
+
+
+@eager_op
+def chunk(x, chunks, axis=0):
+    return list(jnp.array_split(x, chunks, axis=int(axis)))
+
+
+@eager_op
+def tile(x, repeat_times):
+    return jnp.tile(x, _static_ints(repeat_times))
+
+
+@eager_op
+def expand(x, shape):
+    tgt = _static_ints(shape)
+    src = list(x.shape)
+    # paddle expand: -1 keeps dim; broadcasting from the right
+    while len(src) < len(tgt):
+        src.insert(0, 1)
+    out_shape = [s if t == -1 else t for s, t in zip(src, tgt)]
+    return jnp.broadcast_to(jnp.reshape(x, src), out_shape)
+
+
+@eager_op
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@eager_op
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, _static_ints(shape))
+
+
+def broadcast_tensors(inputs):
+    arrs = [unwrap(i) for i in inputs]
+    shape = jnp.broadcast_shapes(*[a.shape for a in arrs])
+    return [broadcast_to(i, shape) for i in inputs]
+
+
+@eager_op
+def flip(x, axis):
+    axes = _static_ints(axis) if not isinstance(axis, int) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@eager_op
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@eager_op
+def roll(x, shifts, axis=None):
+    if axis is not None and not isinstance(axis, int):
+        axis = tuple(_static_ints(axis))
+    if not isinstance(shifts, int):
+        shifts = tuple(_static_ints(shifts))
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@eager_op
+def slice(x, axes, starts, ends):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e in zip(_static_ints(axes), _static_ints(starts), _static_ints(ends)):
+        idx[a] = jnp.s_[s:e]
+    return x[tuple(idx)]
+
+
+@eager_op
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(_static_ints(axes), _static_ints(starts),
+                           _static_ints(ends), _static_ints(strides)):
+        idx[a] = jnp.s_[s:e:st]
+    return x[tuple(idx)]
+
+
+@eager_op
+def gather(x, index, axis=0):
+    index = jnp.reshape(index, (-1,)) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=int(unwrap(axis)) if not isinstance(axis, int) else axis)
+
+
+@eager_op
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@eager_op
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, shape)
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@eager_op
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape).astype(arr.dtype)
+    dims = list(range(arr.ndim))
+    idx = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    idx[axis] = indices
+    if reduce == "assign":
+        return arr.at[tuple(idx)].set(values)
+    if reduce in ("add", "sum"):
+        return arr.at[tuple(idx)].add(values)
+    if reduce in ("mul", "multiply"):
+        return arr.at[tuple(idx)].multiply(values)
+    raise ValueError(f"unknown reduce {reduce}")
+
+
+@eager_op
+def scatter(x, index, updates, overwrite=True):
+    index = jnp.reshape(index, (-1,))
+    if overwrite:
+        return x.at[index].set(updates.astype(x.dtype))
+    # overwrite=False: rows hit by index are zeroed then accumulated
+    # (duplicate indices sum) — paddle scatter semantics.
+    return x.at[index].set(0).at[index].add(updates.astype(x.dtype))
+
+
+@eager_op
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates.astype(x.dtype))
+
+
+@eager_op
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(_static_ints(shape), updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+@eager_op
+def index_select(x, index, axis=0):
+    return jnp.take(x, jnp.reshape(index, (-1,)), axis=axis)
+
+
+@eager_op
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@eager_op
+def index_add(x, index, axis, value):
+    index = jnp.reshape(index, (-1,))
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved.astype(x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+@eager_op
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    if accumulate:
+        return x.at[idx].add(value.astype(x.dtype))
+    return x.at[idx].set(jnp.broadcast_to(value, x[idx].shape).astype(x.dtype))
+
+
+@eager_op
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@eager_op
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis)]
+
+
+@eager_op
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@eager_op
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@eager_op
+def masked_select(x, mask):
+    # dynamic-shape op: eager only (jit path will fail by design, like
+    # the reference's dynamic-output ops do under to_static)
+    return x[jnp.broadcast_to(mask, x.shape)]
+
+
+@eager_op
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@eager_op
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+@eager_op
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True):
+    pads = _static_ints(pad)
+    nd = x.ndim
+    if len(pads) == 2 * nd:
+        # full per-axis spec, paddle order: axis-major lo/hi
+        width = [(pads[2 * i], pads[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to trailing spatial dims; paddle packs
+        # reversed (last axis first) like torch.nn.functional.pad
+        k = len(pads) // 2
+        width = [(0, 0)] * nd
+        for i in range(k):
+            axis = nd - 1 - i
+            width[axis] = (pads[2 * i], pads[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, width, mode="constant", constant_values=value)
+    return jnp.pad(x, width, mode=jmode)
+
+
+@eager_op
+def crop(x, shape=None, offsets=None):
+    sh = _static_ints(shape)
+    off = _static_ints(offsets) if offsets is not None else [0] * x.ndim
+    sh = [x.shape[i] if s == -1 else s for i, s in enumerate(sh)]
+    idx = tuple(jnp.s_[o:o + s] for o, s in zip(off, sh))
+    return x[idx]
+
+
+@eager_op
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+
+@eager_op
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    arr = np.asarray(x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        results = [jnp.asarray(out)]
+        if return_inverse:
+            results.append(jnp.asarray(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.concatenate([idx, [arr.size]]))
+            results.append(jnp.asarray(counts))
+        return results[0] if len(results) == 1 else tuple(results)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+@eager_op
+def rot90_(x, k=1):
+    return jnp.rot90(x, k=k)
+
+
+@eager_op
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, _static_ints(shape_or_dtype))
+    from paddle_tpu.core.dtypes import to_jax
+    return x.view(to_jax(shape_or_dtype)) if hasattr(x, "view") else \
+        jax.lax.bitcast_convert_type(x, to_jax(shape_or_dtype))
+
+
+@eager_op
+def numel(x):
+    return jnp.asarray(x.size, jnp.int64)
+
+
+@eager_op
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)
+
+
+# Public surface: only ops defined in this module (tape-aware wrappers carry
+# __wrapped_pure__; plain helpers must be defined here, not imported).
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

@@ -1,0 +1,169 @@
+"""Random ops.
+
+Eager calls draw from the process-global splitting key (core/state.py) so the
+paddle-style API (`paddle.rand(shape)`) works; the jitted training path should
+use the functional forms with explicit keys (`paddle_tpu.ops.random.*_p`) —
+idiomatic JAX, and required for reproducible pjit programs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import dtypes as _dtypes
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import unwrap
+from paddle_tpu.core.tensor import Tensor
+
+
+def _shape(shape):
+    import numpy as np
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def _dt(dtype):
+    if dtype is None:
+        return _dtypes.to_jax(_state.get_default_dtype())
+    return _dtypes.to_jax(dtype)
+
+
+def seed(s):
+    return _state.seed(s)
+
+
+def get_rng_state():
+    return _state.get_rng_state()
+
+
+def set_rng_state(st):
+    _state.set_rng_state(st)
+
+
+# ---- functional (key-explicit) forms: use these inside jit ----------------
+
+def uniform_p(key, shape, dtype=jnp.float32, min=-1.0, max=1.0):
+    return jax.random.uniform(key, shape, dtype, minval=min, maxval=max)
+
+
+def normal_p(key, shape, dtype=jnp.float32, mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, shape, dtype)
+
+
+def randint_p(key, low, high, shape, dtype=jnp.int32):
+    return jax.random.randint(key, shape, low, high, dtype)
+
+
+def bernoulli_p(key, p, shape, dtype=jnp.float32):
+    return jax.random.bernoulli(key, p, shape).astype(dtype)
+
+
+# ---- eager paddle-parity API ----------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    return Tensor._wrap(jax.random.uniform(_state.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor._wrap(jax.random.normal(_state.next_key(), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor) or shape is None:
+        m = unwrap(mean)
+        s = unwrap(std)
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor._wrap(m + s * jax.random.normal(
+            _state.next_key(), out_shape, _dt(None)))
+    return Tensor._wrap(mean + std * jax.random.normal(
+        _state.next_key(), _shape(shape), _dt(None)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return Tensor._wrap(jax.random.uniform(
+        _state.next_key(), _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = jnp.int64 if dtype is None else _dtypes.to_jax(dtype)
+    return Tensor._wrap(jax.random.randint(
+        _state.next_key(), _shape(shape), int(low), int(high), dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    arr = unwrap(x)
+    if high is None:
+        low, high = 0, low
+    dt = arr.dtype if dtype is None else _dtypes.to_jax(dtype)
+    out = jax.random.randint(_state.next_key(), arr.shape, int(low), int(high),
+                             jnp.int32)
+    return Tensor._wrap(out.astype(dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor._wrap(jax.random.permutation(_state.next_key(), int(n))
+                        .astype(_dtypes.to_jax(dtype)))
+
+
+def shuffle(x, axis=0):
+    arr = unwrap(x)
+    return Tensor._wrap(jax.random.permutation(_state.next_key(), arr, axis=axis,
+                                               independent=False))
+
+
+def bernoulli(x, name=None):
+    arr = unwrap(x)
+    return Tensor._wrap(jax.random.bernoulli(_state.next_key(), arr).astype(arr.dtype))
+
+
+def poisson(x, name=None):
+    arr = unwrap(x)
+    return Tensor._wrap(jax.random.poisson(_state.next_key(), arr).astype(arr.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    arr = unwrap(x)
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_state.next_key(), logits,
+                                     shape=(*arr.shape[:-1], num_samples) if arr.ndim > 1 else (num_samples,),
+                                     axis=-1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(_state.next_key(),
+                              arr.shape, logits.dtype)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor._wrap(out.astype(jnp.int64))
+
+
+def rand_like(x, dtype=None):
+    arr = unwrap(x)
+    dt = arr.dtype if dtype is None else _dtypes.to_jax(dtype)
+    return Tensor._wrap(jax.random.uniform(_state.next_key(), arr.shape, dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    arr = unwrap(x)
+    dt = arr.dtype if dtype is None else _dtypes.to_jax(dtype)
+    return Tensor._wrap(jax.random.normal(_state.next_key(), arr.shape, dt))
+
+
+def exponential_(x, lam=1.0, name=None):
+    arr = unwrap(x)
+    u = jax.random.uniform(_state.next_key(), arr.shape, arr.dtype,
+                           minval=jnp.finfo(arr.dtype).tiny, maxval=1.0)
+    out = -jnp.log(u) / lam
+    if isinstance(x, Tensor):
+        x._set_data(out)
+        return x
+    return Tensor._wrap(out)

@@ -1,0 +1,143 @@
+"""Search / sort / sampling-free selection ops
+(parity: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+@eager_op
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core.dtypes import to_jax
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    out = jnp.argmax(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(to_jax(dtype))
+
+
+@eager_op
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    from paddle_tpu.core.dtypes import to_jax
+    if axis is None:
+        x = x.ravel()
+        axis = 0
+    out = jnp.argmin(x, axis=int(axis), keepdims=keepdim)
+    return out.astype(to_jax(dtype))
+
+
+@eager_op
+def argsort(x, axis=-1, descending=False, stable=True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@eager_op
+def sort(x, axis=-1, descending=False, stable=True):
+    return jnp.sort(x, axis=axis, stable=stable, descending=descending)
+
+
+@eager_op
+def topk(x, k, axis=None, largest=True, sorted=True):
+    if axis is None:
+        axis = -1
+    axis = axis % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(xm, int(k))
+    else:
+        vals, idx = jax.lax.top_k(-xm, int(k))
+        vals = -vals
+    return (jnp.moveaxis(vals, -1, axis),
+            jnp.moveaxis(idx, -1, axis).astype(jnp.int64))
+
+
+@eager_op
+def kthvalue(x, k, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    sorted_v = jnp.sort(x, axis=axis)
+    sorted_i = jnp.argsort(x, axis=axis)
+    vals = jnp.take(sorted_v, k - 1, axis=axis)
+    idx = jnp.take(sorted_i, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@eager_op
+def mode(x, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    # O(n^2) pairwise count — fine for the typical last-dim sizes; fully
+    # static-shaped for XLA.
+    eqmat = moved[..., :, None] == moved[..., None, :]
+    counts = jnp.sum(eqmat, axis=-1)
+    maxc = jnp.max(counts, axis=-1, keepdims=True)
+    is_mode = counts == maxc
+    big = jnp.where(is_mode, moved,
+                    jnp.asarray(jnp.finfo(moved.dtype).max
+                                if jnp.issubdtype(moved.dtype, jnp.floating)
+                                else jnp.iinfo(moved.dtype).max, moved.dtype))
+    vals = jnp.min(big, axis=-1)
+    # paddle returns the LAST index of the modal value
+    hits = moved == vals[..., None]
+    rev_idx = jnp.argmax(jnp.flip(hits, axis=-1), axis=-1)
+    idx = moved.shape[-1] - 1 - rev_idx
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@eager_op
+def nonzero(x, as_tuple=False):
+    idx = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(i.astype(jnp.int64) for i in idx)
+    return jnp.stack(idx, axis=1).astype(jnp.int64)
+
+
+@eager_op
+def masked_argmax(x, mask, axis=None, keepdim=False):
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return jnp.argmax(jnp.where(mask, x, neg), axis=axis, keepdims=keepdim)
+
+
+@eager_op
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        flat_seq = sorted_sequence.reshape(-1, sorted_sequence.shape[-1])
+        flat_val = values.reshape(-1, values.shape[-1])
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            flat_seq, flat_val).reshape(values.shape)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@eager_op
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@eager_op
+def index_fill(x, index, axis, value):
+    index = jnp.reshape(index, (-1,))
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(jnp.asarray(value, x.dtype))
+    return jnp.moveaxis(out, 0, axis)
+
+
+# Public surface: only ops defined in this module (tape-aware wrappers carry
+# __wrapped_pure__; plain helpers must be defined here, not imported).
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

@@ -1,0 +1,75 @@
+"""Statistics ops (parity: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis
+
+
+@eager_op
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@eager_op
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@eager_op
+def median(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "avg":
+        return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+    # mode == 'min': lower of the two middle elements, plus its index
+    ax = -1 if axis is None else int(axis)
+    flat = x.ravel() if axis is None else x
+    n = flat.shape[ax]
+    k = (n - 1) // 2
+    sorted_v = jnp.sort(flat, axis=ax)
+    sorted_i = jnp.argsort(flat, axis=ax)
+    vals = jnp.take(sorted_v, k, axis=ax)
+    idx = jnp.take(sorted_i, k, axis=ax)
+    if keepdim and axis is not None:
+        vals = jnp.expand_dims(vals, ax)
+        idx = jnp.expand_dims(idx, ax)
+    return vals, idx.astype(jnp.int64)
+
+
+@eager_op
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@eager_op
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+@eager_op
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_axis(axis),
+                           keepdims=keepdim, method=interpolation)
+
+
+@eager_op
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    return jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                           weights=weights)
+
+
+# Public surface: only ops defined in this module (tape-aware wrappers carry
+# __wrapped_pure__; plain helpers must be defined here, not imported).
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

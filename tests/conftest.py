@@ -1,0 +1,30 @@
+"""Test configuration: force an 8-device virtual CPU platform so multi-chip
+sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware —
+the pattern prescribed by the task environment and mirroring the reference's
+"N processes on one host" distributed test strategy (SURVEY.md §4)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may have force-selected a remote TPU
+# platform via jax.config.update("jax_platforms", ...) at interpreter start,
+# which overrides the env var; undo it so tests run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu
+    paddle_tpu.seed(0)
+    np.random.seed(0)
+    yield

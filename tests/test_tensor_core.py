@@ -1,0 +1,187 @@
+"""Core Tensor + dispatch + autograd engine tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_to_tensor_basic():
+    t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == "float32"
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_dtype_conversions():
+    t = pt.to_tensor([1, 2, 3])
+    assert t.dtype in ("int32", "int64")
+    f = t.astype("float32")
+    assert f.dtype == "float32"
+    b = f.astype(pt.bfloat16)
+    assert b.dtype == "bfloat16"
+
+
+def test_arithmetic_dunders():
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 * a).numpy(), [2, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    assert bool((a == a).all())
+    assert bool((a < b).all())
+
+
+def test_matmul_and_indexing():
+    a = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = pt.to_tensor(np.ones((3, 2), dtype=np.float32))
+    c = a @ b
+    assert c.shape == [2, 2]
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+    row = a[0]
+    assert row.shape == [3]
+    sl = a[:, 1:]
+    assert sl.shape == [2, 2]
+
+
+def test_simple_backward():
+    x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    w = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = pt.matmul(x, w)          # [1*1+2*3, 1*2+2*4] = [7, 10]
+    z = (y * y).sum()            # 49 + 100
+    z.backward()
+    # dz/dy = 2y = [14, 20]; dz/dx = w @ dz/dy
+    np.testing.assert_allclose(x.grad.numpy(), [14 * 1 + 20 * 2, 14 * 3 + 20 * 4])
+    np.testing.assert_allclose(w.grad.numpy(),
+                               np.outer([1.0, 2.0], [14.0, 20.0]))
+
+
+def test_grad_accumulation_across_backwards():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_input_diamond():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    c = (a * b).sum()   # 12 x^2 → grad 24x = 48
+    c.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [48.0])
+
+
+def test_stop_gradient():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = pt.to_tensor([2.0])  # stop_gradient=True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    d = y.detach()
+    assert d.stop_gradient
+    z = (d * x).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_no_grad_context():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y._grad_node is None
+
+
+def test_autograd_grad_api():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = pt.autograd.grad(y.sum(), x)
+    np.testing.assert_allclose(g.numpy(), [6.0])
+    assert x.grad is None  # grad() must not write .grad
+
+
+def test_backward_non_scalar_needs_grad_tensor():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(pt.ones_like(y))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_inplace_version_guard():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x          # saves x for backward
+    x.add_(1.0)        # mutate after save
+    with pytest.raises(RuntimeError):
+        y.sum().backward()
+
+
+def test_setitem_and_inplace():
+    t = pt.to_tensor([1.0, 2.0, 3.0])
+    t[1] = 9.0
+    np.testing.assert_allclose(t.numpy(), [1, 9, 3])
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), [0, 0, 0])
+    t.fill_(5.0)
+    np.testing.assert_allclose(t.numpy(), [5, 5, 5])
+
+
+def test_pylayer():
+    class Double(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = pt.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_works_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a, b):
+        # same public op functions on raw jax values
+        return pt.matmul(a, b) + pt.ops.math.exp(a).sum()
+
+    a = jnp.ones((2, 2))
+    b = jnp.ones((2, 2))
+    out = f(a, b)
+    assert out.shape == (2, 2)
+
+
+def test_parameter():
+    p = pt.Parameter(np.zeros((2, 2), np.float32))
+    assert not p.stop_gradient
+    assert p.trainable
+    (p.sum() * 3).backward()
+    np.testing.assert_allclose(p.grad.numpy(), 3 * np.ones((2, 2)))
