@@ -26,7 +26,11 @@ __all__ = ["dispatch", "eager_op", "unwrap", "wrap_like"]
 
 
 def unwrap(x):
-    return x._data if isinstance(x, Tensor) else x
+    if isinstance(x, Tensor):
+        from paddle_tpu.core import functional as _func
+        sub = _func.lookup(x)
+        return x._data if sub is None else sub
+    return x
 
 
 def _tree_unwrap(tree):
@@ -44,15 +48,38 @@ def _collect_tensors(tree):
     return out
 
 
+def _amp_wrap(fn, op_name):
+    """Wrap fn so float array args are cast per the active AMP policy."""
+    from paddle_tpu import amp as _amp
+    if not _amp.is_auto_cast_enabled():
+        return fn
+
+    def wrapped(*a, **kw):
+        leaves, treedef = jax.tree.flatten((a, kw))
+        leaves = _amp.maybe_cast_args(op_name, leaves)
+        ra, rkw = jax.tree.unflatten(treedef, leaves)
+        return fn(*ra, **rkw)
+
+    return wrapped
+
+
 def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     """Run pure fn over (args, kwargs); handle Tensor inputs + tape recording.
 
     fn receives raw jax values in place of Tensors.
     Returns Tensors if any input was a Tensor, else fn's raw result.
     """
+    fn = _amp_wrap(fn, op_name)
     tensors = _collect_tensors((args, kwargs))
     if not tensors:
         return fn(*args, **kwargs)
+
+    from paddle_tpu.core import functional as _func
+    if _func.substitution_active():
+        # functional (traced) mode: all Tensors resolve through the
+        # substitution map; no tape, no wrapping — pure jax values out.
+        rargs, rkwargs = _tree_unwrap((args, kwargs))
+        return fn(*rargs, **rkwargs)
 
     diff = [t for t in tensors
             if not t.stop_gradient and _dtypes.is_floating(t._data.dtype)]

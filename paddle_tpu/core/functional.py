@@ -1,0 +1,105 @@
+"""functional_call: run an eagerly-defined Layer as a pure function.
+
+This is the bridge between the paddle-style imperative Layer API and JAX's
+functional transforms — the TPU-native answer to the reference's dy2static
+(@to_static AST rewriting, python/paddle/jit/dy2static/program_translator.py:305).
+Instead of rewriting Python source, we swap every Parameter/buffer access for
+a traced value through a context-local substitution map; ops called on raw
+traced values bypass the eager tape entirely (core/dispatch.py), so tracing a
+Layer's __call__ yields exactly the jaxpr a hand-written pure function would.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Any, Dict, Optional
+
+import jax
+
+_SUBST: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "param_substitution", default=None)
+_RNG: contextvars.ContextVar[Optional[dict]] = contextvars.ContextVar(
+    "functional_rng", default=None)
+
+
+def substitution_active() -> bool:
+    return _SUBST.get() is not None
+
+
+def lookup(tensor):
+    """Return the substituted traced value for an eager Tensor, or None."""
+    m = _SUBST.get()
+    if m is None:
+        return None
+    return m.get(id(tensor))
+
+
+@contextlib.contextmanager
+def substitute(mapping: Dict[int, Any], rngs: Optional[Dict[str, Any]] = None):
+    tok = _SUBST.set(mapping)
+    rng_state = {k: [v, 0] for k, v in (rngs or {}).items()}
+    tok2 = _RNG.set(rng_state)
+    try:
+        yield
+    finally:
+        _SUBST.reset(tok)
+        _RNG.reset(tok2)
+
+
+def functional_rng_active() -> bool:
+    return _RNG.get() is not None and len(_RNG.get()) > 0
+
+
+def next_functional_key(stream: str = "dropout"):
+    """Trace-safe RNG: fold an incrementing counter into the stream key."""
+    st = _RNG.get()
+    if not st or stream not in st:
+        return None
+    entry = st[stream]
+    key = jax.random.fold_in(entry[0], entry[1])
+    entry[1] += 1
+    return key
+
+
+def functional_call(layer, params_and_buffers: Dict[str, Any], *args,
+                    rngs: Optional[Dict[str, Any]] = None, **kwargs):
+    """Call `layer` with its parameters/buffers replaced by the values in
+    `params_and_buffers` (a dict keyed like state_dict(), values raw jax
+    arrays or Tensors).  Safe to use inside jax.jit/grad/vmap.
+    """
+    from paddle_tpu.core.tensor import Tensor
+
+    state = layer.state_dict(keep_vars=True)
+    mapping = {}
+    for name, value in params_and_buffers.items():
+        if name not in state:
+            raise KeyError(f"unknown parameter/buffer '{name}' for "
+                           f"{type(layer).__name__}")
+        v = value._data if isinstance(value, Tensor) else value
+        mapping[id(state[name])] = v
+    with substitute(mapping, rngs):
+        return layer(*args, **kwargs)
+
+
+def params_of(layer, dtype=None):
+    """Extract {name: jax.Array} of all params+buffers — the pytree that
+    functional_call/grad operate on."""
+    out = {}
+    for name, t in layer.state_dict(keep_vars=True).items():
+        arr = t._data
+        if dtype is not None:
+            import jax.numpy as jnp
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                arr = arr.astype(dtype)
+        out[name] = arr
+    return out
+
+
+def trainable_mask(layer):
+    """{name: bool} — True for trainable parameters (not buffers, not frozen)."""
+    from paddle_tpu.core.tensor import Parameter
+    mask = {}
+    for name, t in layer.state_dict(keep_vars=True).items():
+        mask[name] = isinstance(t, Parameter) and not t.stop_gradient
+    return mask

@@ -1,0 +1,108 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm/Norm/Value consumed by optimizers).
+
+Each clip object is callable on a list of (param, grad) pairs (eager) AND
+exposes a pure `apply_pytree(grads)` for the jitted/functional path — the
+same object serves both execution modes.  The distributed-aware variant
+(global norm across tp/pp/sharding groups, reference
+HybridParallelClipGrad) lives in paddle_tpu/distributed/fleet/."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import dispatch
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def apply_pytree(self, grads):
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _scale(self, leaves):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gnorm = jnp.sqrt(sq)
+        return jnp.minimum(1.0, self.clip_norm / jnp.maximum(gnorm, 1e-12)), \
+            gnorm
+
+    def apply_pytree(self, grads):
+        leaves, treedef = jax.tree.flatten(grads)
+        scale, _ = self._scale(leaves)
+        return jax.tree.unflatten(treedef, [(g * scale).astype(g.dtype)
+                                            for g in leaves])
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads if g is not None
+                 and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+
+        def _clip(*gs):
+            scale, _ = self._scale(gs)
+            return tuple((g * scale).astype(g.dtype) for g in gs)
+
+        clipped = dispatch(_clip, *grads, op_name="clip_global_norm")
+        it = iter(clipped)
+        out = []
+        for p, g in params_grads:
+            if g is not None and getattr(p, "need_clip", True):
+                out.append((p, next(it)))
+            else:
+                out.append((p, g))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def apply_pytree(self, grads):
+        def one(g):
+            n = jnp.linalg.norm(g.astype(jnp.float32).ravel())
+            s = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * s).astype(g.dtype)
+        return jax.tree.map(one, grads)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, dispatch(
+                lambda gv: (gv * jnp.minimum(
+                    1.0, self.clip_norm / jnp.maximum(
+                        jnp.linalg.norm(gv.astype(jnp.float32).ravel()),
+                        1e-12))).astype(gv.dtype),
+                g, op_name="clip_norm")))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply_pytree(self, grads):
+        return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, dispatch(lambda gv: jnp.clip(gv, self.min, self.max),
+                                    g, op_name="clip_value")))
+        return out

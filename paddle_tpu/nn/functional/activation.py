@@ -1,0 +1,125 @@
+"""Activation functions (parity: python/paddle/nn/functional/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+relu = eager_op(name="relu")(jax.nn.relu)
+relu6 = eager_op(name="relu6")(jax.nn.relu6)
+sigmoid = eager_op(name="sigmoid")(jax.nn.sigmoid)
+tanh = eager_op(name="tanh")(jnp.tanh)
+silu = eager_op(name="silu")(jax.nn.silu)
+swish = silu
+mish = eager_op(name="mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+hardswish = eager_op(name="hardswish")(jax.nn.hard_swish)
+hardsigmoid = eager_op(name="hardsigmoid")(
+    lambda x, slope=1.0 / 6, offset=0.5: jnp.clip(x * slope + offset, 0, 1))
+hardtanh = eager_op(name="hardtanh")(
+    lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+elu = eager_op(name="elu")(lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+celu = eager_op(name="celu")(lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+selu = eager_op(name="selu")(
+    lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+    scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+leaky_relu = eager_op(name="leaky_relu")(
+    lambda x, negative_slope=0.01: jax.nn.leaky_relu(x, negative_slope))
+softplus = eager_op(name="softplus")(
+    lambda x, beta=1.0, threshold=20.0:
+    jnp.where(x * beta > threshold, x, jax.nn.softplus(x * beta) / beta))
+softsign = eager_op(name="softsign")(jax.nn.soft_sign)
+tanhshrink = eager_op(name="tanhshrink")(lambda x: x - jnp.tanh(x))
+log_sigmoid = eager_op(name="log_sigmoid")(jax.nn.log_sigmoid)
+
+
+@eager_op
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@eager_op
+def softmax(x, axis=-1, dtype=None):
+    from paddle_tpu.core.dtypes import to_jax
+    if dtype is not None:
+        x = x.astype(to_jax(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@eager_op
+def log_softmax(x, axis=-1, dtype=None):
+    from paddle_tpu.core.dtypes import to_jax
+    if dtype is not None:
+        x = x.astype(to_jax(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@eager_op
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@eager_op
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros((), x.dtype))
+
+
+@eager_op
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.asarray(value, x.dtype))
+
+
+@eager_op
+def prelu(x, weight, data_format="NCHW"):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = jnp.reshape(w, shape)
+    return jnp.where(x >= 0, x, w * x)
+
+
+@eager_op
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False):
+    # eval mode (and deterministic training fallback): use the mean slope
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+@eager_op
+def maxout(x, groups, axis=1):
+    axis = axis % x.ndim
+    c = x.shape[axis]
+    new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+@eager_op
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@eager_op
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    # deterministic variant without key (eager path adds gumbel noise upstream)
+    y = jax.nn.softmax(x / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.zeros_like(y)
+        onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis,
+                                    inplace=False) if hasattr(jnp, "put_along_axis") else \
+            onehot.at[..., :].set(jnp.where(
+                jnp.arange(y.shape[axis]) == idx, 1.0, 0.0))
+        y = onehot + jax.lax.stop_gradient(-y) + y
+    return y
+
+
+# Public surface
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

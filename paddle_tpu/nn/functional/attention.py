@@ -1,0 +1,109 @@
+"""Attention functionals.
+
+Parity targets: python/paddle/nn/functional/flash_attention.py (reference
+routes to _C_ops.flash_attn, a CUDA kernel) and scaled_dot_product_attention.
+TPU-native: the hot path routes to a Pallas flash-attention kernel when on
+TPU (paddle_tpu/ops/pallas/flash_attention.py); the reference XLA fallback
+(below) is used on CPU and for odd shapes — XLA fuses it well regardless.
+
+Layout convention is paddle's: [batch, seq, num_heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                    scale=None, dropout_key=None):
+    # [b, s, h, d] → [b, h, s, d]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    # fp32 softmax accumulation (TPU numerics practice for bf16 inputs)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, -1e30)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -1e30)
+        else:
+            scores = scores + attn_mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype)).astype(probs.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q_shape, head_dim):
+    import jax as _j
+    if _j.default_backend() != "tpu":
+        return False
+    # pallas kernel wants lane-aligned head_dim and big enough seq
+    return head_dim % 128 == 0 and q_shape[1] >= 128
+
+
+@eager_op
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None):
+    use_dropout = dropout_p > 0.0 and training
+    if attn_mask is None and not use_dropout and \
+            _use_pallas(query.shape, query.shape[-1]):
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(query, key, value, causal=is_causal,
+                                   scale=scale)
+        except Exception:
+            pass
+    dk = None
+    if use_dropout:
+        from paddle_tpu.core import functional as _cf
+        from paddle_tpu.core import state as _cs
+        dk = _cf.next_functional_key("dropout")
+        if dk is None:
+            dk = _cs.next_key()
+    return _sdpa_reference(query, key, value, attn_mask, dropout_p,
+                           is_causal, scale, dropout_key=dk)
+
+
+@eager_op
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True):
+    """paddle.nn.functional.flash_attention parity: returns (out, softmax)."""
+    out = None
+    if _use_pallas(query.shape, query.shape[-1]):
+        try:
+            from paddle_tpu.ops.pallas.flash_attention import flash_attention \
+                as _fa
+            out = _fa(query, key, value, causal=causal)
+        except Exception:
+            out = None
+    if out is None:
+        out = _sdpa_reference(query, key, value, None, dropout, causal)
+    return out, None
+
+
+@eager_op
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, training=True):
+    # variable-length packed attention: fall back to dense with a block mask
+    raise NotImplementedError(
+        "flash_attn_unpadded: use dense attention with attn_mask for now")
+
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded"]

@@ -1,0 +1,263 @@
+"""Common NN functional ops: linear, dropout, embedding, one_hot, pad,
+interpolate, etc. (parity: python/paddle/nn/functional/common.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core import functional as _func
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import dispatch, eager_op, unwrap
+from paddle_tpu.ops.manipulation import pad  # re-export paddle.nn.functional.pad
+
+
+@eager_op
+def linear(x, weight, bias=None):
+    # paddle stores Linear weight as [in, out] → plain matmul, MXU-friendly
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """RNG comes from the functional stream under jit (functional_call rngs)
+    or the global eager key otherwise."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            # paddle 'downscale_in_infer': train masks unscaled, infer
+            # multiplies by keep-prob
+            return dispatch(lambda xv: (xv * (1.0 - p)).astype(xv.dtype), x,
+                            op_name="dropout")
+        return x
+    if p == 1.0:
+        from paddle_tpu.ops.creation import zeros_like
+        return zeros_like(x)
+
+    key = _func.next_functional_key("dropout")
+    if key is None:
+        key = _state.next_key()
+
+    def _drop(xv):
+        shape = list(xv.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), jnp.zeros((), xv.dtype)
+                             ).astype(xv.dtype)
+        return jnp.where(keep, xv, jnp.zeros((), xv.dtype)).astype(xv.dtype)
+
+    return dispatch(_drop, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _func.next_functional_key("dropout") or _state.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _drop(xv):
+        keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+        a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, xv, alpha_p) + b).astype(xv.dtype)
+
+    return dispatch(_drop, x, op_name="alpha_dropout")
+
+
+@eager_op
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return out
+
+
+@eager_op
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+@eager_op
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    k = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / k
+
+
+@eager_op
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@eager_op
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@eager_op
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@eager_op
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        oc = c // (r * r)
+        x = jnp.reshape(x, (b, oc, r, r, h, w))
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return jnp.reshape(x, (b, oc, h * r, w * r))
+    b, h, w, c = x.shape
+    oc = c // (r * r)
+    x = jnp.reshape(x, (b, h, w, r, r, oc))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return jnp.reshape(x, (b, h * r, w * r, oc))
+
+
+@eager_op
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = jnp.reshape(x, (b, c, h // r, r, w // r, r))
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return jnp.reshape(x, (b, c * r * r, h // r, w // r))
+    raise NotImplementedError("NHWC pixel_unshuffle")
+
+
+@eager_op
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        b, c, h, w = x.shape
+        x = jnp.reshape(x, (b, groups, c // groups, h, w))
+        x = jnp.swapaxes(x, 1, 2)
+        return jnp.reshape(x, (b, c, h, w))
+    b, h, w, c = x.shape
+    x = jnp.reshape(x, (b, h, w, groups, c // groups))
+    x = jnp.swapaxes(x, 3, 4)
+    return jnp.reshape(x, (b, h, w, c))
+
+
+@eager_op
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    b, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            sl = x[:, :, i * dh:i * dh + (oh - 1) * sh + 1:sh,
+                   j * dw:j * dw + (ow - 1) * sw + 1:sw]
+            patches.append(sl)
+    out = jnp.stack(patches, axis=2)  # [b, c, kh*kw, oh, ow]
+    return jnp.reshape(out, (b, c * kh * kw, oh * ow))
+
+
+@eager_op
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    if data_format == "NCHW":
+        spatial = x.shape[2:]
+        chan_first = True
+    else:
+        spatial = x.shape[1:-1]
+        chan_first = False
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        size = [int(s * f) for s, f in zip(spatial, scale_factor)]
+    size = [int(unwrap(s)) for s in size]
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    if chan_first:
+        out_shape = x.shape[:2] + tuple(size)
+    else:
+        out_shape = (x.shape[0],) + tuple(size) + (x.shape[-1],)
+    return jax.image.resize(x, out_shape, method=jmode)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+@eager_op
+def affine_grid(theta, out_shape, align_corners=True):
+    n, _, h, w = [int(s) for s in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1, 1, h)
+        xs = jnp.linspace(-1, 1, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2 / h - 1
+        xs = (jnp.arange(w) + 0.5) * 2 / w - 1
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+    out = jnp.einsum("hwk,nik->nhwi", grid, theta)
+    return out
+
+
+@eager_op
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    b, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    x = jnp.reshape(x, (b, c, kh, kw, nh, nw))
+    out = jnp.zeros((b, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            out = out.at[:, :, i * dh:i * dh + (nh - 1) * sh + 1:sh,
+                         j * dw:j * dw + (nw - 1) * sw + 1:sw].add(x[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+# Public surface
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]
+__all__.append("pad")

@@ -1,0 +1,141 @@
+"""Convolutions (parity: python/paddle/nn/functional/conv.py).
+
+All lower to XLA conv_general_dilated — the MXU path for conv models
+(PP-OCRv4-class networks).  Weight layout follows paddle: [out_c, in_c/groups,
+*spatial]; data_format NCHW (default) or NHWC.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+def _padding(pad, n):
+    if isinstance(pad, str):
+        return pad.upper()  # SAME / VALID
+    if isinstance(pad, int):
+        return [(pad, pad)] * n
+    pad = list(pad)
+    if len(pad) == n and all(isinstance(p, int) for p in pad):
+        return [(p, p) for p in pad]
+    if len(pad) == 2 * n:
+        return [(pad[2 * i], pad[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in pad]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, transpose=False, output_padding=0, output_size=None):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n:]
+        out_spec = lhs_spec
+    else:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+        out_spec = lhs_spec
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
+    stride = _ntuple(stride, n)
+    dilation = _ntuple(dilation, n)
+    pad = _padding(padding, n)
+
+    if not transpose:
+        out = jax.lax.conv_general_dilated(
+            x, weight, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=None)
+    else:
+        # conv_transpose: lhs_dilation= stride implements fractional stride
+        opad = _ntuple(output_padding, n)
+        k = weight.shape[2:]
+        if isinstance(pad, str):
+            raise ValueError("string padding unsupported for conv_transpose")
+        # transpose padding: p' = dilation*(k-1) - p
+        tpad = [(dilation[i] * (k[i] - 1) - pad[i][0],
+                 dilation[i] * (k[i] - 1) - pad[i][1] + opad[i])
+                for i in range(n)]
+        # weight [in, out/groups, *k] for paddle transpose convs → flip to
+        # [out, in/groups, *k] with spatial reversal
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+        w = jnp.swapaxes(w, 0, 1)
+        if groups > 1:
+            # regroup: weight was [in, out/groups, *k]
+            ic = weight.shape[0]
+            oc_pg = weight.shape[1]
+            w = jnp.reshape(weight, (groups, ic // groups, oc_pg) + k)
+            w = jnp.flip(w, axis=tuple(range(3, 3 + n)))
+            w = jnp.swapaxes(w, 1, 2)  # [groups, out/groups, in/groups, *k]
+            w = jnp.reshape(w, (oc_pg * groups, ic // groups) + k)
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1,) * n, padding=tpad,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups)
+
+    if bias is not None:
+        if data_format.startswith("NC"):
+            bshape = (1, -1) + (1,) * n
+        else:
+            bshape = (1,) * (1 + n) + (-1,)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@eager_op
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format)
+
+
+@eager_op
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+@eager_op
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+@eager_op
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+@eager_op
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+@eager_op
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW"):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, transpose=True, output_padding=output_padding)
+
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]

@@ -1,0 +1,291 @@
+"""Loss functionals (parity: python/paddle/nn/functional/loss.py).
+
+cross_entropy keeps logits in fp32 for the softmax (TPU numerics), computes
+log-softmax fused — this is the op the reference implements as
+c_softmax_with_cross_entropy for TP; the sharded variant lives in
+paddle_tpu/distributed/tp.py."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@eager_op
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    x = input.astype(jnp.float32)
+    if use_softmax:
+        logp = jax.nn.log_softmax(x, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(x, 1e-30))
+    n_classes = x.shape[axis]
+
+    if soft_label:
+        tgt = label.astype(jnp.float32)
+        if label_smoothing > 0:
+            tgt = (1 - label_smoothing) * tgt + label_smoothing / n_classes
+        loss = -jnp.sum(tgt * logp, axis=axis)
+        if weight is not None:
+            w = jnp.sum(tgt * weight, axis=axis)
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    lbl = label
+    if lbl.ndim == x.ndim and lbl.shape[axis] == 1:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    valid = lbl != ignore_index
+    safe_lbl = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(
+        logp, jnp.expand_dims(safe_lbl, axis), axis=axis)
+    picked = jnp.squeeze(picked, axis=axis)
+    if label_smoothing > 0:
+        smooth = jnp.mean(logp, axis=axis)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe_lbl)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(jnp.where(valid, w, 0.0)), 1e-12)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, return_softmax=False,
+                               axis=-1):
+    x = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(x, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label.astype(jnp.float32) * logp, axis=axis,
+                        keepdims=True)
+    else:
+        lbl = label
+        squeeze = lbl.ndim == x.ndim and lbl.shape[axis] == 1
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.squeeze(jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis), axis=axis)
+        loss = jnp.where(valid, -picked, 0.0)[..., None]
+    if return_softmax:
+        return loss, jax.nn.softmax(x, axis=axis)
+    return loss
+
+
+@eager_op
+def mse_loss(input, label, reduction="mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@eager_op
+def l1_loss(input, label, reduction="mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@eager_op
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    picked = jnp.take_along_axis(input, safe[..., None] if input.ndim == 2
+                                 else jnp.expand_dims(safe, 1), axis=1)
+    picked = jnp.squeeze(picked, axis=1)
+    loss = -picked
+    if weight is not None:
+        w = jnp.take(weight, safe)
+        loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.sum(jnp.where(valid, w, 0.0))
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(
+            jnp.sum(valid.astype(jnp.float32)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    x = jnp.clip(input.astype(jnp.float32), 1e-12, 1 - 1e-12)
+    loss = -(label * jnp.log(x) + (1 - label) * jnp.log1p(-x))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    x = logit.astype(jnp.float32)
+    neg_abs = -jnp.abs(x)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * x + log_w * (jnp.log1p(jnp.exp(neg_abs)) +
+                                          jnp.maximum(-x, 0))
+    else:
+        loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(neg_abs))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def kl_div(input, label, reduction="mean", log_target=False):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        safe = jnp.maximum(label, 1e-12)
+        loss = label * (jnp.log(safe) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(margin - input, 0.0))
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b) ** p + epsilon, axis=-1) ** (1.0 / p)
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn2 = dist(positive, negative)
+        dn = jnp.minimum(dn, dn2)
+    loss = jnp.maximum(dp - dn + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    # log_probs: [T, B, C] (paddle layout) — use a scan over time with the
+    # standard alpha recursion in log space; static shapes for XLA.
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    lp = log_probs.astype(jnp.float32)
+
+    # extended label sequence with blanks: [B, S]
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+    # transition allowed from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    allow_skip = (ext != blank) & (ext != ext_prev2)
+
+    def emit(t_lp, s_idx):
+        return jnp.take_along_axis(t_lp, s_idx, axis=1)
+
+    alpha0 = jnp.full((B, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(emit(lp[0], ext[:, 0:1])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(L > 0, emit(lp[0], ext[:, 1:2])[:, 0], neg_inf))
+
+    def step(alpha, t_lp):
+        a_prev = alpha
+        a_shift1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                           constant_values=-1e30)
+        a_shift2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                           constant_values=-1e30)
+        a_shift2 = jnp.where(allow_skip, a_shift2, neg_inf)
+        m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+        m_safe = jnp.maximum(m, -1e29)
+        tot = m_safe + jnp.log(
+            jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe) +
+            jnp.exp(a_shift2 - m_safe))
+        new_alpha = tot + emit(t_lp, ext)
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    # gather alpha at t = input_length-1, s = 2*label_length and 2*label_length-1
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    per_b = jnp.take_along_axis(
+        alphas, t_idx[None, :, None], axis=0)[0]  # [B, S]
+    s1 = jnp.clip(2 * label_lengths, 0, S - 1)
+    s2 = jnp.clip(2 * label_lengths - 1, 0, S - 1)
+    a1 = jnp.take_along_axis(per_b, s1[:, None], axis=1)[:, 0]
+    a2 = jnp.take_along_axis(per_b, s2[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a1, a2)
+    ll = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m))
+    loss = -ll
+    if reduction == "mean":
+        return jnp.mean(loss / jnp.maximum(label_lengths, 1))
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    p = jax.nn.sigmoid(logit.astype(jnp.float32))
+    ce = jnp.maximum(logit, 0) - logit * label + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

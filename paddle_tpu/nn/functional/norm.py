@@ -1,0 +1,161 @@
+"""Normalization functionals (parity: python/paddle/nn/functional/norm.py).
+
+batch_norm here is the pure compute; running-stat updates happen in the
+BatchNorm layer (eager) or are returned functionally.  All fuse well under
+XLA; rms_norm is the LLM hot path (kept in fp32 accumulation for bf16
+inputs — TPU numerics practice)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+@eager_op
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        ndim = 1
+    else:
+        ndim = len(tuple(normalized_shape))
+    axes = tuple(range(x.ndim - ndim, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) / jnp.sqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@eager_op
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = (xf * jnp.reciprocal(jnp.sqrt(ms + epsilon))).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@eager_op
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None):
+    chan_axis = 1 if data_format.startswith("NC") and x.ndim > 1 else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[chan_axis] = x.shape[chan_axis]
+    reduce_axes = tuple(i for i in range(x.ndim) if i != chan_axis)
+
+    use_batch = training and not use_global_stats
+    xf = x.astype(jnp.float32)
+    if use_batch:
+        mean = jnp.mean(xf, axis=reduce_axes)
+        var = jnp.var(xf, axis=reduce_axes)
+    else:
+        mean = running_mean
+        var = running_var
+    out = (xf - jnp.reshape(mean, shape)) / jnp.sqrt(
+        jnp.reshape(var, shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+def batch_norm_stats(x, data_format="NCHW"):
+    """Pure helper: batch mean/var along non-channel axes (for layer-side
+    running stat updates)."""
+    from paddle_tpu.core.dispatch import dispatch
+
+    def _stats(xv):
+        chan_axis = 1 if data_format.startswith("NC") and xv.ndim > 1 \
+            else xv.ndim - 1
+        axes = tuple(i for i in range(xv.ndim) if i != chan_axis)
+        xf = xv.astype(jnp.float32)
+        return jnp.mean(xf, axis=axes), jnp.var(xf, axis=axes)
+
+    return dispatch(_stats, x, op_name="batch_norm_stats")
+
+
+@eager_op
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW"):
+    # per-sample, per-channel normalization over spatial dims
+    if data_format.startswith("NC"):
+        axes = tuple(range(2, x.ndim))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        axes = tuple(range(1, x.ndim - 1))
+        shape = (1,) * (x.ndim - 1) + (-1,)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) / jnp.sqrt(var + eps)).astype(x.dtype)
+    if weight is not None:
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@eager_op
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW"):
+    if data_format == "NCHW" or x.ndim == 2:
+        b, c = x.shape[:2]
+        spatial = x.shape[2:]
+        xg = jnp.reshape(x, (b, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, xg.ndim))
+        xf = xg.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = ((xf - mean) / jnp.sqrt(var + epsilon)).astype(x.dtype)
+        out = jnp.reshape(out, x.shape)
+        shape = (1, c) + (1,) * len(spatial)
+    else:  # NHWC
+        b = x.shape[0]
+        c = x.shape[-1]
+        spatial = x.shape[1:-1]
+        xg = jnp.reshape(x, (b,) + spatial + (num_groups, c // num_groups))
+        axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+        xf = xg.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = ((xf - mean) / jnp.sqrt(var + epsilon)).astype(x.dtype)
+        out = jnp.reshape(out, x.shape)
+        shape = (1,) * (x.ndim - 1) + (c,)
+    if weight is not None:
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@eager_op
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    chan_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[chan_axis]
+    pads = [(0, 0)] * x.ndim
+    pads[chan_axis] = (half, size - 1 - half)
+    sq = jnp.pad(sq, pads)
+    acc = jnp.zeros_like(x, dtype=jnp.float32)
+    for i in range(size):
+        sl = [slice(None)] * x.ndim
+        sl[chan_axis] = slice(i, i + c)
+        acc = acc + sq[tuple(sl)].astype(jnp.float32)
+    div = jnp.power(k + alpha * acc / size, beta).astype(x.dtype)
+    return x / div
+
+
+__all__ = ["layer_norm", "rms_norm", "batch_norm", "batch_norm_stats",
+           "instance_norm", "group_norm", "local_response_norm"]

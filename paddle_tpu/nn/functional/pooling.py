@@ -1,0 +1,175 @@
+"""Pooling ops (parity: python/paddle/nn/functional/pooling.py), via
+lax.reduce_window."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+
+def _ntuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    return t * n if len(t) == 1 else t
+
+
+def _pool(x, kernel, stride, padding, n, data_format, op, ceil_mode=False,
+          exclusive=True):
+    kernel = _ntuple(kernel, n)
+    stride = _ntuple(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _ntuple(padding, n)
+        if len(p) == 2 * n:
+            pad_cfg = [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+        else:
+            pad_cfg = [(pi, pi) for pi in p]
+
+    chan_first = data_format.startswith("NC")
+    if chan_first:
+        dims = (1, 1) + kernel
+        strides = (1, 1) + stride
+        if not isinstance(pad_cfg, str):
+            pads = [(0, 0), (0, 0)] + list(pad_cfg)
+    else:
+        dims = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        if not isinstance(pad_cfg, str):
+            pads = [(0, 0)] + list(pad_cfg) + [(0, 0)]
+    if isinstance(pad_cfg, str):
+        pads = pad_cfg
+
+    if op == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, dims, strides,
+                                     pads)
+    # avg
+    summed = jax.lax.reduce_window(x.astype(jnp.float32), 0.0, jax.lax.add,
+                                   dims, strides, pads)
+    if exclusive and not isinstance(pads, str):
+        ones = jnp.ones_like(x, dtype=jnp.float32)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides,
+                                       pads)
+        return (summed / counts).astype(x.dtype)
+    denom = 1
+    for k in kernel:
+        denom *= k
+    return (summed / denom).astype(x.dtype)
+
+
+@eager_op
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL"):
+    out = _pool(x, kernel_size, stride, padding, 1, data_format, "max",
+                ceil_mode)
+    return out
+
+
+@eager_op
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
+                 ceil_mode)
+
+
+@eager_op
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max",
+                 ceil_mode)
+
+
+@eager_op
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL"):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+@eager_op
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+@eager_op
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, exclusive)
+
+
+def _adaptive_out(in_size, out_size):
+    # emit start/end per output index (static shapes)
+    import numpy as np
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, op):
+    chan_first = data_format.startswith("NC")
+    spatial_off = 2 if chan_first else 1
+    out_sizes = _ntuple(output_size, n)
+    arr = x
+    for d in range(n):
+        axis = spatial_off + d
+        in_size = arr.shape[axis]
+        o = out_sizes[d]
+        if in_size % o == 0:
+            # uniform windows → reshape+reduce (fast path)
+            k = in_size // o
+            new_shape = arr.shape[:axis] + (o, k) + arr.shape[axis + 1:]
+            r = jnp.reshape(arr, new_shape)
+            arr = jnp.max(r, axis=axis + 1) if op == "max" else \
+                jnp.mean(r, axis=axis + 1)
+        else:
+            starts, ends = _adaptive_out(in_size, o)
+            slices = []
+            for s, e in zip(starts, ends):
+                window = jax.lax.slice_in_dim(arr, int(s), int(e), axis=axis)
+                red = jnp.max(window, axis=axis, keepdims=True) if op == "max" \
+                    else jnp.mean(window, axis=axis, keepdims=True)
+                slices.append(red)
+            arr = jnp.concatenate(slices, axis=axis)
+    return arr
+
+
+@eager_op
+def adaptive_avg_pool1d(x, output_size):
+    return _adaptive_pool(x, output_size, 1, "NCL", "avg")
+
+
+@eager_op
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+@eager_op
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+@eager_op
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 1, "NCL", "max")
+
+
+@eager_op
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+@eager_op
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+
+
+__all__ = [_n for _n in list(globals())
+           if _n.endswith(("pool1d", "pool2d", "pool3d"))]

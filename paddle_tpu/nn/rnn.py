@@ -1,0 +1,269 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is jax.lax.scan (single compiled kernel, no Python
+loop per step); cells are plain functions over (input, state).  Weight layout
+matches paddle: weight_ih [hidden*gates, input], weight_hh [hidden*gates,
+hidden], gate order i,f,c,o for LSTM and r,z,c for GRU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import dispatch
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        if bias_ih_attr is False:
+            self.bias_ih = None
+        else:
+            self.bias_ih = self.create_parameter(
+                [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+                default_initializer=u)
+        if bias_hh_attr is False:
+            self.bias_hh = None
+        else:
+            self.bias_hh = self.create_parameter(
+                [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+                default_initializer=u)
+
+    def get_initial_states(self, batch_size, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def _cell(self, x, h, wih, whh, bih, bhh):
+        z = x @ wih.T + h @ whh.T
+        if bih is not None:
+            z = z + bih
+        if bhh is not None:
+            z = z + bhh
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(z)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = dispatch(lambda x: jnp.zeros(
+                (x.shape[0], self.hidden_size), x.dtype), inputs)
+        h = dispatch(self._cell, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh,
+                     op_name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def _cell(self, x, h, c, wih, whh, bih, bhh):
+        z = x @ wih.T + h @ whh.T
+        if bih is not None:
+            z = z + bih
+        if bhh is not None:
+            z = z + bhh
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = dispatch(lambda x: jnp.zeros(
+                (x.shape[0], self.hidden_size), x.dtype), inputs)
+            states = (z, z)
+        h, c = states
+        h_new, c_new = dispatch(self._cell, inputs, h, c, self.weight_ih,
+                                self.weight_hh, self.bias_ih, self.bias_hh,
+                                op_name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def _cell(self, x, h, wih, whh, bih, bhh):
+        zi = x @ wih.T
+        zh = h @ whh.T
+        if bih is not None:
+            zi = zi + bih
+        if bhh is not None:
+            zh = zh + bhh
+        ri, zi_, ci = jnp.split(zi, 3, axis=-1)
+        rh, zh_, ch = jnp.split(zh, 3, axis=-1)
+        r = jax.nn.sigmoid(ri + rh)
+        z = jax.nn.sigmoid(zi_ + zh_)
+        c = jnp.tanh(ci + r * ch)
+        return (1 - z) * c + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = dispatch(lambda x: jnp.zeros(
+                (x.shape[0], self.hidden_size), x.dtype), inputs)
+        h = dispatch(self._cell, inputs, states, self.weight_ih,
+                     self.weight_hh, self.bias_ih, self.bias_hh,
+                     op_name="gru_cell")
+        return h, h
+
+
+class RNN(Layer):
+    """Runs a cell over time with lax.scan (reference RNN wrapper class)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = isinstance(self.cell, LSTMCell)
+
+        def _run(x, wih, whh, bih, bhh, states):
+            if not self.time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, D]
+            if self.is_reverse:
+                x = jnp.flip(x, axis=0)
+            b = x.shape[1]
+            if states is None:
+                z = jnp.zeros((b, self.cell.hidden_size), x.dtype)
+                st = (z, z) if is_lstm else z
+            else:
+                st = states
+
+            def step(carry, xt):
+                if is_lstm:
+                    h, c = self.cell._cell(xt, carry[0], carry[1], wih, whh,
+                                           bih, bhh)
+                    return (h, c), h
+                h = self.cell._cell(xt, carry, wih, whh, bih, bhh)
+                return h, h
+
+            final, outs = jax.lax.scan(step, st, x)
+            if self.is_reverse:
+                outs = jnp.flip(outs, axis=0)
+            if not self.time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return outs, final
+
+        return dispatch(_run, inputs, self.cell.weight_ih,
+                        self.cell.weight_hh, self.cell.bias_ih,
+                        self.cell.bias_hh, initial_states, op_name="rnn")
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        from paddle_tpu.ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _StackedRNNBase(Layer):
+    _cell_cls = None
+    _is_lstm = False
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None, **cell_kwargs):
+        super().__init__()
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        from paddle_tpu.nn.common_layers import LayerList
+        self.rnns = LayerList()
+        num_dir = 2 if self.bidirectional else 1
+        for layer_i in range(num_layers):
+            in_size = input_size if layer_i == 0 else hidden_size * num_dir
+            if self.bidirectional:
+                self.rnns.append(BiRNN(
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                    time_major=time_major))
+            else:
+                self.rnns.append(RNN(
+                    self._cell_cls(in_size, hidden_size, **cell_kwargs),
+                    time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        out = inputs
+        finals = []
+        from paddle_tpu.nn.functional import dropout as fdrop
+        for i, rnn in enumerate(self.rnns):
+            st_in = None
+            if initial_states is not None:
+                # accepted forms: list/tuple of per-layer states, or
+                # (h0, c0) arrays with a leading [num_layers*num_dir] axis
+                if isinstance(initial_states, (list, tuple)) and \
+                        len(initial_states) == self.num_layers:
+                    st_in = initial_states[i]
+                elif self._is_lstm and isinstance(initial_states, tuple) and \
+                        len(initial_states) == 2:
+                    h0, c0 = initial_states
+                    st_in = (h0[i], c0[i]) if not self.bidirectional else \
+                        ((h0[2 * i], c0[2 * i]), (h0[2 * i + 1], c0[2 * i + 1]))
+                else:
+                    st_in = initial_states[i] if not self._is_lstm else None
+            out, st = rnn(out, st_in)
+            finals.append(st)
+            if self.dropout and i < self.num_layers - 1:
+                out = fdrop(out, p=self.dropout, training=self.training)
+        return out, finals
+
+
+class SimpleRNN(_StackedRNNBase):
+    _cell_cls = SimpleRNNCell
+
+
+class LSTM(_StackedRNNBase):
+    _cell_cls = LSTMCell
+    _is_lstm = True
+
+
+class GRU(_StackedRNNBase):
+    _cell_cls = GRUCell

@@ -1,1 +1,7 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.optimizer (parity: python/paddle/optimizer/)."""
+
+from paddle_tpu.optimizer import lr  # noqa: F401
+from paddle_tpu.optimizer.optimizer import Optimizer  # noqa: F401
+from paddle_tpu.optimizer.optimizers import (  # noqa: F401
+    SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp,
+)

@@ -1,0 +1,217 @@
+"""Optimizer base (parity: python/paddle/optimizer/optimizer.py —
+accumulators, grad clip, regularization, LR scheduler integration).
+
+TPU-native design: each optimizer defines ONE pure update rule
+`_update(param, grad, state, lr, ...) -> (new_param, new_state)` on raw jax
+arrays.  The eager `step()` walks Parameter.grad and mutates in place (paddle
+semantics); the functional `apply_gradients(params, grads, opt_state)` is the
+same rule jitted over pytrees — used by the train-step compiler, pjit
+sharding, and the distributed wrappers.  One rule, two execution modes, like
+core/dispatch.py for ops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import dispatch, unwrap
+from paddle_tpu.core.tensor import Parameter, Tensor, no_grad
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        from paddle_tpu.optimizer import lr as lr_mod
+        self._lr_scheduler = None
+        if isinstance(learning_rate, lr_mod.LRScheduler):
+            self._lr_scheduler = learning_rate
+        else:
+            self._base_lr = float(learning_rate)
+        self._parameters = list(parameters) if parameters is not None else None
+        if weight_decay is None:
+            self._weight_decay = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+        else:  # L2Decay-like object with coeff
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay, "coeff", 0.0)))
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[int, Dict[str, Any]] = {}
+        self._global_step = 0
+        self._current_param_name = None
+
+    # -- LR ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_scheduler is not None:
+            return float(self._lr_scheduler())
+        return self._base_lr
+
+    def set_lr(self, value: float):
+        if self._lr_scheduler is not None:
+            raise RuntimeError("optimizer's learning rate is a scheduler; "
+                               "call scheduler.step()/set attrs instead")
+        self._base_lr = float(value)
+
+    # -- update rule (override) ---------------------------------------------
+    def _init_state(self, param_arr) -> Dict[str, Any]:
+        """Per-parameter state pytree (raw arrays)."""
+        return {}
+
+    def _init_state_full(self, param_arr) -> Dict[str, Any]:
+        st = self._init_state(param_arr)
+        if self._multi_precision and param_arr.dtype in (jnp.bfloat16,
+                                                         jnp.float16):
+            st = dict(st)
+            st["_master"] = param_arr.astype(jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr, step):
+        """Pure rule: arrays in, arrays out. Override in subclasses."""
+        raise NotImplementedError
+
+    def _apply_weight_decay(self, param, grad):
+        """Default: L2 regularization folded into the gradient (reference
+        optimizer.py regularization path). AdamW overrides to decoupled."""
+        if self._weight_decay:
+            return grad + self._weight_decay * param
+        return grad
+
+    # -- eager step ----------------------------------------------------------
+    def step(self):
+        if self._parameters is None:
+            raise ValueError("Optimizer created without parameters; pass "
+                             "parameters=model.parameters()")
+        params_grads = [(p, p.grad) for p in self._parameters
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self.get_lr()
+        self._global_step += 1
+        step = self._global_step
+        for i, (p, g) in enumerate(params_grads):
+            if g is None:
+                continue
+            key = id(p)
+            if key not in self._accumulators:
+                self._accumulators[key] = self._init_state_full(p._data)
+            state = self._accumulators[key]
+            self._current_param_name = p.name or f"param_{i}"
+            new_p, new_state = self._update_with_master(
+                p._data, unwrap(g), state, lr, step)
+            p._set_data(new_p.astype(p._data.dtype))
+            self._accumulators[key] = new_state
+
+    def _update_with_master(self, pv, gv, state, lr, step):
+        """Shared by eager and functional paths: optional fp32 master weight
+        (kept in the optimizer state under '_master'), weight decay policy,
+        then the subclass rule."""
+        use_master = self._multi_precision and pv.dtype in (
+            jnp.bfloat16, jnp.float16)
+        if use_master:
+            master = state.get("_master")
+            if master is None:
+                master = pv.astype(jnp.float32)
+            work_p = master
+        else:
+            work_p = pv
+        if not isinstance(self, _DecoupledWD):
+            gv = self._apply_weight_decay(work_p, gv)
+        inner = {k: v for k, v in state.items() if k != "_master"}
+        new_p, new_inner = self._update(work_p, gv, inner, lr, step)
+        if use_master:
+            new_inner = dict(new_inner)
+            new_inner["_master"] = new_p
+        return new_p, new_inner
+
+    @no_grad()
+    def _noop(self):
+        pass
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameters is not None:
+            for p in self._parameters:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- functional path -----------------------------------------------------
+    def init_state_pytree(self, params):
+        """params: pytree of raw arrays → matching pytree of state dicts."""
+        return jax.tree.map(lambda p: self._init_state_full(p), params,
+                            is_leaf=lambda x: isinstance(x, (jnp.ndarray,
+                                                             jax.Array,
+                                                             np.ndarray)))
+
+    def apply_gradients(self, params, grads, opt_state, step,
+                        lr=None, skip_clip=False):
+        """Pure functional update over pytrees (jit/pjit-safe).
+
+        params/grads: matching pytrees of arrays; opt_state from
+        init_state_pytree; step: int array/scalar.  Returns
+        (new_params, new_opt_state)."""
+        lr = self.get_lr() if lr is None else lr
+        if self._grad_clip is not None and not skip_clip:
+            grads = self._grad_clip.apply_pytree(grads)
+
+        is_arr = lambda x: isinstance(x, (jnp.ndarray, jax.Array, np.ndarray))
+        flat_pk, treedef = jax.tree_util.tree_flatten_with_path(
+            params, is_leaf=is_arr)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_s = treedef.flatten_up_to(opt_state)
+        new_p, new_s = [], []
+        for (path, p), g, s in zip(flat_pk, flat_g, flat_s):
+            if g is None:
+                new_p.append(p)
+                new_s.append(s)
+                continue
+            self._current_param_name = jax.tree_util.keystr(path)
+            g = g.astype(jnp.float32) if self._multi_precision else g
+            np_, ns = self._update_with_master(p, g, s, lr, step)
+            new_p.append(np_.astype(p.dtype))
+            new_s.append(ns)
+        return jax.tree.unflatten(treedef, new_p), \
+            jax.tree.unflatten(treedef, new_s)
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self):
+        out = {"global_step": self._global_step}
+        if self._lr_scheduler is not None:
+            out["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        accum = {}
+        if self._parameters is not None:
+            for i, p in enumerate(self._parameters):
+                st = self._accumulators.get(id(p))
+                if st is not None:
+                    accum[p.name or f"param_{i}"] = jax.tree.map(np.asarray, st)
+        out["accumulators"] = accum
+        return out
+
+    def set_state_dict(self, state):
+        self._global_step = state.get("global_step", 0)
+        if self._lr_scheduler is not None and "LR_Scheduler" in state:
+            self._lr_scheduler.set_state_dict(state["LR_Scheduler"])
+        accum = state.get("accumulators", {})
+        if self._parameters is not None:
+            for i, p in enumerate(self._parameters):
+                key = p.name or f"param_{i}"
+                if key in accum:
+                    self._accumulators[id(p)] = jax.tree.map(
+                        jnp.asarray, accum[key])
+
+
+class _DecoupledWD:
+    """Marker mixin: optimizer applies decoupled weight decay itself
+    (AdamW/Lamb) instead of the L2-into-grad default."""

@@ -1,0 +1,225 @@
+"""Concrete optimizers (parity: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,adagrad,rmsprop,adadelta,adamax,lamb}.py).  Pure update rules on
+arrays; see optimizer.py for the eager/functional duality."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.optimizer.optimizer import Optimizer, _DecoupledWD
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+           "Adadelta", "Adamax", "Lamb"]
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, s, lr, step):
+        return p - lr * g.astype(p.dtype), s
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _update(self, p, g, s, lr, step):
+        g = g.astype(p.dtype)
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            upd = g + self._momentum * v
+        else:
+            upd = v
+        return p - lr * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        mhat = m / bc1
+        vhat = v / bc2
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam, _DecoupledWD):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._weight_decay = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        mhat = m / (1 - self._beta1 ** step)
+        vhat = v / (1 - self._beta2 ** step)
+        upd = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = self._weight_decay
+        if wd and self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(self._current_param_name or ""):
+            wd = 0.0
+        pf = p.astype(jnp.float32)
+        pf = pf - lr * (upd + wd * pf)
+        return pf.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_val,
+                                        dtype=jnp.float32)}
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        acc = s["moment"] + jnp.square(gf)
+        upd = gf / (jnp.sqrt(acc) + self._eps)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p, dtype=jnp.float32),
+              "momentum": jnp.zeros_like(p, dtype=jnp.float32)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p, dtype=jnp.float32)
+        return st
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * jnp.square(gf)
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * gf
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * s["momentum"] + lr * gf / denom
+        out = {"mean_square": ms, "momentum": mom}
+        if self._centered:
+            out["mean_grad"] = mg
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), out
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._eps, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * \
+            jnp.square(gf)
+        upd = gf * jnp.sqrt(s["avg_squared_update"] + self._eps) / \
+            jnp.sqrt(asg + self._eps)
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * \
+            jnp.square(upd)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * s["moment"] + (1 - self._beta1) * gf
+        u = jnp.maximum(self._beta2 * s["inf_norm"], jnp.abs(gf))
+        upd = m / ((1 - self._beta1 ** step) * (u + self._eps))
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Lamb(Optimizer, _DecoupledWD):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision)
+        self._weight_decay = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
+                "moment2": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, s, lr, step):
+        gf = g.astype(jnp.float32)
+        m = self._beta1 * s["moment1"] + (1 - self._beta1) * gf
+        v = self._beta2 * s["moment2"] + (1 - self._beta2) * jnp.square(gf)
+        mhat = m / (1 - self._beta1 ** step)
+        vhat = v / (1 - self._beta2 ** step)
+        pf = p.astype(jnp.float32)
+        wd = self._weight_decay
+        if wd and self._exclude_fn is not None and \
+                self._exclude_fn(self._current_param_name or ""):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * pf
+        w_norm = jnp.linalg.norm(pf.ravel())
+        r_norm = jnp.linalg.norm(r.ravel())
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}

@@ -1,0 +1,219 @@
+"""nn.Layer + layer zoo tests (modelled on the reference's OpTest/numpy-parity
+style, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def test_linear_forward_shape_and_value():
+    pt.seed(1)
+    layer = nn.Linear(4, 3)
+    x = pt.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5)
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert set(names) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    assert len(sd) == 4
+    # roundtrip
+    net2 = Net()
+    missing, unexpected = net2.set_state_dict(sd)
+    assert not missing and not unexpected
+    np.testing.assert_allclose(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+
+def test_layer_backward_through_net():
+    net = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 1))
+    x = pt.randn([4, 3])
+    loss = net(x).mean()
+    loss.backward()
+    for p in net.parameters():
+        assert p.grad is not None, "missing grad"
+
+
+def test_conv2d_matches_numpy():
+    import torch  # cpu torch available for reference conv
+    import torch.nn.functional as TF
+    pt.seed(0)
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    w = np.random.randn(5, 3, 3, 3).astype(np.float32)
+    b = np.random.randn(5).astype(np.float32)
+    out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w), pt.to_tensor(b),
+                   stride=2, padding=1)
+    ref = TF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                    stride=2, padding=1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+    x = np.random.randn(1, 4, 5, 5).astype(np.float32)
+    w = np.random.randn(4, 6, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+    out = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w), stride=2,
+                             padding=1)
+    ref = TF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm2D(3, momentum=0.5)
+    x = pt.randn([4, 3, 2, 2]) * 3 + 1
+    bn.train()
+    _ = bn(x)
+    # running mean moved toward batch mean
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == [4, 3, 2, 2]
+
+
+def test_layernorm_and_rmsnorm():
+    ln = nn.LayerNorm(8)
+    x = pt.randn([2, 4, 8])
+    y = ln(x)
+    m = y.numpy().mean(-1)
+    np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+    rn = nn.RMSNorm(8)
+    y2 = rn(x)
+    assert y2.shape == [2, 4, 8]
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = pt.ones([1000])
+    d.train()
+    y = d(x)
+    zeros = float((y.numpy() == 0).mean())
+    assert 0.3 < zeros < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = pt.to_tensor(np.array([0, 3, 0], np.int32))
+    out = emb(idx)
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+    np.testing.assert_allclose(out.numpy()[2], np.zeros(4))
+
+
+def test_multi_head_attention():
+    pt.seed(0)
+    mha = nn.MultiHeadAttention(16, 4)
+    x = pt.randn([2, 5, 16])
+    y = mha(x)
+    assert y.shape == [2, 5, 16]
+    y.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    enc_layer = nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    x = pt.randn([2, 6, 16])
+    y = enc(x)
+    assert y.shape == [2, 6, 16]
+
+
+def test_lstm_scan():
+    lstm = nn.LSTM(input_size=4, hidden_size=8, num_layers=1)
+    x = pt.randn([2, 7, 4])  # [B, T, D]
+    out, _ = lstm(x)
+    assert out.shape == [2, 7, 8]
+    out.sum().backward()
+    assert lstm.rnns[0].cell.weight_ih.grad is not None
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(2, 3), nn.Tanh(), nn.Linear(3, 2))
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_pooling():
+    x = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    y = F.max_pool2d(x, 2)
+    np.testing.assert_allclose(y.numpy()[0, 0], [[5, 7], [13, 15]])
+    y2 = F.avg_pool2d(x, 2)
+    np.testing.assert_allclose(y2.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    y3 = F.adaptive_avg_pool2d(x, 1)
+    np.testing.assert_allclose(y3.numpy()[0, 0], [[7.5]])
+
+
+def test_cross_entropy_matches_torch():
+    import torch
+    import torch.nn.functional as TF
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, size=(6,))
+    out = F.cross_entropy(pt.to_tensor(logits),
+                          pt.to_tensor(labels.astype(np.int32)))
+    ref = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels)).numpy()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_smoothing():
+    import torch
+    import torch.nn.functional as TF
+    logits = np.random.randn(6, 10).astype(np.float32)
+    labels = np.random.randint(0, 10, size=(6,))
+    labels[0] = -100
+    out = F.cross_entropy(pt.to_tensor(logits),
+                          pt.to_tensor(labels.astype(np.int32)),
+                          ignore_index=-100, label_smoothing=0.1)
+    ref = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                           ignore_index=-100, label_smoothing=0.1).numpy()
+    np.testing.assert_allclose(float(out), ref, rtol=1e-4)
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p1 = pt.Parameter(np.zeros(3, np.float32))
+    p2 = pt.Parameter(np.zeros(2, np.float32))
+    g1 = pt.to_tensor(np.array([3.0, 0.0, 0.0], np.float32))
+    g2 = pt.to_tensor(np.array([0.0, 4.0], np.float32))
+    out = clip([(p1, g1), (p2, g2)])
+    total = np.sqrt(sum((g.numpy() ** 2).sum() for _, g in out))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_functional_call_under_jit():
+    import jax
+    from paddle_tpu.core.functional import functional_call, params_of
+
+    net = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 1))
+    params = params_of(net)
+
+    @jax.jit
+    def loss_fn(params, x):
+        out = functional_call(net, params, x)
+        return (out ** 2).mean()
+
+    x = pt.randn([5, 3])._data
+    l1 = loss_fn(params, x)
+    grads = jax.grad(loss_fn)(params, x)
+    assert set(grads) == set(params)
+    # eager forward must equal functional forward
+    l2 = float((net(pt.Tensor._wrap(x)) ** 2).mean())
+    np.testing.assert_allclose(float(l1), l2, rtol=1e-5)

@@ -1,0 +1,182 @@
+"""Optimizer + LR scheduler + AMP tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+
+
+def _make_problem():
+    pt.seed(3)
+    net = nn.Linear(4, 1)
+    X = pt.randn([32, 4])
+    w_true = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    Y = pt.to_tensor(X.numpy() @ w_true)
+    return net, X, Y
+
+
+def _train(net, X, Y, opt, steps=150):
+    for _ in range(steps):
+        loss = ((net(X) - Y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(((net(X) - Y) ** 2).mean())
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Adam, dict(learning_rate=0.1)),
+    (optimizer.AdamW, dict(learning_rate=0.1, weight_decay=0.001)),
+    (optimizer.RMSProp, dict(learning_rate=0.05)),
+    (optimizer.Adagrad, dict(learning_rate=0.3)),
+    (optimizer.Adamax, dict(learning_rate=0.1)),
+    (optimizer.Lamb, dict(learning_rate=0.05)),
+])
+def test_optimizers_converge(cls, kw):
+    net, X, Y = _make_problem()
+    opt = cls(parameters=net.parameters(), **kw)
+    final = _train(net, X, Y, opt)
+    assert final < 0.05, f"{cls.__name__} did not converge: {final}"
+
+
+def test_adam_matches_torch_one_step():
+    import torch
+    w0 = np.random.randn(3, 2).astype(np.float32)
+    g = np.random.randn(3, 2).astype(np.float32)
+
+    p = pt.Parameter(w0.copy())
+    p.grad = pt.to_tensor(g)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt.step()
+
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.Adam([tp], lr=0.01, eps=1e-8)
+    tp.grad = torch.tensor(g)
+    topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_adamw_matches_torch_one_step():
+    import torch
+    w0 = np.random.randn(4).astype(np.float32)
+    g = np.random.randn(4).astype(np.float32)
+    p = pt.Parameter(w0.copy())
+    p.grad = pt.to_tensor(g)
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=[p],
+                          weight_decay=0.1)
+    opt.step()
+    tp = torch.nn.Parameter(torch.tensor(w0))
+    topt = torch.optim.AdamW([tp], lr=0.01, weight_decay=0.1)
+    tp.grad = torch.tensor(g)
+    topt.step()
+    np.testing.assert_allclose(p.numpy(), tp.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_functional_apply_gradients_matches_eager():
+    import jax.numpy as jnp
+    w0 = np.random.randn(3, 3).astype(np.float32)
+    g = np.random.randn(3, 3).astype(np.float32)
+
+    p = pt.Parameter(w0.copy())
+    p.grad = pt.to_tensor(g.copy())
+    opt = optimizer.Adam(learning_rate=0.01, parameters=[p])
+    opt.step()
+
+    opt2 = optimizer.Adam(learning_rate=0.01)
+    params = {"w": jnp.asarray(w0)}
+    state = opt2.init_state_pytree(params)
+    new_params, _ = opt2.apply_gradients(params, {"w": jnp.asarray(g)},
+                                         state, step=1)
+    np.testing.assert_allclose(p.numpy(), np.asarray(new_params["w"]),
+                               rtol=1e-6)
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer import lr
+    s = lr.StepDecay(0.1, step_size=10, gamma=0.5)
+    for _ in range(10):
+        s.step()
+    np.testing.assert_allclose(s(), 0.05)
+
+    w = lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    assert w() < 0.02
+    for _ in range(10):
+        w.step()
+    np.testing.assert_allclose(w(), 0.1)
+
+    c = lr.CosineAnnealingDecay(0.1, T_max=100)
+    vals = []
+    for _ in range(100):
+        c.step()
+        vals.append(c())
+    assert vals[-1] < 1e-4 and vals[0] > 0.099
+
+
+def test_optimizer_with_scheduler_and_clip():
+    net, X, Y = _make_problem()
+    sched = optimizer.lr.StepDecay(0.1, step_size=50, gamma=0.5)
+    opt = optimizer.Adam(learning_rate=sched, parameters=net.parameters(),
+                         grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    loss0 = _train(net, X, Y, opt, steps=30)
+    sched.step()
+    assert opt.get_lr() <= 0.1
+
+
+def test_auto_cast_bf16():
+    with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = pt.randn([4, 4])
+        b = pt.randn([4, 4])
+        c = pt.matmul(a, b)
+        assert c.dtype == "bfloat16"
+        # black-list op stays fp32
+        d = pt.exp(pt.randn([4]).astype("bfloat16"))
+        assert d.dtype == "float32"
+    c2 = pt.matmul(a, b)
+    assert c2.dtype == "float32"
+
+
+def test_grad_scaler_fp16_protocol():
+    scaler = pt.amp.GradScaler(init_loss_scaling=8.0,
+                               decr_every_n_nan_or_inf=1)
+    p = pt.Parameter(np.ones(2, np.float32))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    loss = (p * pt.to_tensor([1.0, 1.0])).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == float(loss) * 8.0
+    scaled.backward()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), 1.0 - 0.1 * 1.0, rtol=1e-6)
+    # inf grads are skipped and scale decreases
+    p.clear_grad()
+    p.grad = pt.to_tensor(np.array([np.inf, 1.0], np.float32))
+    before = p.numpy().copy()
+    old_scale = scaler.get_loss_scaling()
+    scaler.step(opt)
+    np.testing.assert_allclose(p.numpy(), before)
+    assert scaler.get_loss_scaling() < old_scale
+
+
+def test_save_load_roundtrip():
+    import tempfile, os
+    net = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 2))
+    opt = optimizer.Adam(parameters=net.parameters())
+    loss = net(pt.randn([2, 3])).sum()
+    loss.backward()
+    opt.step()
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.pdparams")
+        pt.save(net.state_dict(), path)
+        pt.save(opt.state_dict(), os.path.join(d, "opt.pdopt"))
+        loaded = pt.load(path)
+        net2 = nn.Sequential(nn.Linear(3, 4), nn.Tanh(), nn.Linear(4, 2))
+        net2.set_state_dict(loaded)
+        np.testing.assert_allclose(net2[0].weight.numpy(),
+                                   net[0].weight.numpy())
+        opt2 = optimizer.Adam(parameters=net2.parameters())
+        opt2.set_state_dict(pt.load(os.path.join(d, "opt.pdopt")))
+        assert opt2._global_step == 1
