@@ -1,0 +1,100 @@
+"""Benchmark: Llama pretraining MFU on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.md): Llama-3-8B pretraining >= 40% MFU on v5p; on a single
+chip we measure a Llama-proportioned model that fits one chip's HBM and
+report model FLOPs utilisation of the full fwd+bwd+update step.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# bf16 peak FLOP/s per chip by TPU generation
+_PEAK = {
+    "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5": 459e12, "v5p": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return 459e12  # assume v5p (the baseline hardware)
+
+
+def main():
+    import jax
+
+    import paddle_tpu as pp
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # Llama-3-8B-proportioned, scaled to fit one chip with AdamW states
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=4096,
+            rope_theta=500000.0, dtype="bfloat16")
+        batch, seq, iters, warmup = 8, 2048, 10, 3
+    else:  # CI/CPU smoke
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters, warmup = 4, 64, 3, 1
+
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    step = TrainStep(model, opt, remat=on_tpu)
+
+    n_params = sum(int(np.prod(a.shape)) for a in step.params.values())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    batch_dict = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    for _ in range(warmup):
+        step(batch_dict)
+    jax.block_until_ready(step.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(batch_dict)
+    jax.block_until_ready(step.params)
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens = batch * seq
+    # fwd+bwd FLOPs: 6N per token + attention 12*L*s*d per token
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_hidden_layers * seq * cfg.hidden_size
+    mfu = flops_per_token * tokens / dt / _peak_flops(dev)
+    tok_per_sec = tokens / dt
+
+    result = {
+        "metric": "llama_pretrain_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+            "step_time_s": round(dt, 4),
+            "params": n_params,
+            "batch": batch, "seq": seq,
+            "device": getattr(dev, "device_kind", dev.platform),
+            "final_loss": float(loss),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,1 +1,57 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.jit — compiled execution.
+
+Reference parity: python/paddle/jit (@to_static AST rewriting →
+ConcreteProgram → run_program op, jit/dy2static/program_translator.py:305).
+TPU-native: Layers are already functional through
+core.functional.functional_call, so "static mode" is jax.jit over the pure
+form — `to_static(layer_or_fn)` returns a compiled callable with no source
+rewriting, and TrainStep compiles a whole fwd+bwd+update step.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.jit.train_step import TrainStep
+
+__all__ = ["TrainStep", "to_static"]
+
+
+def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
+    """Decorator/function: compile a Layer's forward or a plain function.
+
+    For a Layer, parameters are captured fresh on every call (so eager
+    updates by optimizers stay visible) but the XLA executable is cached by
+    shape/dtype, like the reference's ConcreteProgram cache
+    (jit/dy2static/program_translator.py)."""
+    from paddle_tpu.core.functional import functional_call, params_of
+    from paddle_tpu.nn.layer import Layer
+
+    def wrap(target):
+        if isinstance(target, Layer):
+            jfn = jax.jit(lambda params, *a, **kw: _raw(
+                functional_call(target, params, *a, **kw)))
+
+            def call(*a, **kw):
+                a = tuple(_raw(x) for x in a)
+                kw = {k: _raw(v) for k, v in kw.items()}
+                from paddle_tpu.core.dispatch import wrap_like
+                return wrap_like(jfn(params_of(target), *a, **kw))
+            call.__wrapped__ = target
+            return call
+        jfn = jax.jit(lambda *a, **kw: _raw(target(*a, **kw)))
+
+        def call(*a, **kw):
+            from paddle_tpu.core.dispatch import wrap_like
+            a = tuple(_raw(x) for x in a)
+            kw = {k: _raw(v) for k, v in kw.items()}
+            return wrap_like(jfn(*a, **kw))
+        call.__wrapped__ = target
+        return call
+
+    def _raw(x):
+        return x._data if hasattr(x, "_data") else x
+
+    if obj is None:
+        return wrap
+    return wrap(obj)

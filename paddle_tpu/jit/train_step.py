@@ -1,0 +1,166 @@
+"""TrainStep — the compiled training step.
+
+TPU-native replacement for the reference's static-graph path: where Paddle
+builds a ProgramDesc and runs it on InterpreterCore
+(python/paddle/fluid/executor.py:1241 → new_executor/interpretercore.cc:188),
+here the whole train step (forward + backward + optimizer update) is ONE
+jitted pure function over (params, opt_state, batch) pytrees.  XLA is the
+interpreter, scheduler, and memory planner.
+
+Supports single-chip jit and sharded pjit: pass `mesh` + `param_specs` and
+every pytree is placed with NamedSharding; XLA/GSPMD inserts the collectives
+(grad psum for DP, mp allreduce for TP, …).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.functional import functional_call, params_of, \
+    trainable_mask
+
+__all__ = ["TrainStep"]
+
+
+def _loss_of(model, loss_fn, params, batch, rngs):
+    """batch: dict with 'input_ids'/'labels' (LM) or (x, y) tuple routed to
+    loss_fn(model_out, y)."""
+    if loss_fn is None:
+        from paddle_tpu.nn.functional import cross_entropy
+        out = functional_call(model, params, batch["input_ids"], rngs=rngs)
+        logits = out._data if hasattr(out, "_data") else out
+        v = logits.shape[-1]
+        loss = cross_entropy(logits.reshape((-1, v)),
+                             batch["labels"].reshape((-1,)))
+        return loss._data if hasattr(loss, "_data") else loss
+    x, y = batch
+    out = functional_call(model, params, x, rngs=rngs)
+    loss = loss_fn(out, y)
+    return loss._data if hasattr(loss, "_data") else loss
+
+
+class TrainStep:
+    """Compile model+optimizer into one donated, jitted update.
+
+    step = TrainStep(model, opt)          # or loss_fn=, mesh=, param_specs=
+    loss = step({"input_ids": ids, "labels": labels})
+    step.sync_to_model()                  # write params back into the Layer
+    """
+
+    def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
+                 mesh=None, param_specs: Optional[Dict[str, Any]] = None,
+                 batch_spec=None, compute_dtype=None, seed: int = 0,
+                 remat: bool = False):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        # copy: the step donates its buffers to XLA, and the Layer's own
+        # Parameter arrays must survive donation
+        self.params = {n: a.copy()
+                       for n, a in params_of(model, dtype=compute_dtype).items()}
+        self.opt_state = optimizer.init_state_pytree(self.params)
+        self.step_count = jnp.zeros((), jnp.int32)
+        self._mask = trainable_mask(model)
+        self._key = jax.random.PRNGKey(seed)
+        self._remat = remat
+
+        if mesh is not None and param_specs is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            to_sh = lambda spec: NamedSharding(mesh, spec)
+            self._param_sh = {n: to_sh(param_specs.get(n, P()))
+                              for n in self.params}
+            self.params = {n: jax.device_put(a, self._param_sh[n])
+                           for n, a in self.params.items()}
+            # optimizer state inherits its parameter's sharding
+            self.opt_state = {
+                n: jax.tree.map(
+                    lambda a: jax.device_put(a, self._param_sh[n])
+                    if hasattr(a, "shape") and a.shape == self.params[n].shape
+                    else a, st)
+                for n, st in self.opt_state.items()}
+            self._batch_sh = to_sh(batch_spec) if batch_spec is not None \
+                else None
+        else:
+            self._param_sh = self._batch_sh = None
+
+        self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
+
+    def _step_impl(self, params, opt_state, step_count, batch, key, lr):
+        model, opt = self.model, self.optimizer
+
+        def loss_of_trainable(train_params, frozen_params):
+            full = dict(frozen_params)
+            full.update(train_params)
+            f = lambda p: _loss_of(model, self.loss_fn, p, batch,
+                                   {"dropout": key})
+            if self._remat:
+                f = jax.checkpoint(f)
+            return f(full)
+
+        train_p = {n: v for n, v in params.items() if self._mask.get(n)}
+        frozen_p = {n: v for n, v in params.items() if not self._mask.get(n)}
+        loss, grads = jax.value_and_grad(loss_of_trainable)(train_p, frozen_p)
+        step_count = step_count + 1
+        new_train, new_state = opt.apply_gradients(
+            train_p, grads,
+            {n: opt_state[n] for n in train_p}, step_count, lr=lr)
+        new_params = dict(frozen_p)
+        new_params.update(new_train)
+        new_opt_state = dict(opt_state)
+        new_opt_state.update(new_state)
+        return loss, new_params, new_opt_state, step_count
+
+    def __call__(self, batch):
+        if self._batch_sh is not None:
+            batch = jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), self._batch_sh),
+                batch)
+        else:
+            batch = jax.tree.map(jnp.asarray, batch)
+        self._key, sub = jax.random.split(self._key)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.params, self.opt_state, self.step_count = self._jitted(
+            self.params, self.opt_state, self.step_count, batch, sub, lr)
+        if self.optimizer._lr_scheduler is not None:
+            self.optimizer._lr_scheduler.step()
+        return loss
+
+    def sync_to_model(self):
+        state = self.model.state_dict(keep_vars=True)
+        for n, arr in self.params.items():
+            state[n]._set_data(arr.astype(state[n]._data.dtype))
+
+    # checkpointing ----------------------------------------------------------
+    def state_dict(self):
+        import numpy as np
+        out = {"params": jax.tree.map(np.asarray, self.params),
+               "opt_state": jax.tree.map(np.asarray, self.opt_state),
+               "step": int(self.step_count)}
+        if self.optimizer._lr_scheduler is not None:
+            out["lr_scheduler"] = self.optimizer._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        import numpy as np
+        if self._param_sh:
+            put = lambda n, a: jax.device_put(jnp.asarray(a),
+                                              self._param_sh[n])
+            # opt-state leaves shaped like their param share its sharding
+            put_st = lambda n, st: jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), self._param_sh[n])
+                if np.shape(a) == tuple(self.params[n].shape)
+                else jnp.asarray(a), st)
+        else:
+            put = lambda n, a: jnp.asarray(a)
+            put_st = lambda n, st: jax.tree.map(jnp.asarray, st)
+        self.params = {n: put(n, a) for n, a in state["params"].items()}
+        self.opt_state = {n: put_st(n, st)
+                          for n, st in state["opt_state"].items()}
+        self.step_count = jnp.asarray(state["step"], jnp.int32)
+        if "lr_scheduler" in state and \
+                self.optimizer._lr_scheduler is not None:
+            self.optimizer._lr_scheduler.set_state_dict(state["lr_scheduler"])

@@ -1,0 +1,13 @@
+"""paddle_tpu.models — flagship model zoo.
+
+The reference ships torchvision-style models under python/paddle/vision/models
+and LLM recipes live out-of-tree (PaddleNLP); here the LLM family is in-tree
+because it is the benchmark flagship (BASELINE.md: Llama-3-8B pretraining).
+"""
+
+from paddle_tpu.models.llama import (LlamaAttention, LlamaConfig,
+                                     LlamaDecoderLayer, LlamaForCausalLM,
+                                     LlamaMLP, LlamaModel)
+
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM"]

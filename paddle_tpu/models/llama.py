@@ -1,0 +1,272 @@
+"""Llama-family decoder-only transformer — the flagship pretraining model.
+
+The reference has no in-tree Llama; its LLM recipe is the fleet 4-D hybrid
+stack applied to transformer blocks (SURVEY.md §3.3) built from
+ColumnParallelLinear / RowParallelLinear (fleet/layers/mpu/mp_layers.py:173,343)
+and fused attention ops.  Here the model is a plain nn.Layer stack whose
+parallelism comes from GSPMD sharding annotations (`partition_specs`), not
+parallel-layer classes: under pjit, XLA inserts the same collectives the
+reference issues by hand (mp_allreduce after row-parallel matmul, etc.).
+
+TPU-native choices:
+  * [batch, seq, heads, head_dim] layout; QKV as single wide matmuls (MXU).
+  * fp32 RoPE + fp32 softmax accumulation inside bf16 training.
+  * GQA via jnp broadcast-repeat of KV heads (free under XLA fusion).
+  * weights stay [in, out] so tp sharding is a PartitionSpec on one axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common_layers import Embedding, Linear
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.norm_layers import RMSNorm
+from paddle_tpu.ops import manipulation as M
+
+__all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
+           "LlamaModel", "LlamaForCausalLM"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # None → MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            rope_theta=500000.0, dtype="bfloat16")
+
+    @staticmethod
+    def tiny(**over):
+        cfg = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128)
+        cfg.update(over)
+        return LlamaConfig(**cfg)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.num_kv_heads = c.num_key_value_heads
+        self.head_dim = c.head_dim
+        self.q_proj = Linear(c.hidden_size, self.num_heads * self.head_dim,
+                             bias_attr=False)
+        self.k_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             bias_attr=False)
+        self.v_proj = Linear(c.hidden_size, self.num_kv_heads * self.head_dim,
+                             bias_attr=False)
+        self.o_proj = Linear(self.num_heads * self.head_dim, c.hidden_size,
+                             bias_attr=False)
+
+    def forward(self, x, rope_cos, rope_sin, attn_mask=None, cache=None,
+                position_offset=0):
+        b, s = x.shape[0], x.shape[1]
+        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        q = F.apply_rotary_emb(q, rope_cos, rope_sin, position_offset)
+        k = F.apply_rotary_emb(k, rope_cos, rope_sin, position_offset)
+        new_cache = None
+        if cache is not None:
+            pk, pv = cache
+            k = M.concat([pk, k], axis=1)
+            v = M.concat([pv, v], axis=1)
+            new_cache = (k, v)
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = M.repeat_interleave(k, rep, axis=2)
+            v = M.repeat_interleave(v, rep, axis=2)
+        # is_causal stays on for cached prefill too: the tril mask in sdpa
+        # offsets by sk-sq, so a multi-token query over past KV is causal
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=(attn_mask is None))
+        out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.gate_proj = Linear(c.hidden_size, c.intermediate_size,
+                                bias_attr=False)
+        self.up_proj = Linear(c.hidden_size, c.intermediate_size,
+                              bias_attr=False)
+        self.down_proj = Linear(c.intermediate_size, c.hidden_size,
+                                bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.input_layernorm = RMSNorm(config.hidden_size,
+                                       epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = RMSNorm(config.hidden_size,
+                                                epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, rope_cos, rope_sin, attn_mask=None, cache=None,
+                position_offset=0):
+        h = self.self_attn(self.input_layernorm(x), rope_cos, rope_sin,
+                           attn_mask, cache, position_offset)
+        new_cache = None
+        if cache is not None:
+            h, new_cache = h
+        x = x + h
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.layers = []
+        for i in range(config.num_hidden_layers):
+            layer = LlamaDecoderLayer(config)
+            self.add_sublayer(f"layers_{i}", layer)
+            self.layers.append(layer)
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        cos, sin = F.rotary_freqs(config.head_dim,
+                                  config.max_position_embeddings,
+                                  base=config.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+        if config.dtype != "float32":
+            self.astype(config.dtype)
+            # RoPE tables stay fp32 (applied in fp32 regardless)
+            self.rope_cos._set_data(cos)
+            self.rope_sin._set_data(sin)
+
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        x = self.embed_tokens(input_ids)
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            x = layer(x, self.rope_cos, self.rope_sin, attn_mask, cache,
+                      position_offset)
+            if caches is not None:
+                x, c = x
+                new_caches.append(c)
+        x = self.norm(x)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.model = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        h = self.model(input_ids, attn_mask, caches, position_offset)
+        new_caches = None
+        if caches is not None:
+            h, new_caches = h
+        if self.lm_head is None:
+            from paddle_tpu.ops import linalg as L
+            logits = L.matmul(h, self.model.embed_tokens.weight,
+                              transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def loss(self, input_ids, labels):
+        """Next-token cross-entropy (fp32 logits path inside)."""
+        logits = self(input_ids)
+        v = logits.shape[-1]
+        return F.cross_entropy(M.reshape(logits, [-1, v]),
+                               M.reshape(labels, [-1]))
+
+    # -- GSPMD sharding rules -------------------------------------------------
+    @staticmethod
+    def partition_specs(config: LlamaConfig, dp_axis="dp", tp_axis="tp",
+                        fsdp_axis=None):
+        """{state_dict name pattern → PartitionSpec} for a (dp, tp) mesh.
+
+        Megatron mapping expressed as shardings (the reference does this with
+        ColumnParallelLinear/RowParallelLinear classes,
+        fleet/layers/mpu/mp_layers.py:173,343): q/k/v/gate/up are
+        column-parallel (shard the output dim on tp), o/down are row-parallel
+        (shard the input dim), embedding + lm_head shard the vocab dim.
+        fsdp_axis additionally shards the other weight axis (ZeRO-3 at rest).
+        """
+        from jax.sharding import PartitionSpec as P
+        col = P(fsdp_axis, tp_axis)     # [in, out] weight, shard out
+        row = P(tp_axis, fsdp_axis)     # [in, out] weight, shard in
+        rules = {
+            "model.embed_tokens.weight": P(tp_axis, fsdp_axis),
+            "lm_head.weight": col,
+            ".q_proj.weight": col,
+            ".k_proj.weight": col,
+            ".v_proj.weight": col,
+            ".o_proj.weight": row,
+            ".gate_proj.weight": col,
+            ".up_proj.weight": col,
+            ".down_proj.weight": row,
+            "norm.weight": P(),
+            "layernorm.weight": P(),
+            # rope tables are non-persistable buffers: they never appear in
+            # state_dict/params — they are baked into the jaxpr as constants
+        }
+        return rules
+
+    @staticmethod
+    def spec_for(name, rules):
+        from jax.sharding import PartitionSpec as P
+        for pat, spec in rules.items():
+            if name.endswith(pat) or pat in name:
+                return spec
+        return P()

@@ -105,5 +105,39 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         "flash_attn_unpadded: use dense attention with attn_mask for now")
 
 
+def rotary_freqs(head_dim, max_position, base=10000.0, dtype=jnp.float32):
+    """Precompute RoPE cos/sin tables, each [max_position, head_dim//2]."""
+    inv = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                          / head_dim))
+    t = jnp.arange(max_position, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+@eager_op
+def apply_rotary_emb(x, cos, sin, position_offset=0):
+    """Rotary position embedding, Llama/NeoX half-rotation convention.
+
+    x: [batch, seq, heads, head_dim]; cos/sin: [max_pos, head_dim//2] tables
+    from rotary_freqs.  position_offset shifts positions (decode w/ KV cache).
+    Computed in fp32 then cast back (TPU bf16 numerics practice).
+    """
+    seq = x.shape[1]
+    if isinstance(position_offset, int) and position_offset + seq > cos.shape[0]:
+        raise ValueError(
+            f"RoPE table overflow: positions [{position_offset}, "
+            f"{position_offset + seq}) exceed table length {cos.shape[0]} "
+            f"(max_position_embeddings)")
+    cos = jax.lax.dynamic_slice_in_dim(cos, position_offset, seq, 0)
+    sin = jax.lax.dynamic_slice_in_dim(sin, position_offset, seq, 0)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    half = x.shape[-1] // 2
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flash_attn_unpadded"]
+           "flash_attn_unpadded", "rotary_freqs", "apply_rotary_emb"]

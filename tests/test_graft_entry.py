@@ -1,0 +1,28 @@
+"""Driver-contract coverage: entry() compiles, dryrun_multichip shards the
+full train step over an 8-device mesh (conftest forces the virtual CPU mesh)."""
+
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+
+def test_entry_compiles():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 1024
+    assert np.isfinite(np.asarray(out).sum())
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_1():
+    import __graft_entry__ as g
+    g.dryrun_multichip(1)

@@ -1,0 +1,188 @@
+"""Stage-3 milestone: single-chip E2E training of the flagship Llama stack
+through the jitted TrainStep (SURVEY.md §7 step 3)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.jit import TrainStep, to_static
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _batch(cfg, batch=4, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def test_llama_forward_shapes():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = pp.to_tensor(np.zeros((2, 8), np.int32))
+    logits = model(ids)
+    assert tuple(logits.shape) == (2, 8, cfg.vocab_size)
+
+
+def test_llama_gqa_and_tied():
+    cfg = LlamaConfig.tiny(num_key_value_heads=1, tie_word_embeddings=True)
+    model = LlamaForCausalLM(cfg)
+    ids = pp.to_tensor(np.zeros((2, 8), np.int32))
+    logits = model(ids)
+    assert tuple(logits.shape) == (2, 8, cfg.vocab_size)
+    assert model.lm_head is None
+
+
+def test_llama_kv_cache_matches_full_forward():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, (1, 6))
+    full = model(pp.to_tensor(ids)).numpy()
+
+    import jax.numpy as jnp
+    caches = [(jnp.zeros((1, 0, cfg.num_key_value_heads, cfg.head_dim)),
+               jnp.zeros((1, 0, cfg.num_key_value_heads, cfg.head_dim)))
+              for _ in range(cfg.num_hidden_layers)]
+    outs = []
+    for t in range(6):
+        logits, caches = model(pp.to_tensor(ids[:, t:t + 1]), caches=caches,
+                               position_offset=t)
+        outs.append(logits.numpy())
+    step = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(full, step, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_prefill_then_decode_matches_full_forward():
+    """Prefill (multi-token query over cache) must stay causal."""
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, (1, 8))
+    full = model(pp.to_tensor(ids)).numpy()
+
+    import jax.numpy as jnp
+    caches = [(jnp.zeros((1, 0, cfg.num_key_value_heads, cfg.head_dim)),
+               jnp.zeros((1, 0, cfg.num_key_value_heads, cfg.head_dim)))
+              for _ in range(cfg.num_hidden_layers)]
+    prefill, caches = model(pp.to_tensor(ids[:, :5]), caches=caches)
+    np.testing.assert_allclose(full[:, :5], prefill.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    step1, caches = model(pp.to_tensor(ids[:, 5:6]), caches=caches,
+                          position_offset=5)
+    np.testing.assert_allclose(full[:, 5:6], step1.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rope_table_overflow_raises():
+    cfg = LlamaConfig.tiny(max_position_embeddings=16)
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((1, 32), np.int32)
+    with pytest.raises(ValueError, match="RoPE table overflow"):
+        model(pp.to_tensor(ids))
+
+
+def test_train_step_scheduler_checkpoint_roundtrip():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    sched = pp.optimizer.lr.StepDecay(learning_rate=1e-2, step_size=2,
+                                      gamma=0.5)
+    opt = pp.optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = _batch(cfg)
+    for _ in range(3):
+        step(batch)
+    snap = step.state_dict()
+    lr_before = opt.get_lr()
+
+    model2 = LlamaForCausalLM(cfg)
+    sched2 = pp.optimizer.lr.StepDecay(learning_rate=1e-2, step_size=2,
+                                       gamma=0.5)
+    opt2 = pp.optimizer.SGD(learning_rate=sched2,
+                            parameters=model2.parameters())
+    step2 = TrainStep(model2, opt2)
+    step2.set_state_dict(snap)
+    assert opt2.get_lr() == lr_before
+
+
+def test_train_step_loss_decreases():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = _batch(cfg)
+    losses = [float(step(batch)) for _ in range(12)]
+    assert losses[-1] < losses[0] * 0.8, losses
+    # params written back into the Layer
+    before = model.state_dict(keep_vars=True)[
+        "model.embed_tokens.weight"].numpy().copy()
+    step.sync_to_model()
+    after = model.state_dict(keep_vars=True)[
+        "model.embed_tokens.weight"].numpy()
+    assert not np.allclose(before, after)
+
+
+def test_train_step_lr_schedule_applied():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    sched = pp.optimizer.lr.StepDecay(learning_rate=1e-2, step_size=1,
+                                      gamma=0.0)  # lr → 0 after first step
+    opt = pp.optimizer.SGD(learning_rate=sched, parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = _batch(cfg)
+    step(batch)
+    p1 = {n: np.asarray(a) for n, a in step.params.items()}
+    step(batch)  # lr == 0 now: nothing may move
+    for n, a in step.params.items():
+        np.testing.assert_allclose(np.asarray(a), p1[n], rtol=0, atol=0)
+
+
+def test_train_step_remat():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.SGD(learning_rate=1e-2, parameters=model.parameters())
+    step = TrainStep(model, opt, remat=True)
+    assert np.isfinite(float(step(_batch(cfg))))
+
+
+def test_train_step_checkpoint_roundtrip():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = _batch(cfg)
+    step(batch)
+    snap = step.state_dict()
+    l1 = float(step(batch))
+
+    model2 = LlamaForCausalLM(cfg)
+    opt2 = pp.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model2.parameters())
+    step2 = TrainStep(model2, opt2)
+    step2.set_state_dict(snap)
+    l2 = float(step2(batch))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_to_static_layer():
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    ids = np.zeros((2, 8), np.int32)
+    eager = model(pp.to_tensor(ids)).numpy()
+    compiled = to_static(model)
+    static = compiled(pp.to_tensor(ids)).numpy()
+    np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-5)
+
+
+def test_bf16_model_trains():
+    cfg = LlamaConfig.tiny(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    step = TrainStep(model, opt)
+    losses = [float(step(_batch(cfg))) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
