@@ -163,7 +163,8 @@ class Tensor:
     """Paddle-flavoured eager tensor wrapping an immutable jax.Array."""
 
     __slots__ = ("_data", "stop_gradient", "_grad", "_grad_node", "_out_index",
-                 "_version", "name", "persistable", "_retain_grads", "__weakref__")
+                 "_version", "name", "persistable", "_retain_grads",
+                 "partition_spec", "__weakref__")
 
     # let Tensor win in  np_array op tensor  reflected dispatch
     __array_priority__ = 100

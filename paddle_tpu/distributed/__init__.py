@@ -1,1 +1,42 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.distributed — TPU-native distributed training.
+
+Replaces the reference's distributed stack (python/paddle/distributed/:
+ProcessGroups+NCCL, fleet 4-D hybrid parallel, auto_parallel planner) with
+ONE substrate: a ``jax.sharding.Mesh`` + GSPMD sharding annotations + XLA
+compiler-scheduled collectives over ICI/DCN.  See SURVEY.md §2.4/§2.5 for
+the strategy-by-strategy mapping.
+"""
+
+from paddle_tpu.distributed.env import (  # noqa: F401
+    ParallelEnv, device_count, get_rank, get_world_size, init_parallel_env,
+    is_initialized)
+from paddle_tpu.distributed.communication import (  # noqa: F401
+    Group, ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
+    get_group, new_group, ppermute, recv, reduce, reduce_scatter, scatter,
+    send, shift)
+from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
+    Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn, get_mesh,
+    reshard, set_mesh, shard_layer, shard_op, shard_tensor)
+from paddle_tpu.distributed.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup)
+from paddle_tpu.distributed.parallel import DataParallel  # noqa: F401
+from paddle_tpu.distributed.sharding import (  # noqa: F401
+    ShardingPlan, group_sharded_parallel, shard_plan)
+from paddle_tpu.distributed import fleet as _fleet_mod  # noqa: F401
+from paddle_tpu.distributed.fleet import (  # noqa: F401
+    DistributedStrategy, fleet)
+from paddle_tpu.distributed import mpu  # noqa: F401
+
+__all__ = [
+    "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
+    "device_count", "is_initialized",
+    "ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+    "all_gather", "all_to_all", "reduce_scatter", "broadcast", "reduce",
+    "scatter", "send", "recv", "barrier", "ppermute", "shift",
+    "ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+    "reshard", "shard_layer", "shard_op", "dtensor_from_fn", "get_mesh",
+    "set_mesh",
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "DataParallel", "group_sharded_parallel", "shard_plan", "ShardingPlan",
+    "fleet", "DistributedStrategy", "mpu",
+]

@@ -1,0 +1,255 @@
+"""Auto-parallel annotation API: ProcessMesh + shard_tensor + placements.
+
+Reference parity: ``paddle.distributed.auto_parallel`` — ``ProcessMesh``
+(auto_parallel/process_mesh.py), ``shard_tensor``/``shard_op`` annotation
+(auto_parallel/interface.py), and behind them the Completer/Partitioner/
+Resharder machinery (static/completion.py:107, partitioner.py:40,
+reshard.py:1010) that propagates dist attrs and inserts comm ops.
+
+TPU-native design: that entire planning pipeline IS GSPMD.  ``ProcessMesh``
+wraps ``jax.sharding.Mesh``; ``shard_tensor`` attaches a ``NamedSharding``;
+propagation, partitioning, and resharding happen inside XLA during ``jit``
+compilation.  ``reshard`` is ``jax.device_put`` with a new sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "shard_layer", "dtensor_from_fn", "get_mesh",
+           "set_mesh", "shard_op"]
+
+
+# -- placements (reference: paddle.distributed.{Shard,Replicate,Partial}) ----
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    """Shard tensor dim `dim` across the corresponding mesh axis."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def is_replicated(self):
+        return True
+
+
+class Partial(Placement):
+    """Pending-reduction placement.  GSPMD tracks partial sums internally;
+    at the annotation API level we accept it and treat it as Replicate
+    (the compiler decides when to materialise the reduction)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """Cartesian mesh of devices with named axes.
+
+    Reference: ``paddle.distributed.ProcessMesh(mesh, dim_names)``
+    (auto_parallel/process_mesh.py).  Wraps ``jax.sharding.Mesh`` — the
+    object GSPMD plans over."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None, _devices=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        assert arr.ndim == len(dim_names)
+        self._shape = arr.shape
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._jax_mesh = None
+        self._devices = _devices
+
+    # reference-parity properties
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, name: str) -> int:
+        return self._shape[self._dim_names.index(name)]
+
+    def get_rank_by_dim_and_process_id(self, name, pid):
+        coord = np.argwhere(
+            np.asarray(self._process_ids).reshape(self._shape) == pid)
+        return int(coord[0][self._dim_names.index(name)])
+
+    # jax bridge
+    @property
+    def jax_mesh(self):
+        if self._jax_mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            devs = self._devices
+            if devs is None:
+                all_devs = jax.devices()
+                devs = [all_devs[i] for i in self._process_ids]
+            self._jax_mesh = Mesh(
+                np.asarray(devs).reshape(self._shape), self._dim_names)
+        return self._jax_mesh
+
+    def __enter__(self):
+        set_mesh(self)
+        return self
+
+    def __exit__(self, *exc):
+        set_mesh(None)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+_CURRENT_MESH: List[Optional[ProcessMesh]] = [None]
+
+
+def set_mesh(mesh: Optional[ProcessMesh]):
+    _CURRENT_MESH[0] = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _CURRENT_MESH[0]
+
+
+def _placements_to_spec(placements: Sequence[Placement], ndim: int,
+                        dim_names: List[str]):
+    """[Placement per mesh axis] → PartitionSpec over tensor dims."""
+    from jax.sharding import PartitionSpec as P
+    per_dim: List[Any] = [None] * ndim
+    for axis_i, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.dim if pl.dim >= 0 else pl.dim + ndim
+            if per_dim[d] is None:
+                per_dim[d] = dim_names[axis_i]
+            elif isinstance(per_dim[d], tuple):
+                per_dim[d] = per_dim[d] + (dim_names[axis_i],)
+            else:
+                per_dim[d] = (per_dim[d], dim_names[axis_i])
+    return P(*per_dim)
+
+
+def shard_tensor(tensor, mesh: ProcessMesh,
+                 placements: Sequence[Placement],
+                 stop_gradient: Optional[bool] = None):
+    """Place `tensor` on `mesh` with `placements` (one per mesh dim).
+
+    Reference: ``paddle.distributed.shard_tensor``
+    (auto_parallel/interface.py).  Returns the same Tensor type with its
+    array device_put under the induced NamedSharding — downstream jit'd
+    computation inherits the sharding and GSPMD propagates it."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    data = tensor._data if hasattr(tensor, "_data") else tensor
+    spec = _placements_to_spec(placements, data.ndim, mesh.dim_names)
+    sharded = jax.device_put(data, NamedSharding(mesh.jax_mesh, spec))
+    if hasattr(tensor, "_data"):
+        from paddle_tpu.core.tensor import Tensor
+        out = Tensor(sharded)
+        if stop_gradient is not None:
+            out.stop_gradient = stop_gradient
+        else:
+            out.stop_gradient = tensor.stop_gradient
+        return out
+    return sharded
+
+
+def reshard(tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Change a tensor's distribution — reference Resharder
+    (static/reshard.py:1010) inserted all_gather/all_to_all/slice ops;
+    here ``jax.device_put`` with the new sharding compiles to the same
+    collectives."""
+    return shard_tensor(tensor, mesh, placements)
+
+
+def shard_layer(layer, mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard every parameter of `layer` via `shard_fn(name, layer, mesh)`;
+    default fully replicates (reference: paddle.distributed.shard_layer)."""
+    for name, param in layer.named_parameters():
+        if shard_fn is not None:
+            placements = shard_fn(name, layer, mesh)
+        else:
+            placements = [Replicate() for _ in range(mesh.ndim)]
+        if placements is not None:
+            sharded = shard_tensor(param, mesh, placements)
+            param._set_data(sharded._data)
+    return layer
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    """Build a sharded tensor from a creation fn without materialising the
+    replicated intermediate (reference: dtensor_from_fn)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sample = jax.eval_shape(lambda: fn(*args, **kwargs)._data
+                            if hasattr(fn(*args, **kwargs), "_data")
+                            else fn(*args, **kwargs))
+    spec = _placements_to_spec(placements, len(sample.shape),
+                               mesh.dim_names)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    make = lambda: fn(*args, **kwargs)
+    out = jax.jit(lambda: make()._data if hasattr(make(), "_data")
+                  else make(), out_shardings=sharding)()
+    from paddle_tpu.core.tensor import Tensor
+    return Tensor(out)
+
+
+def shard_op(op_fn, mesh: ProcessMesh = None, in_placements=None,
+             out_placements=None):
+    """Annotate an op's output sharding (reference: shard_op).  Under GSPMD
+    this is `with_sharding_constraint` on the result."""
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if mesh is not None and out_placements is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            data = out._data if hasattr(out, "_data") else out
+            spec = _placements_to_spec(out_placements, data.ndim,
+                                       mesh.dim_names)
+            data = jax.lax.with_sharding_constraint(
+                data, NamedSharding(mesh.jax_mesh, spec))
+            if hasattr(out, "_data"):
+                from paddle_tpu.core.tensor import Tensor
+                return Tensor(data)
+            return data
+        return out
+    return wrapped

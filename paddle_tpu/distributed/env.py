@@ -1,0 +1,110 @@
+"""Process/rank environment — the TPU-native analog of the reference's
+``init_parallel_env`` bootstrap (python/paddle/distributed/parallel.py:915:
+env parsing → TCPStore → ProcessGroup creation).
+
+On TPU there is no ProcessGroup runtime to build: JAX is single-controller
+SPMD, collectives are compiled into the executable and ride ICI.  What remains
+of the reference's bootstrap is (a) multi-host rendezvous —
+``jax.distributed.initialize`` plays the TCPStore role — and (b) a rank/world
+facade (``ParallelEnv``) so fleet-style code keeps working.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "device_count", "is_initialized",
+]
+
+_INITIALIZED = False
+
+
+def is_initialized() -> bool:
+    return _INITIALIZED
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Bootstrap multi-host execution.
+
+    Reference parity: ``paddle.distributed.init_parallel_env``
+    (python/paddle/distributed/parallel.py:915).  There the per-rank process
+    parses ``PADDLE_TRAINER_ID``/``PADDLE_CURRENT_ENDPOINT`` and creates a
+    TCPStore + NCCL ProcessGroup.  Here each *host* process calls
+    ``jax.distributed.initialize`` (rendezvous at ``coordinator_address``),
+    after which ``jax.devices()`` spans every chip in the slice and compiled
+    collectives handle all cross-chip traffic.
+
+    Single-process (1 host, N local devices) needs no initialization at all;
+    this function is then a no-op and only records state.
+    """
+    global _INITIALIZED
+    import jax
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PADDLE_MASTER",
+                                             os.environ.get(
+                                                 "COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        n = os.environ.get("PADDLE_TRAINERS_NUM",
+                           os.environ.get("NUM_PROCESSES"))
+        num_processes = int(n) if n else None
+    if process_id is None:
+        r = os.environ.get("PADDLE_TRAINER_ID", os.environ.get("PROCESS_ID"))
+        process_id = int(r) if r else None
+
+    if coordinator_address and (num_processes or 0) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    _INITIALIZED = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    """Host-process index (reference: ``paddle.distributed.get_rank``)."""
+    import jax
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of host processes (reference: ``get_world_size``).
+
+    Note the unit: the reference counts one rank per GPU; under JAX one
+    process drives many chips, so device-level parallelism is
+    ``device_count()`` and world_size is the process count."""
+    import jax
+    return jax.process_count()
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """Rank/world facade, parity with ``paddle.distributed.ParallelEnv``."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return 0
+
+    @property
+    def dev_id(self) -> int:
+        return 0

@@ -1,0 +1,12 @@
+"""python -m paddle_tpu.distributed.launch — multi-host launcher.
+
+Reference parity: ``python -m paddle.distributed.launch``
+(launch/main.py → controllers/collective.py): builds a Pod of per-GPU worker
+processes with PADDLE_TRAINER_ID / endpoints env, HTTP/etcd rendezvous.
+
+TPU-native: one process per HOST (not per chip) — each process calls
+``jax.distributed.initialize`` against the coordinator and drives all local
+chips; emulation mode (``--nproc_per_node`` on one machine) spawns N
+processes that each see a slice of CPU devices for testing multi-process
+code paths.
+"""

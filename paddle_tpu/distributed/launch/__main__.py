@@ -1,0 +1,3 @@
+from paddle_tpu.distributed.launch.main import main
+
+main()

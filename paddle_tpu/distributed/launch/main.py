@@ -1,0 +1,110 @@
+"""Launcher entry: ``python -m paddle_tpu.distributed.launch [opts] script.py``.
+
+Reference parity: launch/main.py → ``Context`` + ``CollectiveController``
+(launch/controllers/collective.py) spawning a local Pod of per-device worker
+processes with PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT env and an
+HTTP/etcd master for rendezvous (controllers/master.py:65,175).
+
+TPU-native: ``--nnodes`` hosts each run ONE process driving all local chips;
+rendezvous is ``jax.distributed.initialize`` against ``--master``.  For
+single-machine testing, ``--nproc_per_node N`` spawns N processes with a
+shared local coordinator (the reference's N-procs-on-one-host test pattern,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+__all__ = ["main"]
+
+
+def _parse():
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-host/process launcher")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (rendezvous)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this host's index (default: from env or 0)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="local processes to spawn (testing/emulation)")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-rank logs to <log_dir>/workerlog.N")
+    p.add_argument("--devices", default=None,
+                   help="ignored on TPU (chips are slice-assigned); parity")
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def main():
+    args = _parse()
+    nproc = args.nproc_per_node
+
+    if nproc <= 1 and args.nnodes <= 1:
+        # degenerate: exec in place
+        os.execv(sys.executable, [sys.executable, args.script,
+                                  *args.script_args])
+
+    master = args.master or "127.0.0.1:12355"
+    total = args.nnodes * nproc
+    node_rank = args.rank
+    if node_rank is None:
+        node_rank = int(os.environ.get("PADDLE_NODE_RANK", "0"))
+
+    procs = []
+    log_files = []
+    for local_rank in range(nproc):
+        rank = node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_MASTER": master,
+            "COORDINATOR_ADDRESS": master,
+            "PADDLE_TRAINERS_NUM": str(total),
+            "NUM_PROCESSES": str(total),
+            "PADDLE_TRAINER_ID": str(rank),
+            "PROCESS_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+        })
+        stdout = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            stdout = open(os.path.join(args.log_dir,
+                                       f"workerlog.{rank}"), "w")
+            log_files.append(stdout)
+        procs.append(subprocess.Popen(
+            [sys.executable, args.script, *args.script_args],
+            env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None))
+
+    def _kill(signum, frame):
+        for p in procs:
+            p.terminate()
+    signal.signal(signal.SIGTERM, _kill)
+    signal.signal(signal.SIGINT, _kill)
+
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+            if p.returncode not in (0, None):
+                # fail fast like the reference watcher: kill the pod
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+    finally:
+        for f in log_files:
+            f.close()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,221 @@
+"""Model-parallel (tensor-parallel) layers + TP-aware RNG.
+
+Reference parity: ``fleet/layers/mpu/mp_layers.py`` — ``VocabParallelEmbedding``
+(:35), ``ColumnParallelLinear`` (:173), ``RowParallelLinear`` (:343),
+``ParallelCrossEntropy`` (:524 → c_softmax_with_cross_entropy op) — plus the
+RNG tracker (``mpu/random.py:34,88``).
+
+TPU-native design: the reference manually slices weights per rank and calls
+``c_identity``/``mp_allreduce`` collectives (mpu/mp_ops.py).  Here each layer
+keeps the FULL logical weight and records a **PartitionSpec** on it
+(``weight.partition_spec``); under ``jit`` over a mesh, GSPMD shards the
+weight and inserts exactly the Megatron collectives (allreduce after
+row-parallel, none after column-parallel) — compiler-scheduled over ICI.
+The math is identical to the serial layer, which is what makes
+parallel==serial parity tests trivial and is the entire point of SPMD.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "RNGStatesTracker", "get_rng_state_tracker", "constrain"]
+
+MP_AXIS = "mp"
+
+
+def _spec(*names):
+    from jax.sharding import PartitionSpec as P
+    return P(*names)
+
+
+def constrain(x, spec, mesh=None):
+    """with_sharding_constraint when a mesh is active; identity otherwise.
+    The activation-sharding hints GSPMD uses in place of the reference's
+    explicit c_identity/allreduce calls."""
+    data = x._data if hasattr(x, "_data") else x
+    try:
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            data = jax.lax.with_sharding_constraint(
+                data, NamedSharding(mesh, spec))
+        else:
+            data = jax.lax.with_sharding_constraint(data, spec)
+    except (ValueError, RuntimeError):
+        return x  # no mesh in scope — serial run
+    if hasattr(x, "_data"):
+        from paddle_tpu.core.tensor import Tensor
+        out = Tensor(data)
+        out.stop_gradient = x.stop_gradient
+        return out
+    return data
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded on the mp axis
+    (reference mp_layers.py:35: per-rank vocab range + masked lookup +
+    allreduce; here: weight sharded P("mp", None), GSPMD turns the gather
+    into the same masked-lookup + psum)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, mp_axis: str = MP_AXIS, name=None):
+        super().__init__()
+        self.mp_axis = mp_axis
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None
+            else None)
+        self.weight.partition_spec = _spec(mp_axis, None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with output dim sharded on mp (Megatron column-parallel;
+    reference mp_layers.py:173).  gather_output=True adds a constraint that
+    forces GSPMD to all_gather the activation back to replicated."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, mp_group=None,
+                 mp_axis: str = MP_AXIS, fuse_matmul_bias=False, name=None):
+        super().__init__()
+        self.mp_axis = mp_axis
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        self.weight.partition_spec = _spec(None, mp_axis)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.partition_spec = _spec(mp_axis)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = constrain(out, _spec())  # replicated
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with input dim sharded on mp (Megatron row-parallel; reference
+    mp_layers.py:343).  The partial matmul results need a sum over mp —
+    GSPMD inserts the psum the reference issues as mp_allreduce."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, mp_group=None,
+                 mp_axis: str = MP_AXIS, fuse_matmul_bias=False, name=None):
+        super().__init__()
+        self.mp_axis = mp_axis
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr)
+        self.weight.partition_spec = _spec(mp_axis, None)
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            self.bias.partition_spec = _spec()  # replicated: added post-psum
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over vocab-sharded logits (reference mp_layers.py:524 →
+    ``c_softmax_with_cross_entropy``: per-rank max/sum exchanged by
+    allreduce).  Under GSPMD the standard logsumexp-based CE on sharded
+    logits compiles to the same two small psums — no custom kernel needed;
+    we add the constraint that keeps logits sharded on vocab so the
+    compiler doesn't materialise a replicated [tokens, vocab] buffer."""
+
+    def __init__(self, mp_group=None, mp_axis: str = MP_AXIS, name=None,
+                 ignore_index: int = -100):
+        super().__init__()
+        self.mp_axis = mp_axis
+        self.ignore_index = ignore_index
+
+    def forward(self, logits, label):
+        logits = constrain(logits, _spec(None, self.mp_axis))
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# -- TP-aware RNG (reference mpu/random.py) ----------------------------------
+
+class RNGStatesTracker:
+    """Named RNG streams so dropout inside TP regions can draw either a
+    mp-local or a global pattern (reference ``RNGStatesTracker``
+    mpu/random.py:34: CUDA rng state save/restore; here: named PRNG keys —
+    functional, trace-safe)."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = [jax.random.key(seed), 0]
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = states
+
+    def next_key(self, name: str):
+        entry = self.states_[name]
+        key = jax.random.fold_in(entry[0], entry[1])
+        entry[1] += 1
+        return key
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "model_parallel_rng"):
+        """Run the body with this named stream driving paddle_tpu's global
+        RNG (used by Dropout in mp regions, reference mp_layers usage)."""
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        import paddle_tpu.core.state as state
+        key = self.next_key(name)
+        old = state.get_rng_state()
+        state.set_rng_state(jax.random.key_data(key))
+        try:
+            yield
+        finally:
+            state.set_rng_state(old)
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """Reference: ``fleet.meta_parallel.get_rng_state_tracker``
+    (mpu/random.py:84)."""
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2023, mp_rank: int = 0):
+    """Seed the tracker with (global, local=global+offset) streams
+    (reference mpu/random.py:88)."""
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", seed)
+    _TRACKER.add("model_parallel_rng", seed + 1024 + mp_rank)

@@ -1,0 +1,60 @@
+"""DataParallel facade.
+
+Reference parity: ``paddle.DataParallel`` (distributed/parallel.py) — wraps a
+Layer, and a C++ ``Reducer`` (fluid/imperative/reducer.cc, reducer.h:129)
+buckets gradients into ~25MB groups and allreduces them asynchronously as
+backward produces them; ``no_sync`` suppresses the sync for gradient
+accumulation.
+
+TPU-native design: gradient synchronisation is not a runtime concern — when
+the batch is sharded on the ``dp`` mesh axis inside one jit'd step, XLA emits
+a fused reduce of the grads (the exact thing the Reducer's bucketing
+approximates by hand, but scheduled by the compiler and overlapped with the
+backward automatically).  ``DataParallel`` therefore only records the batch
+PartitionSpec and passes calls through.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None, dp_axis: str = "dp"):
+        super().__init__()
+        self._layers = layers
+        self.dp_axis = dp_axis
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Grad-accumulation context (reference parallel.py no_sync).  With
+        compiler-inserted reduction there is nothing to suppress: accumulate
+        microbatch grads in the step function instead.  Kept for parity."""
+        yield
+
+    def batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+        return P(self.dp_axis)
+
+    # passthroughs so the wrapper is transparent, like the reference
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)

@@ -1,0 +1,114 @@
+"""Parameter/optimizer sharding — ZeRO stages as GSPMD sharding plans.
+
+Reference parity: ``paddle.distributed.sharding.group_sharded_parallel``
+(distributed/sharding/group_sharded.py:37) and the stage machinery —
+``DygraphShardingOptimizer`` (stage 1, dygraph_sharding_optimizer.py:29),
+``GroupShardedStage2``+``GroupShardedOptimizerStage2`` (stage 2,
+group_sharded_stage2.py:46), ``GroupShardedStage3`` (stage 3,
+group_sharded_stage3.py:59 with allgather pre-hooks / release post-hooks).
+
+TPU-native design: the reference implements ZeRO with grad-bucket
+reduce-scatters, broadcast of updated shards, and forward allgather hooks —
+all runtime machinery.  Under GSPMD every stage is just a *sharding choice*:
+
+* stage 1 (``os``): optimizer state sharded on the sharding axis, params
+  replicated.  XLA reduce-scatters grads into the update and all-gathers
+  fresh params — the same comm volume as the hand-written stage 1.
+* stage 2 (``os_g``): identical compiled form (grads never exist replicated
+  inside a fused jit step; stage 1 vs 2 is a distinction about runtime
+  buffers the compiler already avoids).
+* stage 3 (``p_g_os``): params sharded **at rest** — FSDP.  XLA inserts the
+  per-layer allgather/release schedule the reference builds with hooks
+  (ForwardPostHooks, group_sharded_stage3.py:809).
+
+``shard_plan`` returns the PartitionSpecs that TrainStep consumes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["group_sharded_parallel", "shard_plan", "ShardingPlan"]
+
+
+class ShardingPlan:
+    """param_specs / opt_state mode for a ZeRO level on a named mesh axis."""
+
+    def __init__(self, level: str, axis: str,
+                 param_specs: Dict[str, object],
+                 shard_opt_state: bool):
+        self.level = level
+        self.axis = axis
+        self.param_specs = param_specs
+        self.shard_opt_state = shard_opt_state
+
+
+def _largest_divisible_dim(shape, n: int) -> Optional[int]:
+    """Pick the tensor dim to shard: largest dim divisible by axis size."""
+    best = None
+    for i, d in sorted(enumerate(shape), key=lambda t: -t[1]):
+        if d % n == 0 and d >= n:
+            best = i
+            break
+    return best
+
+
+def shard_plan(model, level: str = "p_g_os", axis: str = "sharding",
+               axis_size: Optional[int] = None,
+               base_specs: Optional[Dict[str, object]] = None) -> ShardingPlan:
+    """Compute PartitionSpecs implementing a ZeRO level.
+
+    `base_specs` (e.g. TP specs from ``Model.partition_specs``) are
+    composed with: stage-3 sharding uses a free (unsharded) dim of each
+    weight, mirroring how the reference composes sharding with mp/pp.
+    """
+    from jax.sharding import PartitionSpec as P
+    if axis_size is None:
+        import jax
+        axis_size = jax.device_count()
+    base = dict(base_specs or {})
+    specs: Dict[str, object] = {}
+    if level in ("os", "os_g"):
+        specs = {n: base.get(n, P())
+                 for n in model.state_dict(keep_vars=True)}
+    elif level == "p_g_os":
+        for name, t in model.state_dict(keep_vars=True).items():
+            spec = base.get(name, P())
+            parts = list(spec) + [None] * (t.ndim - len(list(spec)))
+            if axis in [p for p in parts if p is not None] or any(
+                    isinstance(p, tuple) and axis in p for p in parts):
+                specs[name] = spec
+                continue
+            free = [i for i, p in enumerate(parts) if p is None]
+            shape = t.shape
+            pick = None
+            for i in sorted(free, key=lambda i: -shape[i]):
+                if shape[i] % axis_size == 0 and shape[i] >= axis_size:
+                    pick = i
+                    break
+            if pick is None:
+                specs[name] = spec  # too small to shard — stays as-is
+            else:
+                parts[pick] = axis
+                specs[name] = P(*parts)
+    else:
+        raise ValueError(f"unknown sharding level '{level}' "
+                         "(expected os | os_g | p_g_os)")
+    return ShardingPlan(level, axis, specs,
+                        shard_opt_state=level in ("os", "os_g", "p_g_os"))
+
+
+def group_sharded_parallel(model, optimizer, level: str = "p_g_os",
+                           scaler=None, group=None, axis: str = "sharding",
+                           axis_size: Optional[int] = None,
+                           sync_buffers: bool = False,
+                           buffer_max_size: int = 0, **_ignored):
+    """API-parity entry (reference group_sharded.py:37).  Returns
+    (model, optimizer, scaler) with the computed ``ShardingPlan`` attached
+    as ``model._sharding_plan`` / ``optimizer._sharding_plan`` — feed
+    ``plan.param_specs`` to ``TrainStep(mesh=..., param_specs=...)``."""
+    plan = shard_plan(model, level=level, axis=axis, axis_size=axis_size)
+    model._sharding_plan = plan
+    if optimizer is not None:
+        optimizer._sharding_plan = plan
+    return model, optimizer, scaler

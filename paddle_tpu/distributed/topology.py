@@ -1,0 +1,183 @@
+"""Hybrid-parallel topology math.
+
+Reference parity: ``CommunicateTopology`` / ``HybridCommunicateGroup``
+(python/paddle/distributed/fleet/base/topology.py:54,140).  In the reference
+these objects create one NCCL ProcessGroup per axis of the
+["data","pipe","sharding","sep","model"] hypercube.  Here the same coordinate
+arithmetic instead *names the axes of one jax.sharding.Mesh* — groups are not
+runtime objects on TPU (XLA compiles the collectives), but the rank↔coordinate
+math is still load-bearing for pipeline schedules, checkpoint layout, and
+parity of the fleet API.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    """N-dim cartesian rank topology (row-major, first axis slowest)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = (
+            "data", "pipe", "sharding", "model"),
+            dims: Sequence[int] = (1, 1, 1, 1)):
+        assert len(hybrid_group_names) == len(dims)
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(
+            *(range(d) for d in self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on `axis_name` equals `index`."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self._coord2rank[c] for c in self.coordinate
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Partition of ranks into communication groups along one axis:
+        each group varies `axis_name` and fixes every other coordinate."""
+        axis = self._parallel_names.index(axis_name)
+        other = [n for i, n in enumerate(self._parallel_names) if i != axis]
+        groups = []
+        for fixed in itertools.product(
+                *(range(self._dims[i])
+                  for i in range(len(self._dims)) if i != axis)):
+            kw = dict(zip(other, fixed))
+            group = []
+            for k in range(self._dims[axis]):
+                kw[self._parallel_names[axis]] = k
+                group.append(self.get_rank(**kw))
+            groups.append(group)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        """Rank with the same coordinate as `global_rank` except overrides."""
+        coord = dict(zip(self._parallel_names, self.get_coord(global_rank)))
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Per-rank view of the topology (reference: topology.py:140).
+
+    The reference builds NCCL groups here; we only answer the coordinate
+    queries (degree / rank-in-group / group ranks) that fleet layers,
+    pipeline schedules, and checkpoint sharding ask for, and expose the
+    mesh-axis names that the GSPMD substrate uses instead of groups.
+    """
+
+    # (topology axis, mesh axis) pairs, reference order topology.py:56
+    AXES = (("data", "dp"), ("pipe", "pp"), ("sharding", "sharding"),
+            ("model", "mp"))
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+        for topo_name, short in self.AXES:
+            try:
+                degree = topology.get_dim(topo_name)
+            except ValueError:
+                degree = 1
+            setattr(self, f"_{short}_degree", degree)
+
+    # degrees ---------------------------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    # coordinates -----------------------------------------------------------
+    def _axis_info(self, name: str):
+        coord = dict(zip(self._topo.get_hybrid_group_names(),
+                         self._topo.get_coord(self.global_rank)))
+        rank_in_group = coord.get(name, 0)
+        index = {k: v for k, v in coord.items() if k != name}
+        ranks = [r for r in range(self.nranks)
+                 if all(dict(zip(self._topo.get_hybrid_group_names(),
+                                 self._topo.get_coord(r))).get(k) == v
+                        for k, v in index.items())]
+        return rank_in_group, sorted(ranks)
+
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_info("data")[0]
+
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_info("model")[0]
+
+    def get_stage_id(self) -> int:
+        return self._axis_info("pipe")[0]
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_info("sharding")[0]
+
+    def get_data_parallel_group_ranks(self) -> List[int]:
+        return self._axis_info("data")[1]
+
+    def get_model_parallel_group_ranks(self) -> List[int]:
+        return self._axis_info("model")[1]
+
+    def get_pipe_parallel_group_ranks(self) -> List[int]:
+        return self._axis_info("pipe")[1]
+
+    def get_sharding_parallel_group_ranks(self) -> List[int]:
+        return self._axis_info("sharding")[1]
+
+    # pipeline neighbours ---------------------------------------------------
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_next_rank(self) -> int:
+        stage = (self.get_stage_id() + 1) % self._pp_degree
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage)
+
+    def get_p2p_prev_rank(self) -> int:
+        stage = (self.get_stage_id() - 1) % self._pp_degree
+        return self._topo.get_rank_from_stage(self.global_rank, pipe=stage)
+
+    # mesh ------------------------------------------------------------------
+    def mesh_shape(self) -> Dict[str, int]:
+        """{mesh axis name: degree>1} — the jax Mesh this topology induces."""
+        out = {}
+        for topo_name, short in self.AXES:
+            try:
+                d = self._topo.get_dim(topo_name)
+            except ValueError:
+                d = 1
+            if d > 1:
+                out[short] = d
+        return out or {"dp": 1}
