@@ -26,6 +26,11 @@ from paddle_tpu.distributed import fleet as _fleet_mod  # noqa: F401
 from paddle_tpu.distributed.fleet import (  # noqa: F401
     DistributedStrategy, fleet)
 from paddle_tpu.distributed import mpu  # noqa: F401
+from paddle_tpu.distributed.pipeline import (  # noqa: F401
+    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc, spmd_pipeline,
+    stack_stage_params)
+from paddle_tpu.distributed.moe import (  # noqa: F401
+    ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate, top_k_gating)
 
 __all__ = [
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
@@ -39,4 +44,8 @@ __all__ = [
     "CommunicateTopology", "HybridCommunicateGroup",
     "DataParallel", "group_sharded_parallel", "shard_plan", "ShardingPlan",
     "fleet", "DistributedStrategy", "mpu",
+    "LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+    "spmd_pipeline", "stack_stage_params",
+    "MoELayer", "ExpertFFN", "NaiveGate", "SwitchGate", "GShardGate",
+    "top_k_gating",
 ]

@@ -1,0 +1,285 @@
+"""Pipeline parallelism.
+
+Reference parity: ``PipelineLayer``/``LayerDesc``/``SharedLayerDesc``
+(fleet/meta_parallel/parallel_layers/pp_layers.py:240,56,76), segmentation
+(``SegmentLayers`` pp_layers.py:92), the 1F1B runtime
+(``PipelineParallel.forward_backward_pipeline``
+meta_parallel/pipeline_parallel.py:188) and interleaved variant (:565,642),
+P2P activations (pp_utils/p2p_communication.py).
+
+TPU-native design: the reference runs one Python process per stage that
+`send/recv`s activations over NCCL and hand-schedules
+forward/backward interleaving.  Under single-controller SPMD the whole
+schedule is ONE traced program: stage weights are stacked on a leading
+[num_stages, ...] axis sharded over the ``pp`` mesh axis, and a
+``lax.scan`` over schedule ticks moves activations between neighbouring
+stages with ``lax.ppermute`` (XLA collective-permute — ICI point-to-point).
+Because ppermute/scan are differentiable, ``jax.grad`` of the scanned loss
+IS the pipelined backward — the compiler produces the reverse schedule that
+the reference writes by hand, and rematerialisation (``jax.checkpoint`` on
+the stage fn) gives the 1F1B-grade memory profile.
+
+Scope note: the scanned schedule is GPipe-shaped (all forwards, then the
+transposed backwards). 1F1B reorders the *runtime buffer lifetimes*, which
+in the reference reduces live activations from O(M) to O(S); here the same
+reduction comes from `remat='stage'` (save only stage boundaries, recompute
+inside the backward scan), which is how praxis/maxtext express it on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from paddle_tpu.nn.layer import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+           "spmd_pipeline"]
+
+
+class LayerDesc:
+    """Deferred layer constructor (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a Layer subclass")
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (reference
+    pp_layers.py:76 — e.g. tied embedding/lm-head; the reference allreduces
+    the shared grads across stages (:532); here the tied parameter is a
+    single array the compiler sees twice, so its gradient contributions sum
+    automatically)."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Split N layer descs into S contiguous stages (reference
+    pp_layers.py:92): 'uniform' by count or 'param' by parameter volume."""
+
+    def __init__(self, layers: Sequence, num_parts: int,
+                 method: str = "uniform"):
+        self.layers = list(layers)
+        self.num_parts = num_parts
+        self.method = method
+        if len(self.layers) < num_parts:
+            raise ValueError(
+                f"{len(self.layers)} layers < {num_parts} stages")
+
+    def do_segment(self) -> List[int]:
+        """Returns stage boundaries, len == num_parts+1."""
+        n, s = len(self.layers), self.num_parts
+        if self.method == "uniform":
+            base, rem = divmod(n, s)
+            sizes = [base + (1 if i < rem else 0) for i in range(s)]
+        elif self.method.startswith("layer:"):
+            # weight by occurrences of a named layer class (reference
+            # supports 'layer:TransformerLayer')
+            name = self.method.split(":", 1)[1]
+            weights = [1 if getattr(d, "layer_cls", type(d)).__name__ == name
+                       else 0 for d in self.layers]
+            sizes = self._balance(weights, s)
+        elif self.method == "param":
+            weights = []
+            for d in self.layers:
+                layer = d.build_layer() if isinstance(d, LayerDesc) else d
+                weights.append(sum(int(np.prod(p.shape))
+                                   for p in layer.parameters()) or 1)
+            sizes = self._balance(weights, s)
+        else:
+            raise ValueError(f"unknown segment method {self.method}")
+        bounds = [0]
+        for sz in sizes:
+            bounds.append(bounds[-1] + sz)
+        return bounds
+
+    @staticmethod
+    def _balance(weights: List[int], s: int) -> List[int]:
+        """Greedy prefix split minimising max stage weight."""
+        total = sum(weights)
+        target = total / s
+        sizes, acc, count = [], 0.0, 0
+        remaining_parts = s
+        for i, w in enumerate(weights):
+            acc += w
+            count += 1
+            remaining = len(weights) - i - 1
+            if (acc >= target and remaining_parts > 1
+                    and remaining >= remaining_parts - 1):
+                sizes.append(count)
+                acc, count = 0.0, 0
+                remaining_parts -= 1
+        sizes.append(count)
+        while len(sizes) < s:
+            sizes.append(0)
+        return sizes
+
+
+class PipelineLayer(Layer):
+    """Stage-segmented model container (reference pp_layers.py:240).
+
+    Single-controller SPMD holds ALL stages' weights (each sharded to its
+    stage's devices by the pp dim of the stacked arrays), so unlike the
+    reference there is no per-rank construction: ``forward`` runs the full
+    serial stack (parity/eval path), and ``stage_layers(i)`` exposes the
+    per-stage slices for the spmd schedule.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: int,
+                 topology=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, name=None):
+        super().__init__()
+        self._descs = list(layers)
+        self._num_stages = num_stages
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+
+        self.segment_bounds = SegmentLayers(
+            self._descs, num_stages, seg_method).do_segment()
+
+        from paddle_tpu.nn.common_layers import LayerList
+        built: List[Layer] = []
+        self._shared: dict = {}
+        self._shared_fwd: dict = {}
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    # reuse the first instance's weights: same Layer object
+                    built.append(self._shared[d.layer_name])
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                    built.append(layer)
+                self._shared_fwd[len(built) - 1] = d.forward_func
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            else:
+                raise TypeError(f"bad pipeline element {d!r}")
+        self.run_function = LayerList(built)
+
+    @property
+    def num_stages(self) -> int:
+        return self._num_stages
+
+    def get_num_virtual_stages(self) -> int:
+        return 1
+
+    def stage_layers(self, stage: int) -> List[Layer]:
+        lo, hi = self.segment_bounds[stage], self.segment_bounds[stage + 1]
+        return list(self.run_function)[lo:hi]
+
+    def forward(self, x):
+        for i, layer in enumerate(self.run_function):
+            fwd = self._shared_fwd.get(i)
+            x = fwd(layer, x) if fwd is not None else layer(x)
+        return x
+
+
+# -- the SPMD schedule -------------------------------------------------------
+
+def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
+                  *, num_microbatches: int, axis_name: str = "pp",
+                  remat: bool = True):
+    """Run a homogeneous-stage pipeline INSIDE an enclosing shard_map.
+
+    Args:
+      stage_fn: ``(params_for_this_stage, x) -> y`` — one stage's compute.
+        Same jaxpr on every device (SPMD); per-stage behaviour comes from
+        the params.
+      stage_params: this device's slice of the stacked [S, ...] params
+        (shard_map has already split the leading axis).
+      microbatches: ``[M, mb, ...]`` array of all microbatch inputs,
+        replicated over the pp axis.
+      num_microbatches: M (static).
+      remat: jax.checkpoint the stage fn — recompute stage interiors in
+        the backward pass, keeping only boundary activations live (the
+        memory behaviour 1F1B buys in the reference).
+
+    Returns ``[M, mb, ...]`` outputs, valid on the LAST stage (other
+    stages hold zeros); combine with a ``where(axis_index==S-1, ...)``
+    psum or an out_spec that keeps the pp axis.
+
+    Schedule: T = M + S - 1 ticks.  At tick t stage s computes microbatch
+    ``t - s`` (when in range) — the classic GPipe wavefront; ppermute
+    rotates boundary activations one hop per tick over ICI.
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = num_microbatches
+    mb_shape = microbatches.shape[1:]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    # probe output shape: stages must be shape-preserving on the boundary
+    out_shape = jax.eval_shape(fn, stage_params,
+                               jax.ShapeDtypeStruct(
+                                   mb_shape, microbatches.dtype))
+    if (out_shape.shape, out_shape.dtype) != (mb_shape, microbatches.dtype):
+        raise ValueError(
+            "spmd_pipeline requires shape-preserving stages; got "
+            f"{mb_shape}->{out_shape.shape}")
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # stage 0 injects microbatch t (clamped; masked out when t >= M)
+        inject = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, inject, recv)
+        y = fn(stage_params, x)
+        # rotate boundary activation to the next stage (ring; the wrap
+        # last->first carries garbage that stage 0 ignores via `where`)
+        new_recv = lax.ppermute(y, axis_name,
+                                [(i, (i + 1) % S) for i in range(S)])
+        # last stage records microbatch t-(S-1)
+        m = t - (S - 1)
+        write = (idx == S - 1) & (m >= 0) & (m < M)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(write, y,
+                      lax.dynamic_index_in_dim(outputs, jnp.clip(m, 0, M - 1),
+                                               axis=0, keepdims=False)),
+            jnp.clip(m, 0, M - 1), axis=0)
+        return (new_recv, outputs), None
+
+    def _vary(x):
+        # the carry becomes device-varying after ppermute; mark the zero
+        # init as varying too so shard_map's vma check accepts the scan
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, (axis_name,), to="varying")
+        if hasattr(lax, "pvary"):
+            return lax.pvary(x, (axis_name,))
+        return x
+
+    init = (_vary(jnp.zeros(mb_shape, microbatches.dtype)),
+            _vary(jnp.zeros((M,) + mb_shape, microbatches.dtype)))
+    (recv, outputs), _ = lax.scan(tick, init, jnp.arange(M + S - 1))
+    return outputs
+
+
+def stack_stage_params(per_stage_params: List[Any]):
+    """[pytree per stage] -> stacked pytree with leading S axis (to be
+    sharded P('pp', ...)).  Stages must be homogeneous."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
