@@ -31,6 +31,9 @@ from paddle_tpu.distributed.pipeline import (  # noqa: F401
     stack_stage_params)
 from paddle_tpu.distributed.moe import (  # noqa: F401
     ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate, top_k_gating)
+from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
+    make_ring_attention, make_ulysses_attention, ring_attention,
+    ulysses_attention)
 
 __all__ = [
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
@@ -48,4 +51,6 @@ __all__ = [
     "spmd_pipeline", "stack_stage_params",
     "MoELayer", "ExpertFFN", "NaiveGate", "SwitchGate", "GShardGate",
     "top_k_gating",
+    "ring_attention", "ulysses_attention", "make_ring_attention",
+    "make_ulysses_attention",
 ]

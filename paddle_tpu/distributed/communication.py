@@ -38,6 +38,16 @@ __all__ = [
 ]
 
 
+def pvary(x, axis_name: str):
+    """Mark `x` as device-varying over `axis_name` — needed for scan carries
+    inside shard_map whose value becomes varying (e.g. after a ppermute)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis_name,))
+    return x
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"

@@ -264,17 +264,12 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
             jnp.clip(m, 0, M - 1), axis=0)
         return (new_recv, outputs), None
 
-    def _vary(x):
-        # the carry becomes device-varying after ppermute; mark the zero
-        # init as varying too so shard_map's vma check accepts the scan
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (axis_name,), to="varying")
-        if hasattr(lax, "pvary"):
-            return lax.pvary(x, (axis_name,))
-        return x
-
-    init = (_vary(jnp.zeros(mb_shape, microbatches.dtype)),
-            _vary(jnp.zeros((M,) + mb_shape, microbatches.dtype)))
+    # the carry becomes device-varying after ppermute; mark the zero init
+    # as varying too so shard_map's vma check accepts the scan
+    from paddle_tpu.distributed.communication import pvary
+    init = (pvary(jnp.zeros(mb_shape, microbatches.dtype), axis_name),
+            pvary(jnp.zeros((M,) + mb_shape, microbatches.dtype),
+                  axis_name))
     (recv, outputs), _ = lax.scan(tick, init, jnp.arange(M + S - 1))
     return outputs
 

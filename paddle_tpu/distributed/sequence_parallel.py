@@ -1,0 +1,150 @@
+"""Sequence / context parallelism: ring attention + Ulysses (DeepSpeed-style).
+
+**New capability — no reference port.** SURVEY.md §5.7 verified the reference
+has NO sequence parallelism (grep over the snapshot); its long-context story
+is flash attention + recompute.  This module is designed TPU-first:
+
+* **Ring attention** (`ring_attention`): the sequence dim is sharded on the
+  ``sp`` mesh axis; each device keeps its Q shard and rotates K/V shards
+  around the ring with ``lax.ppermute`` (one ICI hop per step), folding each
+  incoming block into a running online-softmax — so peak memory is
+  O(seq/sp) and the N² score matrix never materialises anywhere.
+* **Ulysses** (`ulysses_attention`): ``all_to_all`` swaps the head dim for
+  the sequence dim (heads must divide sp), runs dense/flash attention on
+  full sequences of the local heads, and swaps back.  Two all_to_alls per
+  layer vs sp ppermutes — better when heads ≥ sp and ICI all_to_all
+  bandwidth is good (within a pod).
+
+Both are plain differentiable JAX (ppermute/all_to_all have transposes), so
+jax.grad through a shard_map'd call gives the distributed backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ring_attention", "ulysses_attention", "make_ring_attention",
+           "make_ulysses_attention"]
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention INSIDE shard_map.
+
+    q, k, v: local shards [batch, seq_local, heads, head_dim]; the global
+    sequence is the concatenation over the sp axis in rank order.
+    Returns the local output shard [batch, seq_local, heads, head_dim].
+    """
+    sp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # GQA: broadcast kv heads
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    qf = q.astype(jnp.float32)
+    q_pos = idx * s + jnp.arange(s)                    # global q positions
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - i) % sp                           # owner of current kv
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            k_cur.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * s + jnp.arange(s)
+            mask = q_pos[:, None] >= k_pos[None, :]    # [sq, sk]
+            scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)   # [b,h,q,1]
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+        o_new = o * corr + pv
+        # rotate kv to the next rank (skip after the last fold)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    from paddle_tpu.distributed.communication import pvary
+    o0 = pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
+    m0 = pvary(jnp.full((b, h, s, 1), _NEG_INF, jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((b, h, s, 1), jnp.float32), axis_name)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(sp))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    out = (o / safe_l).astype(q.dtype)                 # [b,h,s,d]
+    return jnp.swapaxes(out, 1, 2)                     # [b,s,h,d]
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None,
+                      attn_fn=None):
+    """Ulysses sequence parallelism INSIDE shard_map.
+
+    q, k, v: local shards [batch, seq_local, heads, head_dim]; heads must be
+    divisible by the sp axis size.  all_to_all to [batch, seq_global,
+    heads_local, head_dim], run full attention per local head, swap back.
+    `attn_fn(q, k, v, causal, scale)` defaults to the XLA sdpa; pass the
+    flash kernel for long sequences.
+    """
+    sp = lax.axis_size(axis_name)
+    b, s, h, d = q.shape
+    if h % sp:
+        raise ValueError(f"heads {h} not divisible by sp={sp}")
+
+    def swap_in(x):   # [b, s_l, h, d] -> [b, s_g, h_l, d]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def swap_out(x):  # [b, s_g, h_l, d] -> [b, s_l, h, d]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = swap_in(q), swap_in(k), swap_in(v)
+    if attn_fn is None:
+        from paddle_tpu.nn.functional.attention import _sdpa_reference
+        out = _sdpa_reference(qg, kg, vg, is_causal=causal, scale=scale)
+    else:
+        out = attn_fn(qg, kg, vg, causal=causal, scale=scale)
+    return swap_out(out)
+
+
+def _wrap_shard_map(fn, mesh, axis_name, seq_axis=1):
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    spec = [None, None, None, None]
+    spec[seq_axis] = axis_name
+    spec = P(*spec)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)
+
+
+def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = False,
+                        scale=None):
+    """Top-level entry: global [b, seq, h, d] arrays sharded on `axis_name`
+    → shard_map'd ring attention."""
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return _wrap_shard_map(lambda q, k, v: fn(q, k, v), mesh, axis_name)
+
+
+def make_ulysses_attention(mesh, axis_name: str = "sp",
+                           causal: bool = False, scale=None, attn_fn=None):
+    fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal, scale=scale, attn_fn=attn_fn)
+    return _wrap_shard_map(lambda q, k, v: fn(q, k, v), mesh, axis_name)

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels — the hot-op layer.
+
+Where the reference ships hand-written CUDA (fused_attention_op.cu,
+flash_attn kernels, fused_multi_transformer_op.cu — SURVEY.md §2.2), this
+package holds the TPU equivalents as Pallas kernels.  Everything else is
+left to XLA fusion on purpose: only ops where blockwise scheduling beats
+the compiler get a kernel.
+"""

@@ -1,0 +1,236 @@
+"""Flash attention — Pallas TPU kernel.
+
+Replaces the reference's CUDA flash-attn integration
+(python/paddle/nn/functional/flash_attention.py → _C_ops.flash_attn,
+kernels under paddle/phi/kernels/gpu/flash_attn_*) with a TPU-native
+blockwise kernel:
+
+* forward: online-softmax over K/V blocks streamed HBM→VMEM by the grid
+  pipeline; scores/accumulators live in VMEM scratch in fp32; the MXU does
+  the two matmuls per block.  Saves per-row logsumexp for the backward.
+* backward: blockwise recompute from the saved logsumexp (flash-attention-2
+  style) expressed in JAX and left to XLA to fuse — dQ/dK/dV each come from
+  one scan over blocks, so backward memory is O(seq·block), not O(seq²).
+
+Layout: [batch, seq, heads, head_dim] (paddle convention) at the API;
+kernels see [batch*heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only; tests on CPU use interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_TPU_PL = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAVE_TPU_PL = False
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, block_q, block_k, scale, causal,
+                seq_len):
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks); the k axis is the
+    innermost (sequential) dim, so VMEM scratch carries the online-softmax
+    state across k blocks."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        q = q_ref[0]                                   # [bq, d]
+        k = k_ref[0]                                   # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:]                              # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                         # [bq, bk]
+        correction = jnp.exp(m_prev - m_new)           # [bq, 1]
+        l_new = correction * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, d]
+        acc_ref[:] = acc_ref[:] * correction + pv
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    if causal:
+        # whole block above the diagonal → nothing to do
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:] + jnp.log(safe_l))[:, 0]
+
+
+def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k,
+                interpret=False):
+    """q,k,v: [bh, s, d] → (out [bh, s, d], lse [bh, s])."""
+    bh, s, d = q.shape
+    nq = pl.cdiv(s, block_q)
+    nk = pl.cdiv(s, block_k)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        causal=causal, seq_len=s)
+
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+        pltpu.VMEM((block_q, 1), jnp.float32),
+    ]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(q, k, v)
+
+
+# -- backward: blockwise recompute in JAX (flash-attn-2 equations) -----------
+
+def _bwd_blockwise(res, g, *, scale, causal, block_k):
+    """Memory-efficient backward: scan over K/V blocks; recompute P from
+    q,k and the saved logsumexp.  All matmuls MXU-shaped; XLA fuses the
+    elementwise chain."""
+    q, k, v, out, lse = res           # q,k,v,out [bh,s,d]; lse [bh,s]
+    bh, s, d = q.shape
+    g = g.astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = out.astype(jnp.float32)
+
+    # delta_i = sum_d(dO * O) — rowwise (flash-attn-2 eq. 4)
+    delta = jnp.sum(g * of, axis=-1)                   # [bh, s]
+
+    nk = s // block_k
+    kb = kf.reshape(bh, nk, block_k, d)
+    vb = vf.reshape(bh, nk, block_k, d)
+
+    q_pos = jnp.arange(s)
+
+    def one_block(j):
+        kj = kb[:, j]                                  # [bh, bk, d]
+        vj = vb[:, j]
+        sij = jnp.einsum("bqd,bkd->bqk", qf, kj) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            sij = jnp.where(mask[None], sij, _NEG_INF)
+        pij = jnp.exp(sij - lse[:, :, None])           # [bh, q, bk]
+        dv_j = jnp.einsum("bqk,bqd->bkd", pij, g)
+        dp = jnp.einsum("bqd,bkd->bqk", g, vj)
+        ds = pij * (dp - delta[:, :, None]) * scale
+        dq_contrib = jnp.einsum("bqk,bkd->bqd", ds, kj)
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_contrib, dk_j, dv_j
+
+    def scan_body(dq_acc, j):
+        dq_c, dk_j, dv_j = one_block(j)
+        return dq_acc + dq_c, (dk_j, dv_j)
+
+    dq, (dks, dvs) = jax.lax.scan(scan_body, jnp.zeros_like(qf),
+                                  jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, s, d)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, s, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    return _bwd_blockwise(res, g, scale=scale, causal=causal,
+                          block_k=block_k)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = None):
+    """q,k,v: [batch, seq, heads, head_dim] (paddle layout).  Requires seq
+    divisible by the block sizes (callers pad; the model stack keeps seq a
+    multiple of 128 for MXU efficiency anyway)."""
+    b, s, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} must be divisible by block sizes "
+                         f"({block_q},{block_k})")
+
+    # GQA/MQA: broadcast kv heads to q heads
+    hk = k.shape[2]
+    if hk != h:
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    def to_bh(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
+                      bool(causal), block_q, block_k, bool(interpret))
+    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)

@@ -1,0 +1,116 @@
+"""Ring attention / Ulysses / Pallas flash attention tests.
+
+Parity oracle: the dense XLA attention on the full (unsharded) sequence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+
+def make_qkv(b=2, s=64, h=4, d=16, kv_heads=None, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32) * 0.5
+    k = jax.random.normal(ks[1], (b, s, kv_heads or h, d), jnp.float32) * 0.5
+    v = jax.random.normal(ks[2], (b, s, kv_heads or h, d), jnp.float32) * 0.5
+    return q, k, v
+
+
+def sp_mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = make_qkv()
+        mesh = sp_mesh()
+        fn = dist.make_ring_attention(mesh, causal=causal)
+        got = jax.jit(fn)(q, k, v)
+        want = _sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa_broadcast(self):
+        q, k, v = make_qkv(h=8, kv_heads=2)
+        mesh = sp_mesh()
+        got = jax.jit(dist.make_ring_attention(mesh, causal=True))(q, k, v)
+        want = _sdpa_reference(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2),
+                               is_causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_dense(self):
+        q, k, v = make_qkv(s=32)
+        mesh = sp_mesh(4)
+        ring = dist.make_ring_attention(mesh, causal=True)
+
+        g1 = jax.jit(jax.grad(lambda q, k, v: (ring(q, k, v) ** 2).sum(),
+                              argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: (
+            _sdpa_reference(q, k, v, is_causal=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=1e-5)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = make_qkv(h=8)
+        mesh = sp_mesh()
+        fn = dist.make_ulysses_attention(mesh, causal=causal)
+        got = jax.jit(fn)(q, k, v)
+        want = _sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_heads_not_divisible_raises(self):
+        q, k, v = make_qkv(h=4)  # 4 heads, sp=8
+        mesh = sp_mesh()
+        fn = dist.make_ulysses_attention(mesh)
+        with pytest.raises(ValueError, match="not divisible"):
+            jax.jit(fn)(q, k, v)
+
+
+class TestPallasFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        q, k, v = make_qkv(s=256, d=64)
+        got = flash_attention(q, k, v, causal=causal, interpret=True)
+        want = _sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        q, k, v = make_qkv(s=128, h=8, kv_heads=2, d=64)
+        got = flash_attention(q, k, v, causal=True, interpret=True)
+        want = _sdpa_reference(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2),
+                               is_causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_backward_blockwise(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        q, k, v = make_qkv(s=128, d=64)
+        g1 = jax.grad(lambda q, k, v: (flash_attention(
+            q, k, v, causal=True, interpret=True) ** 2).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: (_sdpa_reference(
+            q, k, v, is_causal=True) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_indivisible_seq_raises(self):
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        q, k, v = make_qkv(s=100, d=64)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
