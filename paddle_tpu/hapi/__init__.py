@@ -1,0 +1,8 @@
+"""paddle_tpu.hapi — high-level Model API (reference: python/paddle/hapi/)."""
+
+from paddle_tpu.hapi.model import Model  # noqa: F401
+from paddle_tpu.hapi.callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger)
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRScheduler"]

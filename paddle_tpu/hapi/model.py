@@ -1,0 +1,257 @@
+"""hapi.Model — the Keras-like high-level train loop.
+
+Reference parity: ``paddle.Model`` (python/paddle/hapi/model.py:1050 —
+``.prepare`` :1661, ``.fit`` :1741, ``train_batch`` :1191).  There the Model
+adapts between dygraph and static graph executors; here there is one
+execution path — the jitted TrainStep — and the loop feeds it from
+paddle_tpu.io.DataLoader with callbacks/metrics on the host side.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.hapi.callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self._step_handles_lr = True  # TrainStep steps the scheduler
+        self.stop_training = False
+
+    # -- configuration -------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, mesh=None, param_specs=None,
+                batch_spec=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        if optimizer is not None and loss is not None:
+            from paddle_tpu.jit import TrainStep
+            loss_fn = loss if callable(loss) else None
+            self._train_step = TrainStep(
+                self.network, optimizer, loss_fn=loss_fn, mesh=mesh,
+                param_specs=param_specs, batch_spec=batch_spec)
+        return self
+
+    def _build_eval_fn(self):
+        if self._eval_fn is not None:
+            return self._eval_fn
+        import jax
+        from paddle_tpu.core.functional import functional_call, params_of
+
+        net = self.network
+
+        @jax.jit
+        def fwd(params, x):
+            out = functional_call(net, params, x)
+            return out._data if hasattr(out, "_data") else out
+
+        def eval_fn(x):
+            params = self._current_params()
+            return fwd(params, x)
+
+        self._eval_fn = eval_fn
+        return eval_fn
+
+    def _current_params(self):
+        if self._train_step is not None:
+            return self._train_step.params
+        from paddle_tpu.core.functional import params_of
+        return params_of(self.network)
+
+    # -- single-batch APIs (reference model.py train_batch :1191) ------------
+    def train_batch(self, inputs, labels=None):
+        if self._train_step is None:
+            raise RuntimeError("call prepare(optimizer, loss) first")
+        import jax.numpy as jnp
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        if self._loss is None or (labels and self._loss is not None
+                                  and not callable(self._loss)):
+            raise RuntimeError("prepare() needs a callable loss")
+        batch = (inputs[0] if len(inputs) == 1 else tuple(inputs),
+                 labels[0] if len(labels) == 1 else tuple(labels))
+        loss = self._train_step(batch)
+        return float(np.asarray(loss))
+
+    def eval_batch(self, inputs, labels=None):
+        import paddle_tpu as pp
+        out = self.predict_batch(inputs)
+        if labels is None or self._loss is None:
+            return out
+        y = _as_list(labels)[0]
+        loss = self._loss(pp.to_tensor(out), pp.to_tensor(np.asarray(y)))
+        return float(np.asarray(
+            loss._data if hasattr(loss, "_data") else loss))
+
+    def predict_batch(self, inputs):
+        import jax.numpy as jnp
+        x = _as_list(inputs)[0]
+        fn = self._build_eval_fn()
+        return np.asarray(fn(jnp.asarray(np.asarray(x))))
+
+    # -- loops ---------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers,
+                     drop_last=False):
+        from paddle_tpu.io import DataLoader, Dataset
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=[m.name() for m in self._metrics])
+        cbks.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            if hasattr(loader, "batch_sampler") and hasattr(
+                    loader.batch_sampler, "set_epoch"):
+                loader.batch_sampler.set_epoch(epoch)
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                x, y = self._split_batch(batch)
+                loss = self.train_batch(x, y)
+                epoch_losses.append(loss)
+                cbks.on_train_batch_end(step, {"loss": loss})
+            logs = {"loss": float(np.mean(epoch_losses))
+                    if epoch_losses else 0.0}
+            history["loss"].append(logs["loss"])
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _cbks=cbks)
+                for c in cbks.callbacks:
+                    if getattr(c, "stop_training", False):
+                        self.stop_training = True
+            if self.stop_training:
+                break
+        cbks.on_train_end()
+        return history
+
+    def _split_batch(self, batch):
+        if isinstance(batch, dict):
+            return batch, None
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], None
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, _cbks=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        cbks = _cbks
+        if cbks is not None:
+            cbks.on_eval_begin()
+        for batch in loader:
+            x, y = self._split_batch(batch)
+            out = self.predict_batch(x)
+            if y is not None and self._loss is not None:
+                import paddle_tpu as pp
+                lv = self._loss(pp.to_tensor(out),
+                                pp.to_tensor(np.asarray(y[0])))
+                losses.append(float(np.asarray(
+                    lv._data if hasattr(lv, "_data") else lv)))
+            for m in self._metrics:
+                if y is not None:
+                    m.update(m.compute(out, np.asarray(y[0])))
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                logs.update(dict(zip(name, acc)))
+            else:
+                logs[name] = acc
+        if cbks is not None:
+            cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=0, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        outs = []
+        for batch in loader:
+            x, _ = self._split_batch(batch)
+            outs.append(self.predict_batch(x))
+        if stack_outputs:
+            return np.concatenate(outs, axis=0)
+        return outs
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path, training=True):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        import paddle_tpu as pp
+        if self._train_step is not None:
+            self._train_step.sync_to_model()
+        pp.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._train_step is not None:
+            pp.save(self._train_step.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as pp
+        state = pp.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._train_step is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._train_step.set_state_dict(pp.load(path + ".pdopt"))
+        elif self._train_step is not None:
+            # refresh step params from the (re)loaded network
+            from paddle_tpu.core.functional import params_of
+            self._train_step.params = {
+                n: a.copy() for n, a in params_of(self.network).items()}
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:60s} {str(tuple(p.shape)):20s} {n}")
+        text = "\n".join(lines)
+        info = f"Total params: {total}\n{text}"
+        print(info)
+        return {"total_params": total}

@@ -1,1 +1,15 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.io — Dataset/DataLoader (reference: python/paddle/io/)."""
+
+from paddle_tpu.io.dataset import (  # noqa: F401
+    BatchSampler, ChainDataset, ConcatDataset, Dataset,
+    DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
+    SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
+    random_split)
+from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
+    "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
+    "RandomSampler", "WeightedRandomSampler", "BatchSampler",
+    "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+]

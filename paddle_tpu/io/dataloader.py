@@ -1,0 +1,189 @@
+"""DataLoader — host-side batching + background prefetch.
+
+Reference parity: ``paddle.io.DataLoader`` (io/reader.py:218) — there,
+multiprocess workers push batches through shared-memory queues into a C++
+``LoDTensorBlockingQueue`` read by a ``create_py_reader`` op
+(io/dataloader/dataloader_iter.py:201, operators/reader/).
+
+TPU-native design: the device never blocks on input — batches are assembled
+on host (optionally by a process pool), then a background thread keeps a
+small prefetch queue ahead of the training loop, overlapping host work with
+device steps.  jit'd steps dispatch asynchronously, so one queue + one
+thread gives the same pipelining the reference's blocking-queue machinery
+does, without native code (XLA's transfer engine does the H2D overlap).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import (BatchSampler, Dataset, IterableDataset,
+                                   SequenceSampler)
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into numpy batch arrays (structure-aware)."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch])
+                for k in sample}
+    if hasattr(sample, "numpy"):  # Tensor
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    arr = np.asarray(sample)
+    if arr.dtype == object:
+        return batch
+    return np.stack([np.asarray(s) for s in batch])
+
+
+class _PrefetchIterator:
+    _STOP = object()
+
+    def __init__(self, gen_fn: Callable[[], Iterable], depth: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._exc = None
+        self._done = False
+
+        def worker():
+            try:
+                for item in gen_fn():
+                    self._q.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._exc = e
+            finally:
+                self._q.put(self._STOP)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._STOP:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None,
+                 batch_size: Optional[int] = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Callable = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(1, prefetch_factor)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+
+        if self._iterable_mode:
+            if batch_sampler is not None:
+                raise ValueError("batch_sampler invalid for IterableDataset")
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            self.batch_size = batch_size
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last) if batch_size is not None else None
+
+        self._pool = None
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    # -- batch generation ----------------------------------------------------
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _gen_map_style(self):
+        if self.num_workers > 0:
+            # process pool maps index batches; order preserved
+            from concurrent.futures import ProcessPoolExecutor
+            if self._pool is None:
+                self._pool = ProcessPoolExecutor(self.num_workers)
+            futures = []
+            inflight = self.num_workers * self.prefetch_factor
+            it = iter(self.batch_sampler)
+            import collections
+            dq = collections.deque()
+            try:
+                for _ in range(inflight):
+                    try:
+                        dq.append(self._pool.submit(_fetch_worker,
+                                                    self.dataset,
+                                                    self.collate_fn,
+                                                    next(it)))
+                    except StopIteration:
+                        break
+                while dq:
+                    fut = dq.popleft()
+                    yield fut.result()
+                    try:
+                        dq.append(self._pool.submit(_fetch_worker,
+                                                    self.dataset,
+                                                    self.collate_fn,
+                                                    next(it)))
+                    except StopIteration:
+                        pass
+            finally:
+                pass
+        else:
+            if self.batch_sampler is None:
+                for i in range(len(self.dataset)):
+                    yield self.dataset[i]
+            else:
+                for indices in self.batch_sampler:
+                    yield self._fetch(indices)
+
+    def _gen_iterable(self):
+        if self.batch_size is None:
+            yield from self.dataset
+            return
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        gen = self._gen_iterable if self._iterable_mode \
+            else self._gen_map_style
+        if self.use_buffer_reader:
+            return _PrefetchIterator(gen, depth=self.prefetch_factor)
+        return iter(gen())
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+def _fetch_worker(dataset, collate_fn, indices):
+    return collate_fn([dataset[i] for i in indices])

@@ -1,0 +1,191 @@
+"""io.DataLoader / metric / hapi.Model tests (reference patterns:
+test/legacy_test/test_dataloader_*, test_metrics.py, test_model.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.io import (BatchSampler, ConcatDataset, DataLoader, Dataset,
+                           DistributedBatchSampler, IterableDataset,
+                           RandomSampler, Subset, TensorDataset,
+                           random_split)
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n, d=4):
+        self.x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+        self.y = (np.arange(n) % 3).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class CountingIterable(IterableDataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.full((2,), i, np.float32)
+
+
+class TestDatasets:
+    def test_tensor_dataset_and_split(self):
+        ds = TensorDataset([np.arange(10), np.arange(10) * 2])
+        assert len(ds) == 10 and ds[3] == (3, 6)
+        a, b = random_split(ds, [7, 3])
+        assert len(a) == 7 and len(b) == 3
+
+    def test_concat_subset(self):
+        d1, d2 = RangeDataset(5), RangeDataset(3)
+        cat = ConcatDataset([d1, d2])
+        assert len(cat) == 8
+        np.testing.assert_allclose(cat[5][0], d2[0][0])
+        sub = Subset(d1, [4, 0])
+        np.testing.assert_allclose(sub[0][0], d1[4][0])
+
+    def test_batch_sampler(self):
+        bs = BatchSampler(RangeDataset(10), batch_size=3)
+        batches = list(bs)
+        assert [len(b) for b in batches] == [3, 3, 3, 1]
+        bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=True)
+        assert len(list(bs)) == 3 == len(bs)
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = RangeDataset(16)
+        samplers = [DistributedBatchSampler(ds, 2, num_replicas=4, rank=r)
+                    for r in range(4)]
+        seen = []
+        for s in samplers:
+            for batch in s:
+                seen.extend(batch)
+        assert sorted(set(seen)) == list(range(16))
+        # each rank sees the same number of batches (padded)
+        counts = [len(list(s)) for s in samplers]
+        assert len(set(counts)) == 1
+
+
+class TestDataLoader:
+    def test_map_style_batching(self):
+        dl = DataLoader(RangeDataset(10), batch_size=4, shuffle=False)
+        batches = list(dl)
+        assert batches[0][0].shape == (4, 4)
+        assert batches[-1][0].shape == (2, 4)
+        np.testing.assert_allclose(batches[0][0][1], np.arange(4, 8))
+
+    def test_iterable_dataset(self):
+        dl = DataLoader(CountingIterable(5), batch_size=2)
+        shapes = [b.shape for b in dl]
+        assert shapes == [(2, 2), (2, 2), (1, 2)]
+
+    def test_shuffle_covers_all(self):
+        dl = DataLoader(RangeDataset(12), batch_size=4, shuffle=True)
+        xs = np.concatenate([b[0] for b in dl])
+        assert sorted(xs[:, 0].tolist()) == sorted(
+            np.arange(12) * 4.0)
+
+    def test_dict_collate(self):
+        class DictDs(Dataset):
+            def __getitem__(self, i):
+                return {"a": np.float32(i), "b": np.arange(2)}
+
+            def __len__(self):
+                return 4
+        batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+        assert batch["a"].shape == (4,) and batch["b"].shape == (4, 2)
+
+    def test_exception_propagates(self):
+        class Bad(Dataset):
+            def __getitem__(self, i):
+                raise RuntimeError("boom")
+
+            def __len__(self):
+                return 4
+        with pytest.raises(RuntimeError, match="boom"):
+            list(DataLoader(Bad(), batch_size=2))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]])
+        label = np.array([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5 and top2 == 0.5
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect_classifier(self):
+        m = Auc()
+        m.update(np.array([0.9, 0.8, 0.1, 0.2]), np.array([1, 1, 0, 0]))
+        assert m.accumulate() == pytest.approx(1.0)
+
+
+class TestHapiModel:
+    def _make(self):
+        pp.seed(0)
+        net = pp.nn.Sequential(pp.nn.Linear(4, 16), pp.nn.ReLU(),
+                               pp.nn.Linear(16, 3))
+        model = pp.Model(net)
+        opt = pp.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+
+        def loss(out, y):
+            return pp.nn.functional.cross_entropy(out, y)
+        model.prepare(opt, loss, metrics=Accuracy())
+        return model
+
+    def test_fit_reduces_loss(self):
+        model = self._make()
+        ds = RangeDataset(32)
+        # normalise features so the loss is well-behaved
+        ds.x = (ds.x - ds.x.mean()) / (ds.x.std() + 1e-6)
+        hist = model.fit(ds, epochs=5, batch_size=8, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_evaluate_and_predict(self):
+        model = self._make()
+        ds = RangeDataset(16)
+        ds.x = (ds.x - ds.x.mean()) / (ds.x.std() + 1e-6)
+        model.fit(ds, epochs=1, batch_size=8, verbose=0)
+        logs = model.evaluate(ds, batch_size=8, verbose=0)
+        assert "loss" in logs and "acc" in logs
+        preds = model.predict(ds, batch_size=8, stack_outputs=True)
+        assert preds.shape == (16, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = self._make()
+        ds = RangeDataset(8)
+        model.fit(ds, epochs=1, batch_size=4, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        before = model.predict_batch([ds.x[:2]])
+
+        model2 = self._make()
+        model2.load(path)
+        after = model2.predict_batch([ds.x[:2]])
+        np.testing.assert_allclose(before, after, rtol=1e-5)
+
+    def test_train_batch_scalar_loss(self):
+        model = self._make()
+        ds = RangeDataset(8)
+        loss = model.train_batch([ds.x[:4]], [ds.y[:4]])
+        assert np.isfinite(loss)
+
+    def test_summary_counts_params(self):
+        model = self._make()
+        info = model.summary()
+        assert info["total_params"] == 4 * 16 + 16 + 16 * 3 + 3
