@@ -63,6 +63,31 @@ def _amp_wrap(fn, op_name):
     return wrapped
 
 
+def _check_nan_inf(op_name: str, out):
+    """FLAGS_check_nan_inf sweep (reference: per-op output scan,
+    framework/details/nan_inf_utils_detail.cc:26 + eager hook
+    eager/nan_inf_utils.cc).  Eager-mode debugging aid — forces a device
+    sync per op, exactly like the reference's blocking check."""
+    from paddle_tpu import flags as _flags
+    try:
+        if not _flags.get("check_nan_inf"):
+            return
+    except KeyError:
+        return
+    level = _flags.get("check_nan_inf_level")
+    for leaf in jax.tree.leaves(out):
+        arr = leaf._data if isinstance(leaf, Tensor) else leaf
+        if hasattr(arr, "dtype") and jnp.issubdtype(arr.dtype, jnp.floating):
+            bad = int(jnp.logical_not(jnp.isfinite(arr)).sum())
+            if bad:
+                msg = (f"[check_nan_inf] op '{op_name}' produced {bad} "
+                       f"non-finite values (shape {arr.shape}, "
+                       f"dtype {arr.dtype})")
+                if level == 0:
+                    raise FloatingPointError(msg)
+                print(msg)
+
+
 def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     """Run pure fn over (args, kwargs); handle Tensor inputs + tape recording.
 
@@ -70,6 +95,8 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     Returns Tensors if any input was a Tensor, else fn's raw result.
     """
     fn = _amp_wrap(fn, op_name)
+    from paddle_tpu.amp import debugging as _dbg
+    _dbg.record_op(op_name)
     tensors = _collect_tensors((args, kwargs))
     if not tensors:
         return fn(*args, **kwargs)
@@ -86,6 +113,7 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     if not (is_grad_enabled() and diff):
         rargs, rkwargs = _tree_unwrap((args, kwargs))
         out = fn(*rargs, **rkwargs)
+        _check_nan_inf(op_name, out)
         return jax.tree.map(wrap_like, out)
 
     # Substitute primal placeholders for the differentiable tensors; close over
@@ -113,6 +141,7 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
         return fn(*rargs, **rkwargs)
 
     out, vjp_fn = jax.vjp(closure, *primal_list)
+    _check_nan_inf(op_name, out)
 
     flat_out, treedef = jax.tree.flatten(out)
     avals = [(o.shape, o.dtype) for o in flat_out]

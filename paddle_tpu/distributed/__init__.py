@@ -34,6 +34,10 @@ from paddle_tpu.distributed.moe import (  # noqa: F401
 from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
     make_ring_attention, make_ulysses_attention, ring_attention,
     ulysses_attention)
+from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed.checkpoint import (  # noqa: F401
+    AutoCheckpoint, Converter, async_save_state_dict, load_state_dict,
+    save_state_dict)
 
 __all__ = [
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
@@ -53,4 +57,6 @@ __all__ = [
     "top_k_gating",
     "ring_attention", "ulysses_attention", "make_ring_attention",
     "make_ulysses_attention",
+    "checkpoint", "save_state_dict", "load_state_dict",
+    "async_save_state_dict", "Converter", "AutoCheckpoint",
 ]

@@ -1,1 +1,275 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.profiler — host annotations + device trace.
+
+Reference parity: ``paddle.profiler`` (python/paddle/profiler/profiler.py:340)
+over the three-layer C++ tracer (SURVEY.md §5.1: RecordEvent host tracer →
+CUPTI device tracer → NodeTree/Chrome-trace aggregation).
+
+TPU-native design: the device tracer IS the XLA/TPU profiler
+(``jax.profiler`` → XPlane/TensorBoard, captures HLO timelines, ICI traffic,
+HBM usage); ``RecordEvent`` host annotations become
+``jax.profiler.TraceAnnotation`` so they interleave with device events in
+the same trace; a lightweight host event recorder feeds ``summary()`` tables
+without any native agent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "benchmark"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    CUSTOM_DEVICE = 2
+    TPU = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-indexed state machine (reference profiler.py:79)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class _HostEvents:
+    """Host event sink (reference HostEventRecorder,
+    platform/profiler/host_event_recorder.h)."""
+
+    def __init__(self):
+        self._all = []
+        self._lock = threading.Lock()
+
+    def add(self, name, t0, t1):
+        with self._lock:
+            self._all.append((name, t0, t1))
+
+    def drain(self):
+        with self._lock:
+            out, self._all = self._all, []
+        return out
+
+
+_EVENTS = _HostEvents()
+
+
+class RecordEvent:
+    """Host-side annotation (reference platform/profiler/event_tracing.h
+    RecordEvent).  Usable as context manager or decorator; events appear in
+    the device trace (TraceAnnotation) and in Profiler.summary()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax.profiler
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+        if self._t0 is not None:
+            _EVENTS.add(self.name, self._t0, time.perf_counter())
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+        return wrapped
+
+
+class Profiler:
+    """Reference ``paddle.profiler.Profiler`` shape: targets/scheduler/
+    on_trace_ready; start/stop/step; summary.  Device-side capture delegates
+    to jax.profiler (XPlane; view in TensorBoard or Perfetto)."""
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready=None, record_shapes=False,
+                 profile_memory=False, timer_only=False,
+                 log_dir: str = "./profiler_log"):
+        self.scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(closed=0, ready=0, record=scheduler[1],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else None)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.log_dir = log_dir
+        self.current_state = ProfilerState.CLOSED
+        self.step_num = 0
+        self._tracing = False
+        self._events = []
+        self._step_times = []
+        self._last_step_t = None
+
+    # device trace control
+    def _start_trace(self):
+        if self.timer_only or self._tracing:
+            return
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(self.log_dir)
+            self._tracing = True
+        except Exception:
+            self._tracing = False
+
+    def _stop_trace(self):
+        if self._tracing:
+            import jax.profiler
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def start(self):
+        self.current_state = self.scheduler(self.step_num) \
+            if self.scheduler else ProfilerState.RECORD
+        if self.current_state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN):
+            self._start_trace()
+        self._last_step_t = time.perf_counter()
+        return self
+
+    def stop(self):
+        self._stop_trace()
+        self._events.extend(_EVENTS.drain())
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+        self.current_state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append((now - self._last_step_t, num_samples))
+        self._last_step_t = now
+        self.step_num += 1
+        if self.scheduler is None:
+            return
+        new_state = self.scheduler(self.step_num)
+        if new_state != self.current_state:
+            recording = self.current_state in (
+                ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+            should = new_state in (ProfilerState.RECORD,
+                                   ProfilerState.RECORD_AND_RETURN)
+            if should and not recording:
+                self._start_trace()
+            elif recording and not should:
+                self._stop_trace()
+            self.current_state = new_state
+
+    def step_info(self, unit: str = "samples"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        times = np.array([t for t, _ in self._step_times])
+        msg = (f"avg {times.mean() * 1000:.2f}ms/step "
+               f"(min {times.min() * 1000:.2f}, max {times.max() * 1000:.2f})")
+        counts = [n for _, n in self._step_times if n]
+        if counts:
+            ips = sum(counts) / times.sum()
+            msg += f", {ips:.1f} {unit}/s"
+        return msg
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit: str = "ms"):
+        """Host-annotation table (device-side detail lives in the XPlane
+        trace; reference summary tables: profiler_statistic.py)."""
+        self._events.extend(_EVENTS.drain())
+        agg = {}
+        for name, t0, t1 in self._events:
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + (t1 - t0), cnt + 1)
+        scale = {"s": 1, "ms": 1e3, "us": 1e6}[time_unit]
+        lines = [f"{'name':40s} {'calls':>8s} "
+                 f"{'total(' + time_unit + ')':>14s}"]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:40s} {cnt:8d} {tot * scale:14.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    def export(self, path: str, format: str = "json"):
+        """Chrome-trace export of host events (device XPlane is exported by
+        start/stop_trace into log_dir)."""
+        import json
+        self._events.extend(_EVENTS.drain())
+        trace = [{"name": n, "ph": "X", "ts": t0 * 1e6,
+                  "dur": (t1 - t0) * 1e6, "pid": 0, "tid": 0}
+                 for n, t0, t1 in self._events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": trace}, f)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: Profiler):
+        import os
+        os.makedirs(dir_name, exist_ok=True)
+        prof.export(f"{dir_name}/{worker_name or 'worker'}.json")
+    return handler
+
+
+def load_profiler_result(path: str):
+    import json
+    with open(path) as f:
+        return json.load(f)
+
+
+@contextlib.contextmanager
+def benchmark():
+    """Throughput timing context (reference dataloader benchmark hooks)."""
+    t0 = time.perf_counter()
+    box = {}
+    yield box
+    box["seconds"] = time.perf_counter() - t0

@@ -1,0 +1,167 @@
+"""Aux subsystems: profiler, nan-inf debugging, distributed checkpoint +
+Converter re-slicing, AutoCheckpoint resume (SURVEY.md §5)."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pp
+import paddle_tpu.distributed as dist
+from paddle_tpu import profiler as prof_mod
+from paddle_tpu.amp.debugging import (DebugMode, TensorCheckerConfig,
+                                      check_numerics, collect_operator_stats,
+                                      compare_accuracy,
+                                      disable_tensor_checker,
+                                      enable_tensor_checker)
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self):
+        p = prof_mod.Profiler(timer_only=True).start()
+        with prof_mod.RecordEvent("myop"):
+            time.sleep(0.01)
+        with prof_mod.RecordEvent("myop"):
+            pass
+        p.stop()
+        table = p.summary()
+        assert "myop" in table
+
+    def test_scheduler_states(self):
+        sched = prof_mod.make_scheduler(closed=1, ready=1, record=2,
+                                        skip_first=1)
+        states = [sched(i) for i in range(6)]
+        assert states[0] == prof_mod.ProfilerState.CLOSED  # skip_first
+        assert states[1] == prof_mod.ProfilerState.CLOSED
+        assert states[2] == prof_mod.ProfilerState.READY
+        assert states[3] == prof_mod.ProfilerState.RECORD
+        assert states[4] == prof_mod.ProfilerState.RECORD_AND_RETURN
+
+    def test_step_info_and_export(self, tmp_path):
+        p = prof_mod.Profiler(timer_only=True).start()
+        for _ in range(3):
+            time.sleep(0.002)
+            p.step(num_samples=8)
+        p.stop()
+        info = p.step_info()
+        assert "ms/step" in info
+        out = str(tmp_path / "trace.json")
+        p.export(out)
+        assert prof_mod.load_profiler_result(out)["traceEvents"] is not None
+
+    def test_record_event_decorator(self):
+        @prof_mod.RecordEvent("decorated")
+        def f(x):
+            return x + 1
+        assert f(1) == 2
+
+
+class TestNanInfDebugging:
+    def test_check_nan_inf_flag_aborts(self):
+        enable_tensor_checker(TensorCheckerConfig(
+            enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT))
+        try:
+            a = pp.to_tensor([1.0, 0.0])
+            with pytest.raises(FloatingPointError, match="non-finite"):
+                _ = a / pp.to_tensor([1.0, 0.0])
+        finally:
+            disable_tensor_checker()
+        # after disable: no raise
+        b = pp.to_tensor([1.0, 0.0]) / pp.to_tensor([1.0, 0.0])
+        assert not np.isfinite(b.numpy()).all()
+
+    def test_check_numerics_counts(self):
+        arr = np.array([1.0, np.nan, np.inf, 0.0])
+        with pytest.raises(FloatingPointError):
+            check_numerics(arr)
+        nan, inf, zero = check_numerics(arr,
+                                        debug_mode=DebugMode.CHECK_NAN_INF)
+        assert (nan, inf, zero) == (1, 1, 1)
+
+    def test_compare_accuracy(self):
+        a = {"w": np.ones(3), "b": np.zeros(2)}
+        b = {"w": np.ones(3) + 1e-8, "b": np.ones(2)}
+        rep = {r["name"]: r for r in compare_accuracy(a, b)}
+        assert rep["w"]["status"] == "ok"
+        assert rep["b"]["status"] == "mismatch"
+
+    def test_operator_stats(self):
+        with collect_operator_stats():
+            x = pp.to_tensor([1.0]) + pp.to_tensor([2.0])
+        from paddle_tpu.amp.debugging import _OP_STATS
+        # counts were printed + returned on disable; re-enable to inspect
+        assert x is not None
+
+
+class TestDistributedCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        state = {"layer.weight": jnp.arange(12.0).reshape(3, 4),
+                 "layer.bias": jnp.zeros(4)}
+        path = str(tmp_path / "ckpt")
+        dist.save_state_dict(state, path)
+        loaded = dist.load_state_dict(path)
+        np.testing.assert_allclose(np.asarray(loaded["layer.weight"]),
+                                   np.arange(12.0).reshape(3, 4))
+
+    def test_load_with_resharding(self, tmp_path):
+        """Save unsharded, load onto a 2x4 mesh with TP sharding — the
+        Converter story."""
+        state = {"w": jnp.arange(64.0).reshape(8, 8)}
+        path = str(tmp_path / "ckpt")
+        dist.save_state_dict(state, path)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "mp"))
+        loaded = dist.load_state_dict(path, mesh=mesh,
+                                      specs={"w": P(None, "mp")})
+        assert loaded["w"].sharding.spec == P(None, "mp")
+        np.testing.assert_allclose(np.asarray(loaded["w"]),
+                                   np.arange(64.0).reshape(8, 8))
+
+    def test_async_save(self, tmp_path):
+        state = {"w": jnp.ones((64, 64))}
+        path = str(tmp_path / "ckpt")
+        h = dist.async_save_state_dict(state, path)
+        h.wait()
+        assert os.path.exists(os.path.join(path, "checkpoint_meta.json"))
+
+    def test_converter_merge_slice_roundtrip(self):
+        g = np.arange(32.0).reshape(4, 8)
+        attr = {"dims_mapping": [-1, 0], "process_shape": [4],
+                "process_group": [0, 1, 2, 3]}
+        shards = dist.Converter.slice_with_dist_attr(g, attr)
+        assert shards[0].shape == (4, 2)
+        merged = dist.Converter.merge_with_dist_attr(shards, attr)
+        np.testing.assert_allclose(merged, g)
+
+    def test_converter_2d_mesh(self):
+        g = np.arange(64.0).reshape(8, 8)
+        attr = {"dims_mapping": [1, 0], "process_shape": [2, 2],
+                "process_group": [0, 1, 2, 3]}
+        shards = dist.Converter.slice_with_dist_attr(g, attr)
+        assert shards[0].shape == (4, 4)
+        merged = dist.Converter.merge_with_dist_attr(shards, attr)
+        np.testing.assert_allclose(merged, g)
+
+    def test_autocheckpoint_resume_and_gc(self, tmp_path):
+        ac = dist.AutoCheckpoint(str(tmp_path / "auto"), keep=2,
+                                 save_interval_steps=10)
+        assert ac.latest_step() is None
+        for step in (10, 20, 30):
+            h = ac.maybe_save(step, {"w": jnp.full((2,), float(step))})
+        if h:
+            h.wait()
+        step, state = ac.restore_latest()
+        assert step == 30
+        np.testing.assert_allclose(np.asarray(state["w"]), 30.0)
+        # keep=2 → step_10 garbage-collected
+        assert ac.latest_step() == 30
+        dirs = sorted(os.listdir(str(tmp_path / "auto")))
+        assert len([d for d in dirs if d.startswith("step_")]) <= 2
+
+    def test_maybe_save_skips_off_interval(self, tmp_path):
+        ac = dist.AutoCheckpoint(str(tmp_path / "auto2"),
+                                 save_interval_steps=100)
+        assert ac.maybe_save(7, {"w": jnp.zeros(2)}) is None
