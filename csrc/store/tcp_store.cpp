@@ -1,0 +1,293 @@
+// TCPStore — native key/value rendezvous for multi-host bootstrap.
+//
+// TPU-native equivalent of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.{h,cc} + socket.cpp):
+// a tiny KV server the first host runs, that all hosts use to exchange
+// coordinator addresses / barrier before jax.distributed takes over, plus
+// generic set/get/add/wait for user-level control-plane sync (the role
+// brpc MessageBus / c_gen_nccl_id play in the reference).
+//
+// Protocol (all little-endian):
+//   request:  u8 cmd | u32 keylen | key | (SET: u32 vallen | val)
+//                                        (ADD: i64 delta)
+//                                        (GET/CHECK: nothing)
+//   response: SET -> u8 ok
+//             GET -> u32 vallen | val   (vallen == 0xFFFFFFFF => not found)
+//             ADD -> i64 new_value
+//             CHECK -> u8 present
+//
+// Exposed as extern "C" for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kCheck = 4 };
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+class Server {
+ public:
+  explicit Server(int port) : port_(port) {}
+
+  bool start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) < 0)
+      return false;
+    if (::listen(listen_fd_, 128) < 0) return false;
+    running_.store(true);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void stop() {
+    running_.store(false);
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    std::lock_guard<std::mutex> lk(conn_mu_);
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+  }
+
+ private:
+  void AcceptLoop() {
+    while (running_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (!running_.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (true) {
+      uint8_t cmd;
+      if (!recv_all(fd, &cmd, 1)) break;
+      uint32_t keylen;
+      if (!recv_all(fd, &keylen, 4)) break;
+      std::string key(keylen, '\0');
+      if (keylen && !recv_all(fd, key.data(), keylen)) break;
+
+      if (cmd == kSet) {
+        uint32_t vallen;
+        if (!recv_all(fd, &vallen, 4)) break;
+        std::string val(vallen, '\0');
+        if (vallen && !recv_all(fd, val.data(), vallen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          data_[key] = std::move(val);
+        }
+        cv_.notify_all();
+        uint8_t ok = 1;
+        if (!send_all(fd, &ok, 1)) break;
+      } else if (cmd == kGet) {
+        std::string val;
+        bool found = false;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = data_.find(key);
+          if (it != data_.end()) {
+            val = it->second;
+            found = true;
+          }
+        }
+        uint32_t vallen = found ? static_cast<uint32_t>(val.size())
+                                : 0xFFFFFFFFu;
+        if (!send_all(fd, &vallen, 4)) break;
+        if (found && !val.empty() && !send_all(fd, val.data(), val.size()))
+          break;
+      } else if (cmd == kAdd) {
+        int64_t delta;
+        if (!recv_all(fd, &delta, 8)) break;
+        int64_t result;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = data_.find(key);
+          if (it != data_.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string val(8, '\0');
+          std::memcpy(val.data(), &cur, 8);
+          data_[key] = std::move(val);
+          result = cur;
+        }
+        cv_.notify_all();
+        if (!send_all(fd, &result, 8)) break;
+      } else if (cmd == kCheck) {
+        uint8_t present;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          present = data_.count(key) ? 1 : 0;
+        }
+        if (!send_all(fd, &present, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> running_{false};
+  std::thread accept_thread_;
+  std::mutex conn_mu_;
+  std::vector<std::thread> conn_threads_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> data_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcpstore_server_start(int port) {
+  auto* s = new Server(port);
+  if (!s->start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  if (s) {
+    s->stop();
+    delete s;
+  }
+}
+
+int tcpstore_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (true) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return -1;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() > deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+int tcpstore_set(int fd, const char* key, const uint8_t* val, int vallen) {
+  uint8_t cmd = kSet;
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  uint32_t vl = static_cast<uint32_t>(vallen);
+  if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
+      !send_all(fd, key, keylen) || !send_all(fd, &vl, 4) ||
+      (vallen && !send_all(fd, val, vl)))
+    return -1;
+  uint8_t ok;
+  return recv_all(fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// Returns value length, -1 on error, -2 if not present.
+int tcpstore_get(int fd, const char* key, uint8_t* out, int out_cap) {
+  uint8_t cmd = kGet;
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
+      !send_all(fd, key, keylen))
+    return -1;
+  uint32_t vallen;
+  if (!recv_all(fd, &vallen, 4)) return -1;
+  if (vallen == 0xFFFFFFFFu) return -2;
+  if (vallen > static_cast<uint32_t>(out_cap)) {
+    // drain and report error
+    std::vector<char> sink(vallen);
+    recv_all(fd, sink.data(), vallen);
+    return -1;
+  }
+  if (vallen && !recv_all(fd, out, vallen)) return -1;
+  return static_cast<int>(vallen);
+}
+
+int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
+  uint8_t cmd = kAdd;
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
+      !send_all(fd, key, keylen) || !send_all(fd, &delta, 8))
+    return INT64_MIN;
+  int64_t result;
+  if (!recv_all(fd, &result, 8)) return INT64_MIN;
+  return result;
+}
+
+int tcpstore_check(int fd, const char* key) {
+  uint8_t cmd = kCheck;
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
+      !send_all(fd, key, keylen))
+    return -1;
+  uint8_t present;
+  if (!recv_all(fd, &present, 1)) return -1;
+  return present;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+}  // extern "C"

@@ -1,0 +1,136 @@
+"""TCPStore — native rendezvous KV store (ctypes over csrc/store).
+
+Reference parity: ``paddle.distributed`` TCPStore
+(paddle/phi/core/distributed/store/tcp_store.h:120 — the bootstrap that
+``init_parallel_env`` uses to exchange NCCL ids).  On TPU the heavy comm
+setup is ``jax.distributed``; this store covers (a) pre-jax rendezvous —
+electing/advertising the coordinator address — and (b) user control-plane
+sync (barriers, small blobs) the reference exposes on its store.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import time
+from typing import Optional
+
+__all__ = ["TCPStore"]
+
+
+def _lib():
+    from paddle_tpu.utils.cpp_extension import load_native
+    lib = load_native("store")
+    lib.tcpstore_server_start.restype = ctypes.c_void_p
+    lib.tcpstore_server_start.argtypes = [ctypes.c_int]
+    lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_connect.restype = ctypes.c_int
+    lib.tcpstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int]
+    lib.tcpstore_set.restype = ctypes.c_int
+    lib.tcpstore_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_get.restype = ctypes.c_int
+    lib.tcpstore_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_add.restype = ctypes.c_int64
+    lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.tcpstore_check.restype = ctypes.c_int
+    lib.tcpstore_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.tcpstore_close.argtypes = [ctypes.c_int]
+    return lib
+
+
+class TCPStore:
+    """API parity with the reference TCPStore: set/get/add/wait + barrier.
+
+    is_master=True starts the native server in-process (host 0); every
+    process (master included) connects a client."""
+
+    def __init__(self, host: str, port: int, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0):
+        self._lib = _lib()
+        self._server = None
+        self.world_size = world_size
+        self.timeout = timeout
+        if is_master:
+            self._server = self._lib.tcpstore_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+        self._fd = self._lib.tcpstore_connect(
+            host.encode(), port, int(timeout * 1000))
+        if self._fd < 0:
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    def set(self, key: str, value):
+        data = value if isinstance(value, bytes) else str(value).encode()
+        rc = self._lib.tcpstore_set(self._fd, key.encode(), data, len(data))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str, wait: bool = True) -> bytes:
+        """Blocking get (reference semantics: waits for the key)."""
+        buf = ctypes.create_string_buffer(1 << 20)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            n = self._lib.tcpstore_get(self._fd, key.encode(), buf,
+                                       len(buf))
+            if n >= 0:
+                return buf.raw[:n]
+            if n == -1:
+                raise RuntimeError("TCPStore.get failed")
+            if not wait:
+                raise KeyError(key)
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"TCPStore.get({key}) timed out")
+            time.sleep(0.01)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        v = self._lib.tcpstore_add(self._fd, key.encode(), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(v)
+
+    def check(self, key: str) -> bool:
+        rc = self._lib.tcpstore_check(self._fd, key.encode())
+        if rc < 0:
+            raise RuntimeError("TCPStore.check failed")
+        return bool(rc)
+
+    def wait(self, keys, timeout: Optional[float] = None):
+        if isinstance(keys, str):
+            keys = [keys]
+        deadline = time.monotonic() + (timeout or self.timeout)
+        for k in keys:
+            while not self.check(k):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"TCPStore.wait({k}) timed out")
+                time.sleep(0.01)
+
+    def barrier(self, name: str = "barrier"):
+        """All world_size processes rendezvous (reference barrier via
+        counting key)."""
+        n = self.add(f"__{name}_count", 1)
+        target = self.world_size
+        deadline = time.monotonic() + self.timeout
+        while n < target:
+            cur = self.add(f"__{name}_count", 0)
+            if cur >= target:
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError("barrier timed out")
+            time.sleep(0.01)
+
+    def close(self):
+        if self._fd is not None and self._fd >= 0:
+            self._lib.tcpstore_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.tcpstore_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

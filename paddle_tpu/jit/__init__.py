@@ -13,8 +13,11 @@ from __future__ import annotations
 import jax
 
 from paddle_tpu.jit.train_step import TrainStep
+from paddle_tpu.jit.save_load import (InputSpec, TranslatedLayer, load,
+                                      save)
 
-__all__ = ["TrainStep", "to_static"]
+__all__ = ["TrainStep", "to_static", "save", "load", "InputSpec",
+           "TranslatedLayer"]
 
 
 def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):

@@ -1,0 +1,144 @@
+"""jit.save / jit.load — deployable compiled artifacts.
+
+Reference parity: ``paddle.jit.save`` (jit/api.py) writes ``model.pdmodel``
+(ProgramDesc) + ``model.pdiparams``; ``paddle.jit.load`` returns a
+``TranslatedLayer``; the C++ serving side loads the same artifact
+(fluid/inference/io.cc, fluid/jit/serializer.cc).
+
+TPU-native artifact: StableHLO.  ``save`` traces the Layer's forward with
+parameters as constants-free inputs, serializes via ``jax.export``
+(portable StableHLO bytes) alongside the parameters (npz) and a JSON meta —
+``<path>.pdmodel`` (stablehlo), ``<path>.pdiparams`` (npz),
+``<path>.pdmeta`` (json).  ``load`` restores a ``TranslatedLayer`` that
+runs the deserialized executable; the native predictor shim (csrc/) reads
+the same files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["save", "load", "InputSpec", "TranslatedLayer"]
+
+
+class InputSpec:
+    """Reference ``paddle.static.InputSpec`` parity."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_sds(self):
+        import jax
+        from paddle_tpu.core.dtypes import to_jax
+        shape = tuple(1 if d is None or d < 0 else d for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, to_jax(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save(layer, path: str, input_spec: Optional[List] = None, **configs):
+    """Serialize `layer` (or a to_static-wrapped fn) for inference."""
+    import jax
+    from jax import export as jexport
+    from paddle_tpu.core.functional import functional_call, params_of
+    from paddle_tpu.nn.layer import Layer
+
+    target = getattr(layer, "__wrapped__", layer)
+    if not isinstance(target, Layer):
+        raise TypeError("jit.save expects a Layer (or to_static(Layer))")
+
+    if input_spec is None:
+        raise ValueError("jit.save on TPU requires input_spec (shapes are "
+                         "compiled; provide InputSpec/example tensors)")
+    sds = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            sds.append(spec.to_sds())
+        elif hasattr(spec, "_data"):
+            sds.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                            spec._data.dtype))
+        else:
+            arr = np.asarray(spec)
+            sds.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+    params = params_of(target)
+    param_names = sorted(params)
+
+    def pure(params_tuple, *inputs):
+        pdict = dict(zip(param_names, params_tuple))
+        out = functional_call(target, pdict, *inputs)
+        return jax.tree.map(
+            lambda t: t._data if hasattr(t, "_data") else t, out,
+            is_leaf=lambda t: hasattr(t, "_data"))
+
+    params_sds = tuple(jax.ShapeDtypeStruct(params[n].shape,
+                                            params[n].dtype)
+                       for n in param_names)
+    exp = jexport.export(jax.jit(pure))(params_sds, *sds)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    np.savez(path + ".pdiparams",
+             **{n: np.asarray(params[n]) for n in param_names})
+    meta = {
+        "format": "stablehlo-jax-export-v1",
+        "param_names": param_names,
+        "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
+                   for s in sds],
+        "mlir_preview": exp.mlir_module()[:2000],
+    }
+    with open(path + ".pdmeta", "w") as f:
+        json.dump(meta, f, indent=2)
+    return path
+
+
+class TranslatedLayer:
+    """Loaded inference layer (reference TranslatedLayer,
+    jit/translated_layer.py): call like the original Layer."""
+
+    def __init__(self, exported, params_tuple, meta):
+        self._exported = exported
+        self._params = params_tuple
+        self._meta = meta
+
+    def __call__(self, *inputs):
+        import jax.numpy as jnp
+        from paddle_tpu.core.dispatch import wrap_like
+        raw = tuple(jnp.asarray(
+            x._data if hasattr(x, "_data") else np.asarray(x))
+            for x in inputs)
+        out = self._exported.call(self._params, *raw)
+        import jax
+        return jax.tree.map(wrap_like, out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    @property
+    def input_specs(self):
+        return self._meta["inputs"]
+
+
+def load(path: str) -> TranslatedLayer:
+    import jax.numpy as jnp
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    archive = np.load(path + ".pdiparams.npz"
+                      if os.path.exists(path + ".pdiparams.npz")
+                      else path + ".pdiparams")
+    params = tuple(jnp.asarray(archive[n]) for n in meta["param_names"])
+    return TranslatedLayer(exp, params, meta)

@@ -1,0 +1,272 @@
+"""paddle_tpu.quantization — PTQ / QAT.
+
+Reference parity: ``paddle.quantization`` (python/paddle/quantization/:
+QuantConfig + PTQ/QAT entries (quantize.py, ptq.py, qat.py), observers
+(observers/abs_max.py …), quanters (quanters/act_lsq.py …)).
+
+TPU-native notes: int8 matmuls hit the MXU natively, so the payoff layer is
+weight-only / weight+act symmetric int8 GEMM.  Fake-quant in QAT uses the
+straight-through estimator; conversion produces ``QuantedLinear`` whose
+forward runs the int8 kernel shape (dequant folded into the output scale —
+XLA fuses it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.core.dispatch import eager_op, unwrap, wrap_like
+
+__all__ = ["AbsMaxObserver", "MovingAverageAbsMaxObserver", "QuantConfig",
+           "PTQ", "QAT", "FakeQuantLinear", "QuantedLinear",
+           "quant_dequant", "quantize_weight"]
+
+
+# -- quant math --------------------------------------------------------------
+
+def _absmax_scale(x, bits: int = 8):
+    qmax = 2.0 ** (bits - 1) - 1
+    return jnp.maximum(jnp.max(jnp.abs(x)), 1e-8) / qmax
+
+
+@eager_op
+def quant_dequant(x, scale, bits: int = 8):
+    """Symmetric fake-quant with straight-through gradient."""
+    qmax = 2.0 ** (bits - 1) - 1
+
+    @jax.custom_vjp
+    def _qdq(v, s):
+        q = jnp.clip(jnp.round(v / s), -qmax - 1, qmax)
+        return q * s
+
+    def _fwd(v, s):
+        return _qdq(v, s), (v, s)
+
+    def _bwd(res, g):
+        v, s = res
+        # STE: pass gradient through where un-clipped
+        mask = (jnp.abs(v / s) <= qmax + 1).astype(g.dtype)
+        return g * mask, jnp.zeros_like(s)
+
+    _qdq.defvjp(_fwd, _bwd)
+    return _qdq(x, scale)
+
+
+def quantize_weight(w, bits: int = 8, axis: Optional[int] = None):
+    """Real quantization: returns (int8 values, fp scale).  Per-channel if
+    `axis` given (the out-features axis for linear weights)."""
+    w = unwrap(w)
+    qmax = 2.0 ** (bits - 1) - 1
+    if axis is None:
+        scale = _absmax_scale(w, bits)
+    else:
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=red, keepdims=True),
+                            1e-8) / qmax
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale
+
+
+# -- observers ---------------------------------------------------------------
+
+class AbsMaxObserver:
+    """reference observers/abs_max.py: running max(|x|) → scale."""
+
+    def __init__(self, quant_bits: int = 8):
+        self.bits = quant_bits
+        self._absmax = 0.0
+
+    def observe(self, x):
+        arr = unwrap(x)
+        self._absmax = max(self._absmax, float(jnp.max(jnp.abs(arr))))
+
+    __call__ = observe
+
+    def scale(self):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        return max(self._absmax, 1e-8) / qmax
+
+
+class MovingAverageAbsMaxObserver(AbsMaxObserver):
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.rate = moving_rate
+        self._initialized = False
+
+    def observe(self, x):
+        arr = unwrap(x)
+        cur = float(jnp.max(jnp.abs(arr)))
+        if not self._initialized:
+            self._absmax = cur
+            self._initialized = True
+        else:
+            self._absmax = self.rate * self._absmax + (1 - self.rate) * cur
+
+    __call__ = observe
+
+
+# -- config ------------------------------------------------------------------
+
+class QuantConfig:
+    """reference quantization/config.py shape: which layer types get which
+    observer/quanter."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation_factory = activation or AbsMaxObserver
+        self.weight_factory = weight or AbsMaxObserver
+        self._layer_types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._layer_types.extend(layer_types)
+
+    def should_quantize(self, layer) -> bool:
+        from paddle_tpu.nn.common_layers import Linear
+        types = self._layer_types or [Linear]
+        return isinstance(layer, tuple(types))
+
+
+# -- quantized layers --------------------------------------------------------
+
+class FakeQuantLinear(Layer):
+    """QAT wrapper: fake-quant weight (and optionally activation) around the
+    wrapped Linear, STE gradients (reference quanters)."""
+
+    def __init__(self, linear, weight_bits: int = 8, act_bits: int = 8,
+                 quant_act: bool = True):
+        super().__init__()
+        self.linear = linear
+        self.weight_bits = weight_bits
+        self.act_bits = act_bits
+        self.quant_act = quant_act
+        self.act_observer = MovingAverageAbsMaxObserver(act_bits)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        w = self.linear.weight
+        w_scale = _absmax_scale(unwrap(w), self.weight_bits)
+        wq = quant_dequant(w, w_scale, bits=self.weight_bits)
+        if self.quant_act:
+            self.act_observer.observe(x)
+            xq = quant_dequant(x, jnp.asarray(self.act_observer.scale()),
+                               bits=self.act_bits)
+        else:
+            xq = x
+        return F.linear(xq, wq, self.linear.bias)
+
+
+class QuantedLinear(Layer):
+    """Converted inference layer: int8 weights at rest; the int8×int8→int32
+    GEMM shape XLA maps onto the MXU, output rescaled by (x_scale*w_scale)."""
+
+    def __init__(self, linear, act_scale: Optional[float] = None,
+                 bits: int = 8):
+        super().__init__()
+        q, scale = quantize_weight(linear.weight, bits=bits, axis=1)
+        self.register_buffer("qweight", wrap_like(q))
+        self.register_buffer("w_scale", wrap_like(scale.reshape(-1)))
+        self.bias = linear.bias
+        self.act_scale = act_scale
+        self.bits = bits
+
+    def forward(self, x):
+        xr = unwrap(x)
+        qw = unwrap(self.qweight)
+        ws = unwrap(self.w_scale)
+        if self.act_scale is not None:
+            qmax = 2.0 ** (self.bits - 1) - 1
+            xq = jnp.clip(jnp.round(xr / self.act_scale), -qmax - 1,
+                          qmax).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, qw, (((xr.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (self.act_scale * ws)
+        else:  # weight-only
+            out = xr @ (qw.astype(xr.dtype) * ws.astype(xr.dtype))
+        if self.bias is not None:
+            out = out + unwrap(self.bias)
+        return wrap_like(out.astype(xr.dtype))
+
+
+def _walk_replace(root: Layer, config: QuantConfig, make):
+    from paddle_tpu.nn.common_layers import Linear
+    for name, child in list(root.named_children()):
+        if config.should_quantize(child) and isinstance(child, Linear):
+            setattr(root, name, make(child))
+        else:
+            _walk_replace(child, config, make)
+
+
+class PTQ:
+    """Post-training quantization (reference ptq.py): wrap → calibrate
+    (observers collect act ranges) → convert (int8 layers)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        self._observers: Dict[int, MovingAverageAbsMaxObserver] = {}
+
+        def make(linear):
+            wrapper = FakeQuantLinear(linear, quant_act=True)
+            # PTQ calibration: observe only, don't fake-quant weights yet
+            obs = wrapper.act_observer
+
+            class _Calib(Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.inner = linear
+                    self.obs = obs
+
+                def forward(self, x):
+                    self.obs.observe(x)
+                    return self.inner(x)
+            c = _Calib()
+            self._observers[id(linear)] = obs
+            c._ptq_target = linear
+            return c
+        _walk_replace(model, self.config, make)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        def unwrap_calib(root):
+            for name, child in list(root.named_children()):
+                if hasattr(child, "_ptq_target"):
+                    linear = child._ptq_target
+                    setattr(root, name, QuantedLinear(
+                        linear, act_scale=child.obs.scale()))
+                else:
+                    unwrap_calib(child)
+        unwrap_calib(model)
+        return model
+
+
+class QAT:
+    """Quantization-aware training (reference qat.py): insert fake-quant
+    wrappers; after training, convert to int8 inference layers."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        _walk_replace(self.config and model, self.config,
+                      lambda lin: FakeQuantLinear(lin))
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        def conv(root):
+            for name, child in list(root.named_children()):
+                if isinstance(child, FakeQuantLinear):
+                    setattr(root, name, QuantedLinear(
+                        child.linear, act_scale=child.act_observer.scale()
+                        if child.quant_act else None))
+                else:
+                    conv(child)
+        conv(model)
+        return model

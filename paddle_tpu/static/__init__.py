@@ -1,1 +1,38 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.static — "static graph" user API.
+
+Reference parity: ``paddle.static`` (Program/Executor user API,
+python/paddle/static/).  On TPU the static-graph mode IS jax.jit: a traced
+jaxpr compiled by XLA replaces ProgramDesc + InterpreterCore (SURVEY.md
+§3.2).  What survives of the API surface:
+
+* ``InputSpec`` — shape/dtype declaration (shared with jit.save)
+* ``save_inference_model`` / ``load_inference_model`` — thin veneers over
+  jit.save/jit.load producing the same artifacts
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.jit.save_load import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Reference signature parity.  `fetch_vars` must be (or wrap) a Layer —
+    in this framework the deployable unit is a Layer, not a Program."""
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.nn.layer import Layer
+    layer = kwargs.get("layer")
+    if layer is None and isinstance(fetch_vars, Layer):
+        layer = fetch_vars
+    if layer is None:
+        raise ValueError(
+            "save_inference_model on TPU serializes a Layer: pass "
+            "layer=<Layer> (the Program abstraction does not exist here)")
+    return jit_save(layer, path_prefix, input_spec=feed_vars)
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    from paddle_tpu.jit import load as jit_load
+    return jit_load(path_prefix)

@@ -1,1 +1,20 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.utils — native-extension loading + misc helpers.
+
+Reference parity: ``paddle.utils`` incl. ``cpp_extension`` (the JIT
+build-and-load toolchain for custom C++ ops,
+python/paddle/utils/cpp_extension/).  Here the native surface is the
+C++ runtime components under csrc/ (TCPStore rendezvous, datafeed),
+built with make+g++ and bound via ctypes (no pybind11 in this image).
+"""
+
+from paddle_tpu.utils.cpp_extension import load_native  # noqa: F401
+
+__all__ = ["load_native"]
+
+
+def try_import(name: str):
+    try:
+        import importlib
+        return importlib.import_module(name)
+    except ImportError:
+        return None

@@ -1,0 +1,50 @@
+"""Build + load the native C++ components.
+
+Reference parity: ``paddle.utils.cpp_extension`` (cpp_extension.py — JIT
+nvcc/ninja build of custom ops, loaded via dlopen).  TPU-side there is no
+device code to compile; the native pieces are host runtime (csrc/): built
+with `make`, loaded with ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIBDIR = os.path.join(_REPO, "paddle_tpu", "lib")
+_CSRC = os.path.join(_REPO, "csrc")
+_lock = threading.Lock()
+_cache = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _build():
+    res = subprocess.run(["make", "-C", _CSRC, "-j"],
+                         capture_output=True, text=True)
+    if res.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{res.stdout}\n{res.stderr}")
+
+
+def load_native(name: str, build_if_missing: bool = True
+                ) -> Optional[ctypes.CDLL]:
+    """Load libpt_<name>.so, building csrc/ on first use."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        path = os.path.join(_LIBDIR, f"libpt_{name}.so")
+        if not os.path.exists(path):
+            if not build_if_missing:
+                return None
+            _build()
+        lib = ctypes.CDLL(path)
+        _cache[name] = lib
+        return lib

@@ -1,0 +1,112 @@
+"""Quantization tests: observers, fake-quant STE, PTQ calibrate/convert,
+QAT train/convert (reference: test/quantization/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.quantization import (AbsMaxObserver, FakeQuantLinear,
+                                     MovingAverageAbsMaxObserver, PTQ, QAT,
+                                     QuantConfig, QuantedLinear,
+                                     quant_dequant, quantize_weight)
+
+
+class TestQuantMath:
+    def test_quant_dequant_roundtrip_error_bounded(self):
+        import jax.numpy as jnp
+        x = pp.randn([64])
+        scale = jnp.asarray(float(np.abs(x.numpy()).max()) / 127.0)
+        y = quant_dequant(x, scale)
+        err = np.abs(y.numpy() - x.numpy()).max()
+        assert err <= float(scale) / 2 + 1e-7
+
+    def test_ste_gradient_passes_through(self):
+        import jax, jax.numpy as jnp
+        scale = jnp.asarray(0.1)
+
+        def f(v):
+            return quant_dequant(v, scale).sum()
+        g = jax.grad(f)(jnp.asarray([0.5, -0.3, 100.0]))
+        np.testing.assert_allclose(np.asarray(g), [1.0, 1.0, 0.0])
+
+    def test_quantize_weight_per_channel(self):
+        w = pp.randn([8, 4])
+        q, scale = quantize_weight(w, axis=1)
+        assert q.dtype == np.int8 and scale.shape == (1, 4)
+        deq = np.asarray(q, np.float32) * np.asarray(scale)
+        assert np.abs(deq - w.numpy()).max() < np.abs(w.numpy()).max() / 64
+
+
+class TestObservers:
+    def test_absmax(self):
+        obs = AbsMaxObserver()
+        obs(pp.to_tensor([1.0, -3.0]))
+        obs(pp.to_tensor([2.0]))
+        assert obs.scale() == pytest.approx(3.0 / 127)
+
+    def test_moving_average(self):
+        obs = MovingAverageAbsMaxObserver(moving_rate=0.5)
+        obs(pp.to_tensor([4.0]))
+        obs(pp.to_tensor([2.0]))
+        assert obs._absmax == pytest.approx(3.0)
+
+
+def _mlp():
+    pp.seed(3)
+    return pp.nn.Sequential(pp.nn.Linear(8, 32), pp.nn.ReLU(),
+                            pp.nn.Linear(32, 4))
+
+
+class TestPTQ:
+    def test_calibrate_convert_accuracy(self):
+        net = _mlp()
+        x = pp.randn([16, 8])
+        ref = net(x).numpy()
+
+        ptq = PTQ()
+        net = ptq.quantize(net)
+        for _ in range(4):  # calibration passes
+            net(x)
+        net = ptq.convert(net)
+        # converted layers are int8
+        assert isinstance(net[0], QuantedLinear)
+        assert net[0].qweight.numpy().dtype == np.int8
+        out = net(x).numpy()
+        # int8 PTQ: small relative error on this scale of net
+        rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert rel < 0.1, rel
+
+
+class TestQAT:
+    def test_fake_quant_trains_and_converts(self):
+        net = _mlp()
+        qat = QAT()
+        net = qat.quantize(net)
+        assert isinstance(net[0], FakeQuantLinear)
+
+        opt = pp.optimizer.Adam(learning_rate=1e-2,
+                                parameters=net.parameters())
+        x = pp.randn([32, 8])
+        y = pp.to_tensor((np.arange(32) % 4).astype(np.int64))
+
+        losses = []
+        for _ in range(20):
+            out = net(x)
+            loss = pp.nn.functional.cross_entropy(out, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+        net = qat.convert(net)
+        assert isinstance(net[0], QuantedLinear)
+        out = net(x)
+        assert tuple(out.shape) == (32, 4)
+
+    def test_weight_only_quanted_linear(self):
+        lin = pp.nn.Linear(16, 8)
+        q = QuantedLinear(lin, act_scale=None)
+        x = pp.randn([4, 16])
+        np.testing.assert_allclose(q(x).numpy(), lin(x).numpy(),
+                                   rtol=0.1, atol=0.05)
