@@ -8,6 +8,14 @@ because it is the benchmark flagship (BASELINE.md: Llama-3-8B pretraining).
 from paddle_tpu.models.llama import (LlamaAttention, LlamaConfig,
                                      LlamaDecoderLayer, LlamaForCausalLM,
                                      LlamaMLP, LlamaModel)
+from paddle_tpu.models.gpt import (GPTConfig, GPTDecoderLayer, GPTForCausalLM,
+                                   GPTModel)
+from paddle_tpu.models.moe_llm import (MoEConfig, MoEDecoderLayer,
+                                       MoEForCausalLM, MoEModel)
+from paddle_tpu.models.dit import DiT, DiTBlock, DiTConfig
 
 __all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
-           "LlamaModel", "LlamaForCausalLM"]
+           "LlamaModel", "LlamaForCausalLM",
+           "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
+           "MoEConfig", "MoEDecoderLayer", "MoEModel", "MoEForCausalLM",
+           "DiTConfig", "DiTBlock", "DiT"]

@@ -1,0 +1,258 @@
+"""DiT — Diffusion Transformer (the DiT/SD3-class vision generative model).
+
+BASELINE.md lists DiT / Stable-Diffusion-3 among the target configs; the
+reference would build this from its vision + transformer layers.  This is
+the standard DiT-XL/2 architecture (Peebles & Xie): patchify → N blocks of
+[adaLN-Zero(modulated) self-attention + MLP] conditioned on (timestep,
+class) embeddings → linear unpatchify predicting noise (and optionally
+sigma).
+
+TPU-native choices: patchify as a single conv-free reshape+matmul (MXU),
+fp32 sinusoidal timestep embedding, all sequence ops static-shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common_layers import Embedding, Linear
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.norm_layers import LayerNorm
+from paddle_tpu.ops import manipulation as M
+
+__all__ = ["DiTConfig", "DiTBlock", "DiT"]
+
+
+@dataclasses.dataclass
+class DiTConfig:
+    input_size: int = 32          # latent H=W
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    depth: int = 28
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+    dtype: str = "float32"
+
+    @property
+    def num_patches(self):
+        return (self.input_size // self.patch_size) ** 2
+
+    @staticmethod
+    def dit_xl_2():
+        return DiTConfig(depth=28, hidden_size=1152, num_heads=16,
+                         patch_size=2)
+
+    @staticmethod
+    def tiny(**over):
+        cfg = dict(input_size=8, patch_size=2, in_channels=4,
+                   hidden_size=64, depth=2, num_heads=4, num_classes=10)
+        cfg.update(over)
+        return DiTConfig(**cfg)
+
+
+def timestep_embedding(t, dim: int, max_period: float = 10000.0):
+    """Sinusoidal timestep embedding in fp32 ([N] → [N, dim])."""
+    from paddle_tpu.core.dispatch import unwrap
+    t = unwrap(t).astype(jnp.float32)
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period)
+                    * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t[:, None] * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class TimestepEmbedder(Layer):
+    def __init__(self, hidden_size: int, freq_dim: int = 256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.fc1 = Linear(freq_dim, hidden_size)
+        self.fc2 = Linear(hidden_size, hidden_size)
+
+    def forward(self, t):
+        emb = timestep_embedding(t, self.freq_dim)
+        return self.fc2(F.silu(self.fc1(emb)))
+
+
+class LabelEmbedder(Layer):
+    """Class embedding with a null class for classifier-free guidance."""
+
+    def __init__(self, num_classes: int, hidden_size: int):
+        super().__init__()
+        self.table = Embedding(num_classes + 1, hidden_size)
+        self.num_classes = num_classes
+
+    def forward(self, labels):
+        return self.table(labels)
+
+
+def _modulate(x, shift, scale):
+    from paddle_tpu.core.dispatch import unwrap
+    xr, sh, sc = unwrap(x), unwrap(shift), unwrap(scale)
+    return xr * (1 + sc[:, None, :]) + sh[:, None, :]
+
+
+class DiTBlock(Layer):
+    """adaLN-Zero block: modulation params regressed from conditioning; the
+    per-branch gates initialise to zero so each block starts as identity."""
+
+    def __init__(self, config: DiTConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.norm1 = LayerNorm(c.hidden_size, epsilon=1e-6,
+                               weight_attr=False, bias_attr=False)
+        self.qkv = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.proj = Linear(c.hidden_size, c.hidden_size)
+        self.norm2 = LayerNorm(c.hidden_size, epsilon=1e-6,
+                               weight_attr=False, bias_attr=False)
+        hidden = int(c.hidden_size * c.mlp_ratio)
+        self.fc1 = Linear(c.hidden_size, hidden)
+        self.fc2 = Linear(hidden, c.hidden_size)
+        # adaLN-zero modulation: 6 params per block, zero-init
+        from paddle_tpu.nn import initializer as I
+        self.adaLN = Linear(c.hidden_size, 6 * c.hidden_size)
+        self.adaLN.weight._set_data(
+            jnp.zeros_like(self.adaLN.weight._data))
+        self.adaLN.bias._set_data(jnp.zeros_like(self.adaLN.bias._data))
+
+    def forward(self, x, cond):
+        from paddle_tpu.core.dispatch import unwrap, wrap_like
+        b, s = x.shape[0], x.shape[1]
+        mod = self.adaLN(F.silu(cond))
+        sh1, sc1, g1, sh2, sc2, g2 = (
+            M.squeeze(t, axis=1)
+            for t in M.split(M.reshape(mod, [b, 6, -1]), 6, axis=1))
+
+        h = _modulate(self.norm1(x), sh1, sc1)
+        h = wrap_like(h) if not hasattr(h, "_data") else h
+        qkv = M.reshape(self.qkv(h), [b, s, 3, self.num_heads,
+                                      self.head_dim])
+        q, k, v = (M.squeeze(t, axis=2) for t in M.split(qkv, 3, axis=2))
+        att = F.scaled_dot_product_attention(q, k, v, is_causal=False)
+        att = M.reshape(att, [b, s, self.num_heads * self.head_dim])
+        att = self.proj(att)
+        x = unwrap(x) + unwrap(g1)[:, None, :] * unwrap(att)
+
+        h2 = _modulate(self.norm2(wrap_like(x)), sh2, sc2)
+        h2 = self.fc2(F.gelu(self.fc1(wrap_like(h2))))
+        x = x + unwrap(g2)[:, None, :] * unwrap(h2)
+        return wrap_like(x)
+
+
+class DiT(Layer):
+    def __init__(self, config: DiTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        c = config
+        p = c.patch_size
+        self.patch_dim = p * p * c.in_channels
+        self.x_embed = Linear(self.patch_dim, c.hidden_size)
+        self.t_embed = TimestepEmbedder(c.hidden_size)
+        self.y_embed = LabelEmbedder(c.num_classes, c.hidden_size)
+        import numpy as np
+        self.register_buffer(
+            "pos_embed",
+            _sincos_2d(c.hidden_size, c.input_size // p),
+            persistable=False)
+        self.blocks = []
+        for i in range(c.depth):
+            blk = DiTBlock(c)
+            self.add_sublayer(f"blocks_{i}", blk)
+            self.blocks.append(blk)
+        self.norm_f = LayerNorm(c.hidden_size, epsilon=1e-6,
+                                weight_attr=False, bias_attr=False)
+        out_ch = c.in_channels * (2 if c.learn_sigma else 1)
+        self.final = Linear(c.hidden_size, p * p * out_ch)
+        self.final.weight._set_data(jnp.zeros_like(self.final.weight._data))
+        self.final.bias._set_data(jnp.zeros_like(self.final.bias._data))
+
+    # -- patch ops (reshape+matmul; NCHW in, paddle convention) -------------
+    def patchify(self, x):
+        from paddle_tpu.core.dispatch import unwrap
+        c = self.config
+        p = c.patch_size
+        xr = unwrap(x)  # [B, C, H, W]
+        b, ch, hh, ww = xr.shape
+        g = hh // p
+        xr = xr.reshape(b, ch, g, p, g, p)
+        xr = jnp.transpose(xr, (0, 2, 4, 3, 5, 1))   # B,g,g,p,p,C
+        return xr.reshape(b, g * g, p * p * ch)
+
+    def unpatchify(self, tokens, out_ch):
+        c = self.config
+        p = c.patch_size
+        b, n, _ = tokens.shape
+        g = int(math.sqrt(n))
+        t = tokens.reshape(b, g, g, p, p, out_ch)
+        t = jnp.transpose(t, (0, 5, 1, 3, 2, 4))     # B,C,g,p,g,p
+        return t.reshape(b, out_ch, g * p, g * p)
+
+    def forward(self, x, t, y):
+        """x: [B, C, H, W] noisy latents; t: [B] timesteps; y: [B] labels."""
+        from paddle_tpu.core.dispatch import unwrap, wrap_like
+        tokens = self.patchify(x) @ unwrap(self.x_embed.weight) \
+            + unwrap(self.x_embed.bias)
+        tokens = tokens + unwrap(self.pos_embed)[None]
+        cond = wrap_like(unwrap(self.t_embed(t)) + unwrap(self.y_embed(y)))
+        h = wrap_like(tokens)
+        for blk in self.blocks:
+            h = blk(h, cond)
+        h = self.norm_f(h)
+        out_tokens = self.final(h)
+        out_ch = self.config.in_channels * (2 if self.config.learn_sigma
+                                            else 1)
+        img = self.unpatchify(unwrap(out_tokens), out_ch)
+        return wrap_like(img)
+
+    def loss(self, x, t, y, noise_target):
+        """Simple eps-prediction MSE (first in_channels of the output)."""
+        from paddle_tpu.core.dispatch import unwrap, wrap_like
+        out = unwrap(self(x, t, y))
+        eps = out[:, :self.config.in_channels]
+        return wrap_like(jnp.mean((eps - unwrap(noise_target)) ** 2))
+
+    @staticmethod
+    def partition_specs(config, dp_axis="dp", tp_axis="tp", fsdp_axis=None):
+        from jax.sharding import PartitionSpec as P
+        col = P(fsdp_axis, tp_axis)
+        row = P(tp_axis, fsdp_axis)
+        return {
+            ".qkv.weight": col, ".qkv.bias": P(tp_axis),
+            ".proj.weight": row, ".proj.bias": P(),
+            ".fc1.weight": col, ".fc1.bias": P(tp_axis),
+            ".fc2.weight": row, ".fc2.bias": P(),
+            ".adaLN.weight": P(fsdp_axis, None), ".adaLN.bias": P(),
+            "x_embed.weight": P(None, fsdp_axis),
+            "final.weight": P(fsdp_axis, None),
+        }
+
+    @staticmethod
+    def spec_for(name, rules):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        return LlamaForCausalLM.spec_for(name, rules)
+
+
+def _sincos_2d(dim: int, grid: int):
+    """2D sin-cos positional embedding [grid*grid, dim] (DiT uses fixed)."""
+    import numpy as np
+    half = dim // 2
+
+    def one_dim(pos, d):
+        omega = np.arange(d // 2, dtype=np.float64) / (d / 2.0)
+        omega = 1.0 / 10000 ** omega
+        out = np.einsum("m,d->md", pos, omega)
+        return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+    coords = np.arange(grid, dtype=np.float64)
+    gy, gx = np.meshgrid(coords, coords, indexing="ij")
+    emb = np.concatenate([one_dim(gy.reshape(-1), half),
+                          one_dim(gx.reshape(-1), half)], axis=1)
+    return emb.astype(np.float32)

@@ -1,0 +1,195 @@
+"""GPT / ERNIE-style decoder-only transformer (learned positions, pre-LN).
+
+Reference parity: the ERNIE/GPT recipe the reference trains via fleet —
+transformer blocks of MultiHeadAttention + LayerNorm + GELU MLP
+(python/paddle/nn/layer/transformer.py) composed with the mpu parallel
+layers (fleet/layers/mpu/mp_layers.py).  Same GSPMD-first structure as
+models/llama.py: plain layers + partition_specs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn.common_layers import Dropout, Embedding, Linear
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.norm_layers import LayerNorm
+from paddle_tpu.ops import manipulation as M
+
+__all__ = ["GPTConfig", "GPTAttention", "GPTMLP", "GPTDecoderLayer",
+           "GPTModel", "GPTForCausalLM"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: Optional[int] = None  # None → 4*hidden
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.intermediate_size is None:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def ernie_345m():
+        """ERNIE-scale medium config (the reference's flagship NLP family)."""
+        return GPTConfig(vocab_size=40000, hidden_size=1024,
+                         num_hidden_layers=24, num_attention_heads=16,
+                         max_position_embeddings=2048)
+
+    @staticmethod
+    def tiny(**over):
+        cfg = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, max_position_embeddings=128,
+                   hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+        cfg.update(over)
+        return GPTConfig(**cfg)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        c = config
+        self.num_heads = c.num_attention_heads
+        self.head_dim = c.head_dim
+        # fused qkv: one wide MXU matmul
+        self.qkv_proj = Linear(c.hidden_size, 3 * c.hidden_size)
+        self.out_proj = Linear(c.hidden_size, c.hidden_size)
+        self.dropout_p = c.attention_dropout_prob
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = M.reshape(self.qkv_proj(x),
+                        [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = (M.squeeze(t, axis=2)
+                   for t in M.split(qkv, 3, axis=2))
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout_p,
+            training=self.training)
+        out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+        return self.out_proj(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size)
+
+    def forward(self, x):
+        return self.fc_out(F.gelu(self.fc_in(x)))
+
+
+class GPTDecoderLayer(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.ln_1 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        x = x + self.dropout(self.mlp(self.ln_2(x)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_positions = Embedding(config.max_position_embeddings,
+                                         config.hidden_size)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.layers = []
+        for i in range(config.num_hidden_layers):
+            layer = GPTDecoderLayer(config)
+            self.add_sublayer(f"layers_{i}", layer)
+            self.layers.append(layer)
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+        if config.dtype != "float32":
+            self.astype(config.dtype)
+
+    def forward(self, input_ids, position_offset: int = 0):
+        import jax.numpy as jnp
+        s = input_ids.shape[1]
+        pos = jnp.arange(position_offset, position_offset + s)
+        x = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        x = self.dropout(x)
+        for layer in self.layers:
+            x = layer(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__(dtype=config.dtype)
+        self.config = config
+        self.model = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, position_offset: int = 0):
+        h = self.model(input_ids, position_offset)
+        if self.lm_head is None:
+            from paddle_tpu.ops import linalg as L
+            return L.matmul(h, self.model.embed_tokens.weight,
+                            transpose_y=True)
+        return self.lm_head(h)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        v = logits.shape[-1]
+        return F.cross_entropy(M.reshape(logits, [-1, v]),
+                               M.reshape(labels, [-1]))
+
+    @staticmethod
+    def partition_specs(config, dp_axis="dp", tp_axis="tp", fsdp_axis=None):
+        """Megatron mapping: qkv/fc_in column-parallel, out/fc_out
+        row-parallel, embeddings vocab-sharded (cf. llama.partition_specs)."""
+        from jax.sharding import PartitionSpec as P
+        col = P(fsdp_axis, tp_axis)
+        row = P(tp_axis, fsdp_axis)
+        return {
+            "model.embed_tokens.weight": P(tp_axis, fsdp_axis),
+            "model.embed_positions.weight": P(None, fsdp_axis),
+            "lm_head.weight": col,
+            ".qkv_proj.weight": col,
+            ".qkv_proj.bias": P(tp_axis),
+            ".out_proj.weight": row,
+            ".out_proj.bias": P(),
+            ".fc_in.weight": col,
+            ".fc_in.bias": P(tp_axis),
+            ".fc_out.weight": row,
+            ".fc_out.bias": P(),
+            "ln_1.weight": P(), "ln_1.bias": P(),
+            "ln_2.weight": P(), "ln_2.bias": P(),
+            "ln_f.weight": P(), "ln_f.bias": P(),
+        }
+
+    @staticmethod
+    def spec_for(name, rules):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        return LlamaForCausalLM.spec_for(name, rules)

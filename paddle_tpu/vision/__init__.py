@@ -1,1 +1,7 @@
-"""placeholder — populated in later milestones this round."""
+"""paddle_tpu.vision — models + transforms (reference:
+python/paddle/vision/)."""
+
+from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import transforms  # noqa: F401
+
+__all__ = ["models", "transforms"]

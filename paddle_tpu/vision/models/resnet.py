@@ -1,0 +1,162 @@
+"""ResNet family (reference: python/paddle/vision/models/resnet.py).
+
+The conv/vision model in the benchmark matrix (PP-OCRv4-class backbones are
+ResNet-ish conv stacks).  Convs lower straight to XLA's conv-general which
+tiles onto the MXU; BN in training mode keeps running stats as buffers.
+NCHW layout (paddle convention).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type, Union
+
+from paddle_tpu.nn.common_layers import Linear, Sequential
+from paddle_tpu.nn.conv_layers import Conv2D
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn.norm_layers import BatchNorm2D
+from paddle_tpu.nn.pooling_layers import AdaptiveAvgPool2D, MaxPool2D
+
+__all__ = ["ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+           "resnet34", "resnet50", "resnet101", "resnet152"]
+
+
+def _conv3x3(cin, cout, stride=1):
+    return Conv2D(cin, cout, 3, stride=stride, padding=1, bias_attr=False)
+
+
+def _conv1x1(cin, cout, stride=1):
+    return Conv2D(cin, cout, 1, stride=stride, bias_attr=False)
+
+
+class BasicBlock(Layer):
+    expansion = 1
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        from paddle_tpu.nn import functional as F
+        self.conv1 = _conv3x3(inplanes, planes, stride)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = _conv3x3(planes, planes)
+        self.bn2 = BatchNorm2D(planes)
+        self.downsample = downsample
+        self._relu = F.relu
+
+    def forward(self, x):
+        identity = x
+        out = self._relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self._relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        from paddle_tpu.nn import functional as F
+        self.conv1 = _conv1x1(inplanes, planes)
+        self.bn1 = BatchNorm2D(planes)
+        self.conv2 = _conv3x3(planes, planes, stride)
+        self.bn2 = BatchNorm2D(planes)
+        self.conv3 = _conv1x1(planes, planes * self.expansion)
+        self.bn3 = BatchNorm2D(planes * self.expansion)
+        self.downsample = downsample
+        self._relu = F.relu
+
+    def forward(self, x):
+        identity = x
+        out = self._relu(self.bn1(self.conv1(x)))
+        out = self._relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self._relu(out + identity)
+
+
+class ResNet(Layer):
+    def __init__(self, block: Type[Union[BasicBlock, BottleneckBlock]],
+                 depth_layers: List[int], num_classes: int = 1000,
+                 with_pool: bool = True, in_channels: int = 3):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_layers[0])
+        self.layer2 = self._make_layer(block, 128, depth_layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, depth_layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, depth_layers[3], 2)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, planes, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != planes * block.expansion:
+            downsample = Sequential(
+                _conv1x1(self.inplanes, planes * block.expansion, stride),
+                BatchNorm2D(planes * block.expansion))
+        layers = [block(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, planes))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        from paddle_tpu.ops import manipulation as M
+        x = F.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        x = self.layer1(x)
+        x = self.layer2(x)
+        x = self.layer3(x)
+        x = self.layer4(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = M.flatten(x, start_axis=1)
+            x = self.fc(x)
+        return x
+
+    @staticmethod
+    def partition_specs(config=None, dp_axis="dp", tp_axis="tp",
+                        fsdp_axis=None):
+        """Conv nets are DP/FSDP-parallel: convs replicate (or fsdp-shard
+        the output-channel dim); the fc head column-shards on tp."""
+        from jax.sharding import PartitionSpec as P
+        return {
+            "fc.weight": P(fsdp_axis, tp_axis),
+            "fc.bias": P(tp_axis),
+            ".weight": P(fsdp_axis) if fsdp_axis else P(),
+        }
+
+    @staticmethod
+    def spec_for(name, rules):
+        from paddle_tpu.models.llama import LlamaForCausalLM
+        return LlamaForCausalLM.spec_for(name, rules)
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)

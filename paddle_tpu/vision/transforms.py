@@ -1,0 +1,110 @@
+"""vision.transforms — numpy-side image transforms (reference:
+python/paddle/vision/transforms/).  Host-side preprocessing feeding the
+DataLoader; device work stays in the model."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms: List):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1]."""
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32) / 255.0
+        return np.transpose(arr, (2, 0, 1))
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.transpose(np.asarray(img), self.order)
+
+
+class Normalize:
+    def __init__(self, mean: Sequence[float], std: Sequence[float],
+                 data_format="CHW"):
+        shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+        self.mean = np.asarray(mean, np.float32).reshape(shape)
+        self.std = np.asarray(std, np.float32).reshape(shape)
+
+    def __call__(self, img):
+        return (np.asarray(img, np.float32) - self.mean) / self.std
+
+
+def _resize_np(arr: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize HWC via jax.image (no PIL dependency)."""
+    import jax.image
+    import jax.numpy as jnp
+    out = jax.image.resize(jnp.asarray(arr, jnp.float32),
+                           (h, w) + arr.shape[2:], method="bilinear")
+    return np.asarray(out).astype(arr.dtype)
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        return _resize_np(arr, self.size[0], self.size[1])
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop:
+    def __init__(self, size, pad=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.pad = pad
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.pad:
+            arr = np.pad(arr, ((self.pad,) * 2, (self.pad,) * 2)
+                         + ((0, 0),) * (arr.ndim - 2), mode="constant")
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, max(1, h - th + 1))
+        j = np.random.randint(0, max(1, w - tw + 1))
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return np.asarray(img)

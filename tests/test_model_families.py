@@ -1,0 +1,227 @@
+"""Model-family tests: GPT, MoE-LLM (DeepSeek/Qwen2-MoE shape), DiT,
+ResNet — forward shapes, training steps, sharded compile."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pp
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import (DiT, DiTConfig, GPTConfig, GPTForCausalLM,
+                               MoEConfig, MoEForCausalLM)
+
+
+class TestGPT:
+    def test_forward_and_loss(self):
+        pp.seed(0)
+        cfg = GPTConfig.tiny()
+        model = GPTForCausalLM(cfg)
+        ids = pp.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 16)).astype("int32"))
+        logits = model(ids)
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        # tied embeddings: no separate lm_head parameter
+        assert model.lm_head is None
+        loss = model.loss(ids, ids)
+        assert np.isfinite(float(loss.numpy()))
+
+    def test_train_step_reduces_loss(self):
+        pp.seed(0)
+        cfg = GPTConfig.tiny(vocab_size=128)
+        model = GPTForCausalLM(cfg)
+        opt = pp.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (4, 17))
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        losses = [float(step(batch)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_sharded_compile(self):
+        pp.seed(0)
+        cfg = GPTConfig.tiny(vocab_size=128, hidden_size=64)
+        model = GPTForCausalLM(cfg)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "tp"))
+        rules = GPTForCausalLM.partition_specs(cfg)
+        specs = {n: GPTForCausalLM.spec_for(n, rules)
+                 for n in model.state_dict(keep_vars=True)}
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt, mesh=mesh, param_specs=specs,
+                         batch_spec=P("dp"))
+        ids = np.random.default_rng(0).integers(0, 128, (4, 17))
+        loss = step({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+        assert np.isfinite(float(loss))
+
+
+class TestMoELLM:
+    def test_forward_and_aux_loss(self):
+        pp.seed(0)
+        cfg = MoEConfig.tiny()
+        model = MoEForCausalLM(cfg)
+        ids = pp.to_tensor(np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (2, 16)).astype("int32"))
+        logits = model(ids)
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        aux = model.model.aux_loss()
+        assert aux is not None and np.isfinite(float(np.asarray(aux)))
+        # layer 0 dense (first_k_dense_replace=1), layer 1 MoE
+        assert model.model.layers[0].is_dense
+        assert not model.model.layers[1].is_dense
+
+    def test_train_step_with_ep_sharding(self):
+        pp.seed(0)
+        cfg = MoEConfig.tiny(num_experts=4)
+        model = MoEForCausalLM(cfg)
+        mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ("dp", "ep"))
+        rules = MoEForCausalLM.partition_specs(cfg)
+        specs = {n: MoEForCausalLM.spec_for(n, rules)
+                 for n in model.state_dict(keep_vars=True)}
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+
+        def loss_fn(out, y):  # routed through model.loss for the aux term
+            raise AssertionError("unused")
+
+        step = TrainStep(model, opt, mesh=mesh, param_specs=specs,
+                         batch_spec=P("dp"),
+                         loss_fn=None)
+        ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 17))
+        losses = [float(step({"input_ids": ids[:, :-1],
+                              "labels": ids[:, 1:]})) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+    def test_expert_grads_flow(self):
+        pp.seed(0)
+        cfg = MoEConfig.tiny(num_experts=4, first_k_dense_replace=0)
+        model = MoEForCausalLM(cfg)
+        from paddle_tpu.core.functional import functional_call, params_of
+        params = params_of(model)
+
+        def loss(ps, ids):
+            out = functional_call(model, ps, pp.Tensor(ids))
+            return (out._data.astype(jnp.float32) ** 2).mean()
+
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, 8)), jnp.int32)
+        g = jax.grad(loss)(params, ids)
+        w1_key = [k for k in g if "experts.w1" in k][0]
+        assert float(jnp.abs(g[w1_key]).sum()) > 0
+
+
+class TestDiT:
+    def test_forward_shapes(self):
+        pp.seed(0)
+        cfg = DiTConfig.tiny()
+        model = DiT(cfg)
+        x = pp.randn([2, cfg.in_channels, cfg.input_size, cfg.input_size])
+        t = pp.to_tensor(np.array([3, 7], np.int32))
+        y = pp.to_tensor(np.array([1, 2], np.int32))
+        out = model(x, t, y)
+        out_ch = cfg.in_channels * 2  # learn_sigma
+        assert tuple(out.shape) == (2, out_ch, cfg.input_size,
+                                    cfg.input_size)
+
+    def test_adaln_zero_init_is_identity_path(self):
+        """final layer zero-init → output starts at exactly zero."""
+        pp.seed(0)
+        cfg = DiTConfig.tiny()
+        model = DiT(cfg)
+        x = pp.randn([1, cfg.in_channels, cfg.input_size, cfg.input_size])
+        t = pp.to_tensor(np.array([0], np.int32))
+        y = pp.to_tensor(np.array([0], np.int32))
+        out = model(x, t, y)
+        np.testing.assert_allclose(out.numpy(), 0.0)
+
+    def test_training_reduces_mse(self):
+        pp.seed(0)
+        cfg = DiTConfig.tiny()
+        model = DiT(cfg)
+        from paddle_tpu.core.functional import functional_call, params_of
+        from paddle_tpu.core.dispatch import unwrap
+        params = params_of(model)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 4, 8, 8)), jnp.float32)
+        noise = jnp.asarray(rng.normal(size=(2, 4, 8, 8)), jnp.float32)
+        t = jnp.asarray([1, 2], jnp.int32)
+        y = jnp.asarray([0, 1], jnp.int32)
+
+        def loss(ps):
+            out = functional_call(model, ps, pp.Tensor(x), pp.Tensor(t),
+                                  pp.Tensor(y))
+            eps = unwrap(out)[:, :4]
+            return jnp.mean((eps - noise) ** 2)
+
+        @jax.jit
+        def step(ps):
+            l, g = jax.value_and_grad(loss)(ps)
+            return l, jax.tree.map(lambda p, gr: p - 1e-2 * gr, ps, g)
+
+        l0, params = step(params)
+        for _ in range(10):
+            l, params = step(params)
+        assert float(l) < float(l0)
+
+    def test_patchify_roundtrip(self):
+        cfg = DiTConfig.tiny()
+        model = DiT(cfg)
+        x = np.arange(2 * 4 * 8 * 8, dtype=np.float32).reshape(2, 4, 8, 8)
+        tokens = model.patchify(pp.to_tensor(x))
+        assert tokens.shape == (2, cfg.num_patches,
+                                cfg.patch_size ** 2 * 4)
+        back = model.unpatchify(tokens, 4)
+        np.testing.assert_allclose(np.asarray(back), x)
+
+
+class TestResNet:
+    def test_resnet18_forward(self):
+        from paddle_tpu.vision.models import resnet18
+        pp.seed(0)
+        net = resnet18(num_classes=10)
+        x = pp.randn([2, 3, 32, 32])
+        out = net(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_resnet50_bottleneck(self):
+        from paddle_tpu.vision.models import resnet50
+        pp.seed(0)
+        net = resnet50(num_classes=4)
+        x = pp.randn([1, 3, 64, 64])
+        assert tuple(net(x).shape) == (1, 4)
+
+    def test_train_step(self):
+        from paddle_tpu.vision.models import resnet18
+        pp.seed(0)
+        net = resnet18(num_classes=4)
+        opt = pp.optimizer.Momentum(learning_rate=1e-2,
+                                    parameters=net.parameters())
+
+        def loss_fn(out, y):
+            return pp.nn.functional.cross_entropy(out, y)
+
+        step = TrainStep(net, opt, loss_fn=loss_fn)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 3, 32, 32)).astype("float32")
+        y = (np.arange(8) % 4).astype("int64")
+        losses = [float(step((x, y))) for _ in range(5)]
+        assert np.isfinite(losses).all() if hasattr(
+            np.isfinite(losses), "all") else all(
+            np.isfinite(l) for l in losses)
+
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.default_rng(0).random((40, 48, 3)) * 255
+               ).astype(np.uint8)
+        pipeline = T.Compose([
+            T.Resize(32), T.CenterCrop(28), T.RandomHorizontalFlip(1.0),
+            T.ToTensor(),
+            T.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5]),
+        ])
+        out = pipeline(img)
+        assert out.shape == (3, 28, 28)
+        assert out.dtype == np.float32
+        assert -1.01 <= out.min() and out.max() <= 1.01
