@@ -30,9 +30,15 @@ _SENTINEL = "checkpoint_meta.json"
 
 
 def _to_host(arr) -> np.ndarray:
+    """Device → host.  Multi-host jax.Arrays are not fully addressable, so
+    np.asarray would raise; gather the global value across processes first
+    (every process participates — the coordinator gets the full array)."""
     if hasattr(arr, "_data"):
         arr = arr._data
-    return np.asarray(arr)
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -90,9 +96,25 @@ class _AsyncSave:
         return not self.thread.is_alive()
 
 
-def async_save_state_dict(state_dict: Dict[str, Any], path: str) -> _AsyncSave:
+def async_save_state_dict(state_dict: Dict[str, Any], path: str,
+                          coordinator_rank: int = 0) -> _AsyncSave:
     """Snapshot to host memory synchronously (cheap: D2H over PCIe/DMA),
-    write to disk on a background thread (the orbax async pattern)."""
+    write to disk on a background thread (the orbax async pattern).
+
+    Multi-host: all processes participate in the snapshot only for arrays
+    that need a cross-process gather; otherwise non-coordinator ranks skip
+    the host copy entirely (no wasted host memory)."""
+    import jax
+    if jax.process_count() > 1 and jax.process_index() != coordinator_rank:
+        # participate in collective gathers for non-addressable arrays,
+        # drop the result immediately
+        for arr in state_dict.values():
+            a = arr._data if hasattr(arr, "_data") else arr
+            if not getattr(a, "is_fully_addressable", True):
+                _to_host(a)
+        t = threading.Thread(target=lambda: None, daemon=True)
+        t.start()
+        return _AsyncSave(t)
     host_copy = {name: _to_host(arr) for name, arr in state_dict.items()}
     t = threading.Thread(target=save_state_dict, args=(host_copy, path),
                          daemon=True)

@@ -205,7 +205,11 @@ class MoELayer(Layer):
         E = self.num_experts
         x2d = data.reshape(T, d)
 
-        capacity = max(1, int(self.capacity_factor * T / E))
+        # expected assignments are top_k*T/E under balanced routing, so
+        # capacity must scale with k (reference GShardGate caps per expert
+        # at ceil(cap_rate * tokens), similarly k-aware in effect)
+        capacity = max(1, int(self.capacity_factor * self.gate.top_k
+                              * T / E))
         logits = unwrap(self.gate.logits(x2d))
         combine, dispatch, aux = top_k_gating(
             logits, k=self.gate.top_k, capacity=capacity)

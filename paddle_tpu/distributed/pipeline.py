@@ -278,3 +278,241 @@ def stack_stage_params(per_stage_params: List[Any]):
     """[pytree per stage] -> stacked pytree with leading S axis (to be
     sharded P('pp', ...)).  Stages must be homogeneous."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+# -- 1F1B: the explicit fused forward/backward schedule ----------------------
+
+def build_1f1b_schedule(num_stages: int, num_microbatches: int):
+    """Static [T, S] op/microbatch tables for the 1F1B schedule (reference
+    PipelineParallel.forward_backward_pipeline, pipeline_parallel.py:188).
+
+    Discrete-event simulation on the host (trace-time constant): each stage
+    does warmup = S-1-s forwards, then strictly alternates backward/forward
+    (the "one forward, one backward" steady state), then drains.  Arrival
+    constraints (activation from upstream, cotangent from downstream, one
+    hop per tick) are enforced by readiness sets, so the table is valid by
+    construction.
+
+    Returns (op[T,S], mb[T,S]) int32 numpy arrays; op: 0 idle, 1 fwd, 2 bwd.
+    The max number of in-flight microbatches at stage s is S-s (<= S), which
+    bounds the activation buffer — the memory property 1F1B exists for.
+    """
+    S, M = num_stages, num_microbatches
+    fwd_ready = [set() for _ in range(S)]   # microbatches whose input arrived
+    bwd_ready = [set() for _ in range(S)]   # cotangent arrived
+    fwd_ready[0] = set(range(M))            # stage 0 owns all inputs
+    fwd_done = [0] * S
+    bwd_done = [0] * S
+    ops, mbs = [], []
+    guard = 0
+    while any(b < M for b in bwd_done):
+        guard += 1
+        if guard > 4 * (M + S) + 16:
+            raise RuntimeError("1f1b schedule did not converge")
+        row_op = [0] * S
+        row_mb = [0] * S
+        events = []  # (stage, kind, m) applied after the tick
+        for s in range(S):
+            warmup = min(S - 1 - s, M)
+            # next microbatch in order for each direction
+            fm, bm = fwd_done[s], bwd_done[s]
+            can_fwd = fm < M and fm in fwd_ready[s]
+            can_bwd = bm < fwd_done[s] and bm in bwd_ready[s]
+            prefer_bwd = fwd_done[s] >= warmup
+            do_bwd = can_bwd and (prefer_bwd or not can_fwd)
+            do_fwd = (not do_bwd) and can_fwd and \
+                (fwd_done[s] - bwd_done[s]) <= warmup
+            if do_bwd:
+                row_op[s], row_mb[s] = 2, bm
+                bwd_done[s] += 1
+                if s > 0:
+                    events.append((s - 1, "bwd", bm))
+            elif do_fwd:
+                row_op[s], row_mb[s] = 1, fm
+                fwd_done[s] += 1
+                if s < S - 1:
+                    events.append((s + 1, "fwd", fm))
+                else:
+                    # last stage: its own cotangent is ready immediately
+                    events.append((s, "bwd", fm))
+        for s, kind, m in events:
+            (fwd_ready if kind == "fwd" else bwd_ready)[s].add(m)
+        ops.append(row_op)
+        mbs.append(row_mb)
+    return (np.asarray(ops, np.int32), np.asarray(mbs, np.int32))
+
+
+def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
+                  stage_params: Any, mb_inputs, mb_labels, *,
+                  num_microbatches: int, axis_name: str = "pp",
+                  remat: bool = True):
+    """Fused forward+backward 1F1B pipeline step INSIDE a shard_map.
+
+    The reference hand-schedules 1F1B across NCCL ranks
+    (pipeline_parallel.py:188 warmup/steady/cooldown, p2p_communication.py);
+    here the whole schedule is ONE lax.scan over ticks: every tick each
+    stage consults the static schedule table and either forwards a
+    microbatch, backwards one (recomputing its stage from the saved
+    boundary input — the reference's recompute-interval memory trick, so
+    only O(S) boundary activations are ever live), or idles.  Boundary
+    activations ppermute forward, cotangents ppermute backward, parameter
+    gradients accumulate in the carry.
+
+    Args:
+      stage_fn:  (params, x[mb, ...]) -> y[mb, ...] — the stage's block
+        stack; boundary shape-preserving.
+      first_fn:  (params, raw_mb) -> x — input embedding, applied only on
+        stage 0 (raw microbatch may be int ids; its params live in stage
+        0's param slice).
+      last_fn:   (params, y, labels_mb) -> scalar loss — head + loss,
+        applied only on the last stage.
+      stage_params: this device's stage param slice (shard_map already
+        split the stacked [S, ...] axis).  To keep SPMD homogeneous, every
+        stage's slice has the same structure — embed/head slots exist on
+        every stage and are zeros except where used.
+      mb_inputs: [M, mb, ...] raw microbatch inputs (replicated on pp).
+      mb_labels: [M, mb, ...] labels (replicated on pp).
+
+    Returns (mean_loss, stage_param_grads) — loss is valid on the last
+    stage (psum'd over pp so every stage sees it), grads are per-stage.
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = num_microbatches
+    from paddle_tpu.distributed.communication import pvary
+
+    op_np, mb_np = build_1f1b_schedule(S, M)
+    op_table = jnp.asarray(op_np)    # [T, S]
+    mb_table = jnp.asarray(mb_np)
+    T = op_np.shape[0]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    # probe boundary shape
+    x0 = jax.eval_shape(
+        first_fn, stage_params,
+        jax.ShapeDtypeStruct(mb_inputs.shape[1:], mb_inputs.dtype))
+    y0 = jax.eval_shape(fn, stage_params, x0)
+    if (y0.shape, y0.dtype) != (x0.shape, x0.dtype):
+        raise ValueError(f"stage must preserve boundary: {x0} -> {y0}")
+    bshape, bdtype = y0.shape, y0.dtype
+
+    zeros_b = lambda: jnp.zeros(bshape, bdtype)
+    grad_zero = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.promote_types(a.dtype, jnp.float32)
+                            if jnp.issubdtype(a.dtype, jnp.floating)
+                            else a.dtype),
+        stage_params)
+
+    inv_m = 1.0 / M
+
+    # Sender-side static info lets the receiver decide whether this tick's
+    # incoming wire payloads are real: what my upstream (idx-1) / downstream
+    # (idx+1) neighbour did LAST tick, from the same static table.
+    # up_op[t, s] = op of stage s-1 at tick t-1; down_op likewise.
+    up_op = np.zeros_like(op_np)
+    up_mb = np.zeros_like(mb_np)
+    down_op = np.zeros_like(op_np)
+    down_mb = np.zeros_like(mb_np)
+    up_op[1:, 1:] = op_np[:-1, :-1]
+    up_mb[1:, 1:] = mb_np[:-1, :-1]
+    down_op[1:, :-1] = op_np[:-1, 1:]
+    down_mb[1:, :-1] = mb_np[:-1, 1:]
+    up_op_t = jnp.asarray(up_op)
+    up_mb_t = jnp.asarray(up_mb)
+    down_op_t = jnp.asarray(down_op)
+    down_mb_t = jnp.asarray(down_mb)
+
+    def _store(buf, valid, m, payload):
+        """buf[m % S] = payload where valid (else unchanged)."""
+        slot = m % S
+        cur = lax.dynamic_index_in_dim(buf, slot, 0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(
+            buf, jnp.where(valid, payload, cur), slot, 0)
+
+    def tick(carry, t):
+        fwd_wire, bwd_wire, in_buf, cot_buf, grads, loss_acc = carry
+        op = op_table[t, idx]
+        m = mb_table[t, idx]
+
+        # 1) bank incoming wire payloads (schedule allows consuming them
+        #    ticks later, so they must survive subsequent rotations)
+        in_buf = _store(in_buf, up_op_t[t, idx] == 1, up_mb_t[t, idx],
+                        fwd_wire)
+        cot_buf = _store(cot_buf, down_op_t[t, idx] == 2, down_mb_t[t, idx],
+                         bwd_wire)
+
+        raw = lax.dynamic_index_in_dim(mb_inputs, m, 0, keepdims=False)
+        lab = lax.dynamic_index_in_dim(mb_labels, m, 0, keepdims=False)
+        x_saved = lax.dynamic_index_in_dim(in_buf, m % S, 0, keepdims=False)
+        g_recv = lax.dynamic_index_in_dim(cot_buf, m % S, 0, keepdims=False)
+
+        def thread_first(p, x):
+            # embed path on stage 0 only; `where` keeps the jaxpr uniform
+            # across stages, grads flow to embed params only where idx==0
+            x_in = jnp.where(idx == 0, first_fn(p, raw), x)
+            return fn(p, x_in)
+
+        # 2) compute — switch so idle ticks cost nothing and fwd ticks
+        #    don't pay the vjp.  Every branch output is pvary'd so the
+        #    branches agree on varying-manual-axes types.
+        from paddle_tpu.distributed.communication import pvary as _pv
+        pv = lambda *tree: jax.tree.map(lambda a: _pv(a, axis_name), tree)
+
+        def do_idle(_):
+            return pv(zeros_b(), zeros_b(), jax.tree.map(
+                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+
+        def do_fwd(_):
+            y = thread_first(stage_params, x_saved)
+            return pv(y, zeros_b(), jax.tree.map(
+                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+
+        def do_bwd(_):
+            def run(loss_like):
+                from paddle_tpu.distributed.communication import pvary
+                val, pull = jax.vjp(loss_like, stage_params, x_saved)
+                dp, dx = pull(pvary(jnp.ones((), val.dtype), axis_name))
+                return val, dp, dx
+
+            def last_branch(_):
+                return run(lambda p, x: last_fn(p, thread_first(p, x), lab)
+                           * inv_m)
+
+            def mid_branch(_):
+                return run(lambda p, x: jnp.sum(
+                    thread_first(p, x).astype(jnp.float32)
+                    * g_recv.astype(jnp.float32)))
+
+            val, dp, dx = lax.cond(idx == S - 1, last_branch, mid_branch,
+                                   None)
+            loss_c = jnp.where(idx == S - 1, val, 0.0)
+            dpf = jax.tree.map(lambda d, z: d.astype(z.dtype), dp, grad_zero)
+            return pv(zeros_b(), dx.astype(bdtype), dpf,
+                      loss_c.astype(jnp.float32).reshape(()))
+
+        send_y, send_dx, dp, loss_c = lax.switch(
+            jnp.clip(op, 0, 2), [do_idle, do_fwd, do_bwd], None)
+
+        grads = jax.tree.map(lambda g, d: g + d, grads, dp)
+        loss_acc = loss_acc + loss_c
+
+        # 3) rotate: activations forward, cotangents backward (ring; the
+        #    wrap edges carry garbage that validity gating ignores)
+        new_fwd = lax.ppermute(send_y, axis_name,
+                               [(i, (i + 1) % S) for i in range(S)])
+        new_bwd = lax.ppermute(send_dx, axis_name,
+                               [(i, (i - 1) % S) for i in range(S)])
+        return (new_fwd, new_bwd, in_buf, cot_buf, grads, loss_acc), None
+
+    init = (pvary(zeros_b(), axis_name),
+            pvary(zeros_b(), axis_name),
+            pvary(jnp.zeros((S,) + bshape, bdtype), axis_name),
+            pvary(jnp.zeros((S,) + bshape, bdtype), axis_name),
+            jax.tree.map(lambda z: pvary(z, axis_name), grad_zero),
+            pvary(jnp.zeros((), jnp.float32), axis_name))
+    (_, _, _, _, grads, loss_acc), _ = lax.scan(tick, init, jnp.arange(T))
+
+    # every stage reports the (last-stage-only) loss
+    loss = lax.psum(loss_acc, axis_name)
+    return loss, grads

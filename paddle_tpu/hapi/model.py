@@ -192,7 +192,10 @@ class Model:
                     lv._data if hasattr(lv, "_data") else lv)))
             for m in self._metrics:
                 if y is not None:
-                    m.update(m.compute(out, np.asarray(y[0])))
+                    # Metric.compute may return (pred, label) for the update
+                    # (reference: metric.update(*to_list(metric_outs)))
+                    outs = m.compute(out, np.asarray(y[0]))
+                    m.update(*(outs if isinstance(outs, tuple) else (outs,)))
         logs = {}
         if losses:
             logs["loss"] = float(np.mean(losses))

@@ -68,8 +68,11 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
+        if getattr(self, "_done", False):
+            raise StopIteration  # the single _STOP sentinel was consumed
         item = self._q.get()
         if item is self._STOP:
+            self._done = True
             if self._exc is not None:
                 raise self._exc
             raise StopIteration

@@ -34,10 +34,31 @@ class InputSpec:
         self.dtype = dtype
         self.name = name
 
-    def to_sds(self):
+    def has_dynamic_dims(self) -> bool:
+        return any(d is None or (isinstance(d, int) and d < 0)
+                   for d in self.shape)
+
+    def to_sds(self, scope=None, name_hint="x"):
+        """Static dims → ShapeDtypeStruct directly; None/-1 dims become
+        jax.export symbolic dimensions so the saved artifact accepts any
+        size there (reference: save_inference_model supports dynamic batch).
+        Axis-0 symbols are all named "batch" so every input shares one
+        batch dimension; pass a common `scope` across specs."""
         import jax
         from paddle_tpu.core.dtypes import to_jax
-        shape = tuple(1 if d is None or d < 0 else d for d in self.shape)
+        if not self.has_dynamic_dims():
+            return jax.ShapeDtypeStruct(tuple(self.shape),
+                                        to_jax(self.dtype))
+        from jax import export as jexport
+        if scope is None:
+            scope = jexport.SymbolicScope()
+        parts = []
+        for i, d in enumerate(self.shape):
+            if d is None or (isinstance(d, int) and d < 0):
+                parts.append("batch" if i == 0 else f"{name_hint}_d{i}")
+            else:
+                parts.append(str(d))
+        shape = jexport.symbolic_shape(", ".join(parts), scope=scope)
         return jax.ShapeDtypeStruct(shape, to_jax(self.dtype))
 
     def __repr__(self):
@@ -59,9 +80,13 @@ def save(layer, path: str, input_spec: Optional[List] = None, **configs):
         raise ValueError("jit.save on TPU requires input_spec (shapes are "
                          "compiled; provide InputSpec/example tensors)")
     sds = []
-    for spec in input_spec:
+    sym_scope = None
+    for i, spec in enumerate(input_spec):
         if isinstance(spec, InputSpec):
-            sds.append(spec.to_sds())
+            if spec.has_dynamic_dims() and sym_scope is None:
+                from jax import export as jexport
+                sym_scope = jexport.SymbolicScope()
+            sds.append(spec.to_sds(scope=sym_scope, name_hint=f"x{i}"))
         elif hasattr(spec, "_data"):
             sds.append(jax.ShapeDtypeStruct(tuple(spec.shape),
                                             spec._data.dtype))

@@ -70,7 +70,18 @@ class TrainStep:
 
         if mesh is not None and param_specs is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            to_sh = lambda spec: NamedSharding(mesh, spec)
+
+            def sanitize(spec):
+                # model partition rules name every axis they know about
+                # (dp/fsdp/tp/ep); drop the ones absent from this mesh so a
+                # ('dp','ep') mesh accepts Llama-style tp rules unchanged
+                axes = set(mesh.axis_names)
+                keep = lambda e: (e if e is None or (
+                    e in axes if not isinstance(e, tuple)
+                    else all(a in axes for a in e)) else None)
+                return P(*(keep(e) for e in spec))
+
+            to_sh = lambda spec: NamedSharding(mesh, sanitize(spec))
             self._param_sh = {n: to_sh(param_specs.get(n, P()))
                               for n in self.params}
             self.params = {n: jax.device_put(a, self._param_sh[n])

@@ -101,10 +101,9 @@ class LlamaAttention(Layer):
             k = M.concat([pk, k], axis=1)
             v = M.concat([pv, v], axis=1)
             new_cache = (k, v)
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = M.repeat_interleave(k, rep, axis=2)
-            v = M.repeat_interleave(v, rep, axis=2)
+        # GQA k/v pass through at kv_heads width — the Pallas flash kernel
+        # maps query heads onto kv heads in its grid (no repeat in HBM);
+        # the XLA fallback repeats internally.
         # is_causal stays on for cached prefill too: the tril mask in sdpa
         # offsets by sk-sq, so a multi-token query over past KV is causal
         out = F.scaled_dot_product_attention(

@@ -19,6 +19,12 @@ from paddle_tpu.core.dispatch import eager_op
 
 def _sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
                     scale=None, dropout_key=None):
+    # GQA/MQA: this path materializes s×s scores anyway, so repeating KV
+    # costs nothing extra (the Pallas path never repeats)
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # [b, s, h, d] → [b, h, s, d]
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
@@ -60,13 +66,13 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  training=True, scale=None):
     use_dropout = dropout_p > 0.0 and training
     if attn_mask is None and not use_dropout and \
+            query.shape[1] == key.shape[1] and \
             _use_pallas(query.shape, query.shape[-1]):
-        try:
-            from paddle_tpu.ops.pallas.flash_attention import flash_attention
-            return flash_attention(query, key, value, causal=is_causal,
-                                   scale=scale)
-        except Exception:
-            pass
+        # no try/except: a lowering break in the flagship kernel must
+        # surface, not silently fall back (round-1 lesson)
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        return flash_attention(query, key, value, causal=is_causal,
+                               scale=scale)
     dk = None
     if use_dropout:
         from paddle_tpu.core import functional as _cf

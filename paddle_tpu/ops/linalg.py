@@ -66,7 +66,8 @@ def norm(x, p=None, axis=None, keepdim=False):
     if axis is None:
         x = x.ravel()
         axis = 0
-    if jnp.isinf(p):
+    import math
+    if isinstance(p, (int, float)) and math.isinf(p):
         f = jnp.max if p > 0 else jnp.min
         return f(jnp.abs(x), axis=axis, keepdims=keepdim)
     if p == 0:

@@ -8,12 +8,23 @@ blockwise kernel:
 * forward: online-softmax over K/V blocks streamed HBM→VMEM by the grid
   pipeline; scores/accumulators live in VMEM scratch in fp32; the MXU does
   the two matmuls per block.  Saves per-row logsumexp for the backward.
+  GQA/MQA is handled in the grid itself: the K/V BlockSpec index map sends
+  q-head h to kv-head h // (hq // hk), so KV tiles are fetched once per
+  group instead of materializing repeated heads in HBM.
 * backward: blockwise recompute from the saved logsumexp (flash-attention-2
-  style) expressed in JAX and left to XLA to fuse — dQ/dK/dV each come from
-  one scan over blocks, so backward memory is O(seq·block), not O(seq²).
+  style) expressed in JAX with grouped-GQA einsums and left to XLA to fuse —
+  dQ/dK/dV each come from one scan over blocks, so backward memory is
+  O(seq·block), not O(seq²), and dK/dV sum over the query group without
+  ever materializing repeated KV.
+
+Mosaic legality notes (the round-1 kernel broke here): every output block's
+last two dims must be (divisible by 8, divisible by 128) or equal to the
+array dims.  The logsumexp is therefore emitted as [b, h, nq, 1, block_q]
+— block (1,1,1,1,block_q) is legal because the trailing two dims equal the
+array's — and reshaped to [b, h, s] outside the kernel.
 
 Layout: [batch, seq, heads, head_dim] (paddle convention) at the API;
-kernels see [batch*heads, seq, head_dim].
+kernels see [batch, heads, seq, head_dim].
 """
 
 from __future__ import annotations
@@ -37,14 +48,13 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *, block_q, block_k, scale, causal,
-                seq_len):
-    """Grid: (batch*heads, num_q_blocks, num_k_blocks); the k axis is the
+                acc_ref, m_ref, l_ref, *, block_q, block_k, scale, causal):
+    """Grid: (batch, q_heads, num_q_blocks, num_k_blocks); the k axis is the
     innermost (sequential) dim, so VMEM scratch carries the online-softmax
     state across k blocks."""
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
 
     @pl.when(kj == 0)
     def _init():
@@ -53,8 +63,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0]                                   # [bq, d]
-        k = k_ref[0]                                   # [bk, d]
+        q = q_ref[0, 0]                                # [bq, d]
+        k = k_ref[0, 0]                                # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -73,7 +83,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         correction = jnp.exp(m_prev - m_new)           # [bq, 1]
         l_new = correction * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [bq, d]
         acc_ref[:] = acc_ref[:] * correction + pv
         m_ref[:] = m_new
@@ -91,20 +101,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = l_ref[:]
         safe_l = jnp.where(l > 0, l, 1.0)
-        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(safe_l))[:, 0]
+        o_ref[0, 0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:] + jnp.log(safe_l)               # [bq, 1]
+        lse_ref[0, 0, 0] = lse.reshape(1, block_q)
 
 
 def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k,
                 interpret=False):
-    """q,k,v: [bh, s, d] → (out [bh, s, d], lse [bh, s])."""
-    bh, s, d = q.shape
+    """q: [b, hq, s, d]; k,v: [b, hk, s, d] → (out [b, hq, s, d],
+    lse [b, hq, s] fp32)."""
+    b, hq, s, d = q.shape
+    hk = k.shape[1]
+    rep = hq // hk
     nq = pl.cdiv(s, block_q)
     nk = pl.cdiv(s, block_k)
 
     kernel = functools.partial(
         _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
-        causal=causal, seq_len=s)
+        causal=causal)
 
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),
@@ -112,64 +126,82 @@ def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k,
         pltpu.VMEM((block_q, 1), jnp.float32),
     ]
 
-    return pl.pallas_call(
+    params = {}
+    if _HAVE_TPU_PL and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+
+    out, lse5 = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
+        grid=(b, hq, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // rep, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, 1, block_q),
+                         lambda b_, h, i, j: (b_, h, i, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, nq, 1, block_q), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
+        **params,
     )(q, k, v)
+    return out, lse5.reshape(b, hq, s)
 
 
 # -- backward: blockwise recompute in JAX (flash-attn-2 equations) -----------
 
 def _bwd_blockwise(res, g, *, scale, causal, block_k):
     """Memory-efficient backward: scan over K/V blocks; recompute P from
-    q,k and the saved logsumexp.  All matmuls MXU-shaped; XLA fuses the
-    elementwise chain."""
-    q, k, v, out, lse = res           # q,k,v,out [bh,s,d]; lse [bh,s]
-    bh, s, d = q.shape
+    q,k and the saved logsumexp.  Grouped-GQA einsums keep KV at hk heads;
+    dK/dV sum over the query group (r axis) inside the contraction.  All
+    matmuls MXU-shaped; XLA fuses the elementwise chain."""
+    q, k, v, out, lse = res      # q,out [b,hq,s,d]; k,v [b,hk,s,d]
+    b, hq, s, d = q.shape
+    hk = k.shape[1]
+    rep = hq // hk
     g = g.astype(jnp.float32)
-    qf = q.astype(jnp.float32)
+    qf = q.astype(jnp.float32).reshape(b, hk, rep, s, d)
     kf = k.astype(jnp.float32)
     vf = v.astype(jnp.float32)
     of = out.astype(jnp.float32)
+    gg = g.reshape(b, hk, rep, s, d)
+    lse_g = lse.reshape(b, hk, rep, s)
 
     # delta_i = sum_d(dO * O) — rowwise (flash-attn-2 eq. 4)
-    delta = jnp.sum(g * of, axis=-1)                   # [bh, s]
+    delta = jnp.sum(g * of, axis=-1).reshape(b, hk, rep, s)
 
     nk = s // block_k
-    kb = kf.reshape(bh, nk, block_k, d)
-    vb = vf.reshape(bh, nk, block_k, d)
+    kb = kf.reshape(b, hk, nk, block_k, d)
+    vb = vf.reshape(b, hk, nk, block_k, d)
 
     q_pos = jnp.arange(s)
 
     def one_block(j):
-        kj = kb[:, j]                                  # [bh, bk, d]
-        vj = vb[:, j]
-        sij = jnp.einsum("bqd,bkd->bqk", qf, kj) * scale
+        kj = kb[:, :, j]                               # [b, hk, bk, d]
+        vj = vb[:, :, j]
+        sij = jnp.einsum("bgrqd,bgkd->bgrqk", qf, kj) * scale
         if causal:
             k_pos = j * block_k + jnp.arange(block_k)
             mask = q_pos[:, None] >= k_pos[None, :]
-            sij = jnp.where(mask[None], sij, _NEG_INF)
-        pij = jnp.exp(sij - lse[:, :, None])           # [bh, q, bk]
-        dv_j = jnp.einsum("bqk,bqd->bkd", pij, g)
-        dp = jnp.einsum("bqd,bkd->bqk", g, vj)
-        ds = pij * (dp - delta[:, :, None]) * scale
-        dq_contrib = jnp.einsum("bqk,bkd->bqd", ds, kj)
-        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)
+            sij = jnp.where(mask[None, None, None], sij, _NEG_INF)
+        pij = jnp.exp(sij - lse_g[..., None])          # [b,g,r,q,bk]
+        dv_j = jnp.einsum("bgrqk,bgrqd->bgkd", pij, gg)
+        dp = jnp.einsum("bgrqd,bgkd->bgrqk", gg, vj)
+        ds = pij * (dp - delta[..., None]) * scale
+        dq_contrib = jnp.einsum("bgrqk,bgkd->bgrqd", ds, kj)
+        dk_j = jnp.einsum("bgrqk,bgrqd->bgkd", ds, qf)
         return dq_contrib, dk_j, dv_j
 
     def scan_body(dq_acc, j):
@@ -178,8 +210,9 @@ def _bwd_blockwise(res, g, *, scale, causal, block_k):
 
     dq, (dks, dvs) = jax.lax.scan(scan_body, jnp.zeros_like(qf),
                                   jnp.arange(nk))
-    dk = jnp.moveaxis(dks, 0, 1).reshape(bh, s, d)
-    dv = jnp.moveaxis(dvs, 0, 1).reshape(bh, s, d)
+    dq = dq.reshape(b, hq, s, d)
+    dk = jnp.moveaxis(dks, 0, 2).reshape(b, hk, s, d)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(b, hk, s, d)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -205,32 +238,33 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = None, block_k: int = None,
                     interpret: bool = None):
-    """q,k,v: [batch, seq, heads, head_dim] (paddle layout).  Requires seq
-    divisible by the block sizes (callers pad; the model stack keeps seq a
-    multiple of 128 for MXU efficiency anyway)."""
+    """q: [batch, seq, heads, head_dim]; k,v: [batch, seq, kv_heads,
+    head_dim] (paddle layout).  Requires seq divisible by the block sizes
+    (callers pad; the model stack keeps seq a multiple of 128 for MXU
+    efficiency anyway) and heads % kv_heads == 0."""
     b, s, h, d = q.shape
+    hk = k.shape[2]
+    if h % hk:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hk}")
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if block_q is None:
+        block_q = min(128, s)
+    if block_k is None:
+        block_k = min(128, s)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
         raise ValueError(f"seq {s} must be divisible by block sizes "
                          f"({block_q},{block_k})")
 
-    # GQA/MQA: broadcast kv heads to q heads
-    hk = k.shape[2]
-    if hk != h:
-        rep = h // hk
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    def to_bhsd(x):
+        return jnp.swapaxes(x, 1, 2)
 
-    def to_bh(x):
-        return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-
-    out = _flash_core(to_bh(q), to_bh(k), to_bh(v), float(scale),
+    out = _flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(scale),
                       bool(causal), block_q, block_k, bool(interpret))
-    return jnp.swapaxes(out.reshape(b, h, s, d), 1, 2)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,324 @@
+"""OpTest — declarative numeric op-testing harness.
+
+Rebuild of the reference's highest-leverage test framework
+(test/legacy_test/eager_op_test.py: class OpTest :377, check_output :2143,
+check_grad vs finite differences :2325, numeric grad :133): declare numpy
+inputs/attrs once; the harness checks every execution mode and the
+gradients against central finite differences with per-dtype tolerances.
+
+Modes checked by ``check_output``:
+  * eager     — Tensor inputs through the dispatch tape
+  * jit       — the op under jax.jit on raw arrays
+  * functional— raw jax arrays (no Tensor wrapper), the in-trace path
+
+Gradient checks (``check_grad``):
+  * eager tape (Tensor.backward) and jax.grad both vs central differences
+
+Usage:
+    class TestAdd(OpTest):
+        def setup(self):
+            self.op = paddle_tpu.add
+            self.inputs = {"x": rand(3, 4), "y": rand(3, 4)}
+            self.ref = np.add          # numpy oracle
+    # or the compact spec form:
+    make_op_test(op=pp.add, ref=np.add, n_inputs=2)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["OpTest", "op_case", "binary_cases", "unary_cases"]
+
+# per-dtype (rtol, atol) — mirrors the reference's per-dtype thresholds
+# (op_accuracy_white_list / check_output atol args).  CPU XLA matmuls run
+# in reduced precision by default, so fp32 tolerances are not 1e-7.
+_TOL = {
+    np.dtype(np.float64): (1e-7, 1e-7),
+    np.dtype(np.float32): (1e-5, 1e-6),
+    np.dtype(np.float16): (1e-2, 1e-3),
+    # bf16 ~ 8 mantissa bits
+    "bfloat16": (2e-2, 2e-2),
+}
+
+
+def _tol_for(dtype, rtol=None, atol=None):
+    key = "bfloat16" if str(dtype) == "bfloat16" else np.dtype(dtype)
+    base_r, base_a = _TOL.get(key, (1e-5, 1e-6))
+    return (rtol if rtol is not None else base_r,
+            atol if atol is not None else base_a)
+
+
+def _to_np(x):
+    import jax.numpy as jnp
+    if hasattr(x, "_data"):
+        x = x._data
+    if hasattr(x, "dtype") and x.dtype == jnp.bfloat16:
+        return np.asarray(x.astype(jnp.float32))
+    return np.asarray(x)
+
+
+def _assert_close(got, want, rtol, atol, what):
+    got, want = _to_np(got), _to_np(want)
+    assert got.shape == tuple(np.shape(want)), \
+        f"{what}: shape {got.shape} != {np.shape(want)}"
+    if got.size == 0:
+        return
+    if got.dtype == bool or np.issubdtype(got.dtype, np.integer):
+        np.testing.assert_array_equal(got, want, err_msg=what)
+    else:
+        np.testing.assert_allclose(got, np.asarray(want, got.dtype),
+                                   rtol=rtol, atol=atol, err_msg=what)
+
+
+class OpTest:
+    """Subclass, implement setup(), get all modes + grads checked.
+
+    Attributes set by setup():
+      op:       the paddle_tpu op (eager_op-wrapped callable)
+      inputs:   {name: np.ndarray} tensor inputs (ordered — passed
+                positionally in declaration order)
+      attrs:    {name: value} non-tensor kwargs
+      ref:      numpy oracle fn(*inputs_np, **attrs) -> array / tuple
+      grad_inputs: names to gradient-check (default: float inputs)
+      out_index: when the op returns a tuple, which element to check
+                 gradients through (default 0)
+    """
+
+    op: Callable = None
+    inputs: Dict[str, np.ndarray] = None
+    attrs: Dict[str, Any] = None
+    ref: Callable = None
+    grad_inputs: Optional[Sequence[str]] = None
+    out_index: int = 0
+    rtol: Optional[float] = None
+    atol: Optional[float] = None
+    # max relative error for finite-difference grad comparison
+    # (reference default max_relative_error=0.005; FD in f32 is noisy)
+    grad_rtol: float = 1e-2
+    fd_eps: float = 1e-2
+
+    def setup(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- helpers -----------------------------------------------------------
+    def _pure(self):
+        """The op on raw jax arrays (bypassing the Tensor tape)."""
+        op = self.op
+        attrs = self.attrs or {}
+
+        def fn(*arrays):
+            out = op(*arrays, **attrs)
+            return out
+
+        return fn
+
+    def _ref_out(self):
+        vals = [v for v in self.inputs.values()]
+        out = self.ref(*vals, **(self.attrs or {}))
+        return out
+
+    # -- checks ------------------------------------------------------------
+    def check_output(self):
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as pp
+
+        self.setup()
+        attrs = self.attrs or {}
+        names = list(self.inputs)
+        arrays = [jnp.asarray(self.inputs[n]) for n in names]
+        want = self._ref_out()
+        multi = isinstance(want, tuple)
+
+        dtype = arrays[0].dtype if arrays else np.float32
+        rtol, atol = _tol_for(dtype, self.rtol, self.atol)
+
+        def compare(out, mode):
+            if multi:
+                for i, w in enumerate(want):
+                    if w is None:
+                        continue
+                    _assert_close(out[i], w, rtol, atol,
+                                  f"{self._opname()}[{mode}] out{i}")
+            else:
+                _assert_close(out, want, rtol, atol,
+                              f"{self._opname()}[{mode}]")
+
+        # eager (Tensor) mode
+        tens = [pp.to_tensor(self.inputs[n]) for n in names]
+        compare(self.op(*tens, **attrs), "eager")
+        # functional (raw) mode
+        compare(self.op(*arrays, **attrs), "functional")
+        # jit mode
+        compare(jax.jit(self._pure())(*arrays), "jit")
+
+    def check_grad(self):
+        """Analytic grads (eager tape AND jax.grad) vs central differences,
+        through a scalar projection loss sum(out * w) with fixed random w
+        (the reference uses uniform dout; a random projection catches
+        sign/transpose errors plain sums miss)."""
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as pp
+
+        self.setup()
+        attrs = self.attrs or {}
+        names = list(self.inputs)
+        which = list(self.grad_inputs if self.grad_inputs is not None else
+                     [n for n in names
+                      if np.issubdtype(np.asarray(self.inputs[n]).dtype,
+                                       np.floating)])
+        if not which:
+            return
+        arrays = [jnp.asarray(self.inputs[n]) for n in names]
+
+        rng = np.random.default_rng(0)
+        out_probe = self._pure()(*arrays)
+        if isinstance(out_probe, tuple):
+            out_probe = out_probe[self.out_index]
+        w = jnp.asarray(rng.standard_normal(out_probe.shape),
+                        out_probe.dtype) if out_probe.size else \
+            jnp.zeros(out_probe.shape, out_probe.dtype)
+
+        idx = self.out_index
+
+        def scalar_loss(*arrays_):
+            out = self._pure()(*arrays_)
+            if isinstance(out, tuple):
+                out = out[idx]
+            return jnp.sum(out.astype(jnp.float32)
+                           * w.astype(jnp.float32))
+
+        argnums = tuple(names.index(n) for n in which)
+        analytic = jax.grad(scalar_loss, argnums=argnums)(*arrays)
+
+        # eager-tape grads for the same projection
+        tens = [pp.to_tensor(self.inputs[n]) for n in names]
+        for t, n in zip(tens, names):
+            t.stop_gradient = n not in which
+        out_t = self.op(*tens, **attrs)
+        if isinstance(out_t, (tuple, list)):
+            out_t = out_t[idx]
+        loss_t = (out_t.astype("float32") * pp.to_tensor(np.asarray(w))
+                  ).sum()
+        loss_t.backward()
+
+        for n, g_an in zip(which, analytic):
+            x_np = np.asarray(self.inputs[n], np.float32)
+            i = names.index(n)
+            g_fd = self._numeric_grad(scalar_loss, arrays, i, x_np)
+            g_an = _to_np(g_an)
+            self._compare_grads(g_an, g_fd, f"{self._opname()} d/d{n} "
+                                            f"(jax.grad vs FD)")
+            g_tape = tens[i].grad
+            if g_tape is not None:
+                self._compare_grads(_to_np(g_tape), g_an,
+                                    f"{self._opname()} d/d{n} "
+                                    f"(tape vs jax.grad)", tight=True)
+
+    def _numeric_grad(self, loss, arrays, i, x_np):
+        """Central differences, one element at a time (reference
+        get_numeric_gradient :133)."""
+        import jax.numpy as jnp
+        eps = self.fd_eps
+        flat = x_np.reshape(-1).copy()
+        g = np.zeros_like(flat, np.float64)
+        for j in range(flat.size):
+            orig = flat[j]
+            for sign, store in ((1.0, 0), (-1.0, 1)):
+                flat[j] = orig + sign * eps
+                arrs = list(arrays)
+                arrs[i] = jnp.asarray(flat.reshape(x_np.shape),
+                                      arrays[i].dtype)
+                val = float(loss(*arrs))
+                if store == 0:
+                    plus = val
+                else:
+                    minus = val
+            g[j] = (plus - minus) / (2 * eps)
+            flat[j] = orig
+        return g.reshape(x_np.shape)
+
+    def _compare_grads(self, got, want, what, tight=False):
+        got = np.asarray(got, np.float64).reshape(-1)
+        want = np.asarray(want, np.float64).reshape(-1)
+        if got.size == 0:
+            return
+        if tight:
+            np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                       err_msg=what)
+            return
+        # reference-style max relative error against max(|grad|, 1)
+        denom = np.maximum(np.abs(want).max(), 1.0)
+        max_err = np.abs(got - want).max() / denom
+        assert max_err < self.grad_rtol, \
+            f"{what}: max relative grad error {max_err:.3e} " \
+            f">= {self.grad_rtol}"
+
+    def _opname(self):
+        return getattr(self.op, "__name__", str(self.op))
+
+    def run(self, grad=True):
+        self.check_output()
+        if grad:
+            self.check_grad()
+
+
+# -- compact spec helpers ----------------------------------------------------
+
+class op_case(OpTest):
+    """One-liner OpTest: op_case(op, ref, inputs, attrs=..., ...).run()"""
+
+    def __init__(self, op, ref, inputs, attrs=None, grad_inputs=None,
+                 rtol=None, atol=None, grad_rtol=None, out_index=0,
+                 fd_eps=None):
+        self._spec = dict(op=op, ref=ref, inputs=inputs, attrs=attrs or {},
+                          grad_inputs=grad_inputs, rtol=rtol, atol=atol,
+                          out_index=out_index)
+        if grad_rtol is not None:
+            self.grad_rtol = grad_rtol
+        if fd_eps is not None:
+            self.fd_eps = fd_eps
+
+    def setup(self):
+        for k, v in self._spec.items():
+            setattr(self, k, v)
+
+
+def _rand(shape, dtype=np.float32, lo=-1.0, hi=1.0, seed=None):
+    rng = np.random.default_rng(seed if seed is not None else abs(hash(
+        (tuple(shape), str(dtype)))) % (2 ** 31))
+    return (rng.uniform(lo, hi, shape)).astype(dtype)
+
+
+def binary_cases(op, ref, lo=-1.0, hi=1.0, grad=True, dtypes=(np.float32,),
+                 grad_rtol=None):
+    """Standard shape sweep for a binary elementwise op: same-shape,
+    broadcast, scalar-operand, 0-size (the reference's degenerate-shape
+    coverage)."""
+    shapes = [((3, 4), (3, 4)), ((2, 3, 4), (3, 4)), ((3, 1), (1, 4)),
+              ((4,), ()), ((0, 3), (0, 3))]
+    cases = []
+    for dt in dtypes:
+        for sx, sy in shapes:
+            cases.append(op_case(
+                op, ref,
+                {"x": _rand(sx, dt, lo, hi), "y": _rand(sy, dt, lo, hi)},
+                grad_inputs=None if grad else [], grad_rtol=grad_rtol))
+    return cases
+
+
+def unary_cases(op, ref, lo=-1.0, hi=1.0, grad=True, dtypes=(np.float32,),
+                grad_rtol=None, fd_eps=None):
+    shapes = [(3, 4), (2, 3, 4), (), (0, 4)]
+    cases = []
+    for dt in dtypes:
+        for s in shapes:
+            cases.append(op_case(
+                op, ref, {"x": _rand(s, dt, lo, hi)},
+                grad_inputs=None if grad else [], grad_rtol=grad_rtol,
+                fd_eps=fd_eps))
+    return cases

@@ -5,7 +5,11 @@ the pattern prescribed by the task environment and mirroring the reference's
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# "cpu,axon": default backend is the 8-device virtual CPU mesh, but a
+# tunneled TPU (axon plugin) stays visible so the real-hardware smoke tests
+# (test_flash_attention_tpu.py) can compile for the chip instead of
+# silently skipping.  Falls back to cpu-only when no tunnel is attached.
+os.environ["JAX_PLATFORMS"] = "cpu,axon"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,7 +20,11 @@ import jax  # noqa: E402
 # The environment's sitecustomize may have force-selected a remote TPU
 # platform via jax.config.update("jax_platforms", ...) at interpreter start,
 # which overrides the env var; undo it so tests run on the virtual CPU mesh.
-jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_platforms", "cpu,axon")
+    jax.devices()  # force platform init; raises if axon is unavailable
+except Exception:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

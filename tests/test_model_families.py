@@ -104,7 +104,8 @@ class TestMoELLM:
 
         def loss(ps, ids):
             out = functional_call(model, ps, pp.Tensor(ids))
-            return (out._data.astype(jnp.float32) ** 2).mean()
+            out = out._data if hasattr(out, "_data") else out
+            return (out.astype(jnp.float32) ** 2).mean()
 
         ids = jnp.asarray(np.random.default_rng(0).integers(
             0, cfg.vocab_size, (1, 8)), jnp.int32)

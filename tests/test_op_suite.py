@@ -1,0 +1,283 @@
+"""Op-surface numeric suite on the OpTest harness (paddle_tpu.testing).
+
+Mirrors the reference's test/legacy_test per-op OpTest files: every spec
+declares numpy inputs + a numpy oracle; the harness checks eager / raw /
+jit outputs and analytic-vs-finite-difference gradients.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.testing import op_case, binary_cases, unary_cases, _rand
+
+
+def _sp(name, cases, grad_on_first=True):
+    """(name, case, check_grad) triples for parametrization."""
+    out = []
+    for i, c in enumerate(cases):
+        out.append(pytest.param(c, grad_on_first and i == 0,
+                                id=f"{name}-{i}"))
+    return out
+
+
+# ----------------------------------------------------------- elementwise
+BINARY = []
+BINARY += _sp("add", binary_cases(pp.add, np.add))
+BINARY += _sp("subtract", binary_cases(pp.subtract, np.subtract))
+BINARY += _sp("multiply", binary_cases(pp.multiply, np.multiply))
+BINARY += _sp("divide", binary_cases(pp.divide, np.divide, lo=0.5, hi=2.0))
+BINARY += _sp("maximum", binary_cases(pp.maximum, np.maximum, grad=False))
+BINARY += _sp("minimum", binary_cases(pp.minimum, np.minimum, grad=False))
+BINARY += _sp("pow", binary_cases(pp.pow, np.power, lo=0.5, hi=2.0,
+                                  grad_rtol=3e-2))
+BINARY += _sp("atan2", binary_cases(pp.atan2, np.arctan2, lo=0.3, hi=2.0))
+BINARY += _sp("hypot", binary_cases(pp.hypot, np.hypot, lo=0.5, hi=2.0))
+BINARY += _sp("logaddexp", binary_cases(pp.logaddexp, np.logaddexp))
+BINARY += _sp("heaviside", binary_cases(pp.heaviside, np.heaviside,
+                                        grad=False))
+BINARY += _sp("fmax", binary_cases(pp.fmax, np.fmax, grad=False))
+BINARY += _sp("fmin", binary_cases(pp.fmin, np.fmin, grad=False))
+BINARY += _sp("remainder", binary_cases(pp.remainder, np.remainder,
+                                        lo=0.5, hi=2.0, grad=False))
+BINARY += _sp("floor_divide", binary_cases(pp.floor_divide,
+                                           np.floor_divide, lo=0.5, hi=3.0,
+                                           grad=False))
+BINARY += _sp("copysign", binary_cases(pp.copysign, np.copysign,
+                                       grad=False))
+BINARY += _sp("nextafter", binary_cases(pp.nextafter, np.nextafter,
+                                        grad=False))
+
+UNARY = []
+UNARY += _sp("exp", unary_cases(pp.exp, np.exp))
+UNARY += _sp("expm1", unary_cases(pp.expm1, np.expm1))
+UNARY += _sp("log", unary_cases(pp.log, np.log, lo=0.5, hi=3.0))
+UNARY += _sp("log2", unary_cases(pp.log2, np.log2, lo=0.5, hi=3.0))
+UNARY += _sp("log10", unary_cases(pp.log10, np.log10, lo=0.5, hi=3.0))
+UNARY += _sp("log1p", unary_cases(pp.log1p, np.log1p, lo=-0.4, hi=2.0))
+UNARY += _sp("sqrt", unary_cases(pp.sqrt, np.sqrt, lo=0.3, hi=3.0))
+UNARY += _sp("rsqrt", unary_cases(pp.rsqrt, lambda x: 1 / np.sqrt(x),
+                                  lo=0.3, hi=3.0))
+UNARY += _sp("abs", unary_cases(pp.abs, np.abs, lo=0.2, hi=2.0))
+UNARY += _sp("ceil", unary_cases(pp.ceil, np.ceil, grad=False))
+UNARY += _sp("floor", unary_cases(pp.floor, np.floor, grad=False))
+UNARY += _sp("round", unary_cases(pp.round, np.round, grad=False))
+UNARY += _sp("trunc", unary_cases(pp.trunc, np.trunc, grad=False))
+UNARY += _sp("sign", unary_cases(pp.sign, np.sign, grad=False))
+UNARY += _sp("sin", unary_cases(pp.sin, np.sin))
+UNARY += _sp("cos", unary_cases(pp.cos, np.cos))
+UNARY += _sp("tan", unary_cases(pp.tan, np.tan, lo=-1.0, hi=1.0))
+UNARY += _sp("asin", unary_cases(pp.asin, np.arcsin, lo=-0.8, hi=0.8,
+                                 grad_rtol=3e-2))
+UNARY += _sp("acos", unary_cases(pp.acos, np.arccos, lo=-0.8, hi=0.8,
+                                 grad_rtol=3e-2))
+UNARY += _sp("atan", unary_cases(pp.atan, np.arctan))
+UNARY += _sp("sinh", unary_cases(pp.sinh, np.sinh))
+UNARY += _sp("cosh", unary_cases(pp.cosh, np.cosh))
+UNARY += _sp("tanh", unary_cases(pp.tanh, np.tanh))
+UNARY += _sp("asinh", unary_cases(pp.asinh, np.arcsinh))
+UNARY += _sp("acosh", unary_cases(pp.acosh, np.arccosh, lo=1.3, hi=3.0))
+UNARY += _sp("atanh", unary_cases(pp.atanh, np.arctanh, lo=-0.7, hi=0.7,
+                                  grad_rtol=3e-2))
+UNARY += _sp("reciprocal", unary_cases(pp.reciprocal, lambda x: 1.0 / x,
+                                       lo=0.5, hi=2.0))
+UNARY += _sp("square", unary_cases(pp.square, np.square))
+UNARY += _sp("sigmoid", unary_cases(
+    pp.nn.functional.sigmoid, lambda x: 1 / (1 + np.exp(-x))))
+import scipy.special as _ss  # noqa: E402
+UNARY += _sp("erf", unary_cases(pp.erf, _ss.erf))
+UNARY += _sp("lgamma", unary_cases(pp.lgamma, _ss.gammaln, lo=0.5, hi=3.0,
+                                   grad_rtol=3e-2))
+UNARY += _sp("digamma", unary_cases(pp.digamma, _ss.digamma, lo=0.8,
+                                    hi=3.0, grad_rtol=3e-2))
+UNARY += _sp("expit-bf16", unary_cases(
+    pp.exp, np.exp, dtypes=(np.float32,), grad=False))
+
+
+# --------------------------------------------------------- reductions
+def _reduction_cases():
+    x = _rand((3, 4, 5))
+    specs = [
+        ("sum", op_case(pp.sum, lambda x, axis=None, keepdim=False:
+                        np.sum(x, axis=axis, keepdims=keepdim),
+                        {"x": x}, attrs={"axis": 1})),
+        ("sum_keep", op_case(pp.sum, lambda x, axis=None, keepdim=False:
+                             np.sum(x, axis=axis, keepdims=keepdim),
+                             {"x": x}, attrs={"axis": (0, 2),
+                                              "keepdim": True})),
+        ("mean", op_case(pp.mean, lambda x, axis=None, keepdim=False:
+                         np.mean(x, axis=axis, keepdims=keepdim),
+                         {"x": x}, attrs={"axis": -1})),
+        ("max", op_case(pp.max, lambda x, axis=None, keepdim=False:
+                        np.max(x, axis=axis, keepdims=keepdim),
+                        {"x": x}, attrs={"axis": 0}, grad_inputs=[])),
+        ("min", op_case(pp.min, lambda x, axis=None, keepdim=False:
+                        np.min(x, axis=axis, keepdims=keepdim),
+                        {"x": x}, attrs={"axis": 2}, grad_inputs=[])),
+        ("prod", op_case(pp.prod, lambda x, axis=None, keepdim=False,
+                         dtype=None: np.prod(x, axis=axis, keepdims=keepdim),
+                         {"x": _rand((3, 4), lo=0.5, hi=1.5)},
+                         attrs={"axis": 1})),
+        ("logsumexp", op_case(
+            pp.logsumexp, lambda x, axis=None, keepdim=False:
+            _ss.logsumexp(x, axis=axis, keepdims=keepdim),
+            {"x": x}, attrs={"axis": 1})),
+        ("cumsum", op_case(pp.cumsum, lambda x, axis=None, dtype=None:
+                           np.cumsum(x, axis=axis), {"x": x},
+                           attrs={"axis": 1})),
+        ("cumprod", op_case(pp.cumprod, lambda x, dim=None, dtype=None:
+                            np.cumprod(x, axis=dim),
+                            {"x": _rand((3, 4), lo=0.5, hi=1.5)},
+                            attrs={"dim": 1})),
+        ("nansum", op_case(pp.nansum, lambda x, axis=None, dtype=None,
+                           keepdim=False: np.nansum(x, axis=axis,
+                                                    keepdims=keepdim),
+                           {"x": x}, attrs={"axis": 0}, grad_inputs=[])),
+        ("count_nonzero", op_case(
+            pp.count_nonzero, lambda x, axis=None, keepdim=False:
+            np.count_nonzero(x, axis=axis), {"x": x}, attrs={"axis": 1},
+            grad_inputs=[])),
+        ("trace", op_case(pp.trace, lambda x, offset=0, axis1=0, axis2=1:
+                          np.trace(x, offset=offset, axis1=axis1,
+                                   axis2=axis2),
+                          {"x": _rand((4, 4))}, attrs={"offset": 1})),
+    ]
+    return [pytest.param(c, True, id=n) for n, c in specs]
+
+
+# --------------------------------------------------------- manipulation
+def _manip_cases():
+    x = _rand((3, 4, 5))
+    specs = [
+        ("reshape", op_case(pp.reshape, lambda x, shape: np.reshape(x, shape),
+                            {"x": x}, attrs={"shape": [4, 15]})),
+        ("transpose", op_case(pp.transpose,
+                              lambda x, perm: np.transpose(x, perm),
+                              {"x": x}, attrs={"perm": [2, 0, 1]})),
+        ("squeeze", op_case(pp.squeeze, lambda x, axis=None:
+                            np.squeeze(x, axis=axis),
+                            {"x": _rand((3, 1, 5))}, attrs={"axis": 1})),
+        ("unsqueeze", op_case(pp.unsqueeze, lambda x, axis:
+                              np.expand_dims(x, axis),
+                              {"x": _rand((3, 4))}, attrs={"axis": 1})),
+        ("flip", op_case(pp.flip, lambda x, axis: np.flip(x, axis),
+                         {"x": x}, attrs={"axis": [0, 2]})),
+        ("roll", op_case(pp.roll, lambda x, shifts, axis=None:
+                         np.roll(x, shifts, axis),
+                         {"x": x}, attrs={"shifts": 2, "axis": 1})),
+        ("tile", op_case(pp.tile, lambda x, repeat_times:
+                         np.tile(x, repeat_times),
+                         {"x": _rand((2, 3))},
+                         attrs={"repeat_times": [2, 2]})),
+        ("gather", op_case(
+            pp.gather, lambda x, index, axis=0: np.take(x, index, axis),
+            {"x": x, "index": np.array([2, 0, 1])}, attrs={"axis": 1},
+            grad_inputs=["x"])),
+        ("index_select", op_case(
+            pp.index_select,
+            lambda x, index, axis=0: np.take(x, index, axis),
+            {"x": x, "index": np.array([0, 2])}, attrs={"axis": 0},
+            grad_inputs=["x"])),
+        ("pad", op_case(
+            pp.ops.manipulation.pad,
+            lambda x, pad, mode="constant", value=0.0, **kw:
+            np.pad(x, [(0, 0), (1, 2)], constant_values=value),
+            {"x": _rand((3, 4))}, attrs={"pad": [1, 2], "value": 0.0})),
+        ("where", op_case(
+            pp.where, lambda c, x, y: np.where(c, x, y),
+            {"condition": np.array([[True, False], [False, True]]),
+             "x": _rand((2, 2)), "y": _rand((2, 2))},
+            grad_inputs=["x", "y"])),
+        ("masked_fill", op_case(
+            pp.masked_fill,
+            lambda x, m, value=0.0: np.where(m, np.float32(value), x),
+            {"x": _rand((3, 4)), "mask": np.tri(3, 4) > 0},
+            attrs={"value": 2.5}, grad_inputs=["x"])),
+        ("take_along_axis", op_case(
+            pp.take_along_axis,
+            lambda a, idx, axis, broadcast=True:
+            np.take_along_axis(a, idx, axis),
+            {"arr": x, "indices": np.argsort(x, axis=2)}, attrs={"axis": 2},
+            grad_inputs=["arr"])),
+    ]
+    return [pytest.param(c, True, id=n) for n, c in specs]
+
+
+# --------------------------------------------------------- linalg / logic
+def _linalg_cases():
+    specs = [
+        ("matmul", op_case(pp.matmul,
+                           lambda x, y, transpose_x=False, transpose_y=False:
+                           np.matmul(x, y),
+                           {"x": _rand((3, 4)), "y": _rand((4, 5))},
+                           rtol=2e-2, atol=2e-2, grad_rtol=3e-2)),
+        ("matmul_bat", op_case(
+            pp.matmul, lambda x, y, transpose_x=False, transpose_y=False:
+            np.matmul(x, y),
+            {"x": _rand((2, 3, 4)), "y": _rand((2, 4, 2))},
+            rtol=2e-2, atol=2e-2, grad_rtol=3e-2)),
+        ("matmul_tx", op_case(
+            pp.matmul, lambda x, y, transpose_x=False, transpose_y=False:
+            np.matmul(x.T, y),
+            {"x": _rand((4, 3)), "y": _rand((4, 5))},
+            attrs={"transpose_x": True}, rtol=2e-2, atol=2e-2,
+            grad_rtol=3e-2)),
+        ("dot", op_case(pp.dot, lambda x, y: np.sum(x * y, -1),
+                        {"x": _rand((5,)), "y": _rand((5,))})),
+        ("norm2", op_case(
+            pp.ops.linalg.norm,
+            lambda x, p=2, axis=None, keepdim=False:
+            np.linalg.norm(x, axis=axis),
+            {"x": _rand((3, 4), lo=0.3, hi=2.0)}, attrs={"axis": 1})),
+    ]
+    return [pytest.param(c, True, id=n) for n, c in specs]
+
+
+def _logic_cases():
+    a, b = _rand((3, 4)), _rand((3, 4))
+    ints = np.arange(12).reshape(3, 4)
+    specs = [
+        ("equal", op_case(pp.equal, np.equal, {"x": ints, "y": ints.copy()},
+                          grad_inputs=[])),
+        ("not_equal", op_case(pp.not_equal, np.not_equal,
+                              {"x": ints, "y": ints.T.reshape(3, 4)},
+                              grad_inputs=[])),
+        ("less_than", op_case(pp.less_than, np.less, {"x": a, "y": b},
+                              grad_inputs=[])),
+        ("greater_equal", op_case(pp.greater_equal, np.greater_equal,
+                                  {"x": a, "y": b}, grad_inputs=[])),
+        ("logical_and", op_case(pp.logical_and, np.logical_and,
+                                {"x": a > 0, "y": b > 0}, grad_inputs=[])),
+        ("logical_not", op_case(pp.logical_not, np.logical_not,
+                                {"x": a > 0}, grad_inputs=[])),
+        ("isnan", op_case(pp.isnan, np.isnan,
+                          {"x": np.array([1.0, np.nan, np.inf])},
+                          grad_inputs=[])),
+        ("isfinite", op_case(pp.isfinite, np.isfinite,
+                             {"x": np.array([1.0, np.nan, np.inf])},
+                             grad_inputs=[])),
+        ("argmax", op_case(pp.argmax, lambda x, axis=None:
+                           np.argmax(x, axis=axis),
+                           {"x": a}, attrs={"axis": 1}, grad_inputs=[])),
+        ("argmin", op_case(pp.argmin, lambda x, axis=None:
+                           np.argmin(x, axis=axis),
+                           {"x": a}, attrs={"axis": 0}, grad_inputs=[])),
+        ("argsort", op_case(pp.argsort, lambda x, axis=-1, descending=False:
+                            np.argsort(x, axis=axis, kind="stable"),
+                            {"x": a}, attrs={"axis": 1}, grad_inputs=[])),
+        ("sort", op_case(pp.sort, lambda x, axis=-1, descending=False:
+                         np.sort(x, axis=axis),
+                         {"x": a}, attrs={"axis": 1}, grad_inputs=[])),
+    ]
+    return [pytest.param(c, True, id=n) for n, c in specs]
+
+
+ALL_CASES = (BINARY + UNARY + _reduction_cases() + _manip_cases()
+             + _linalg_cases() + _logic_cases())
+
+
+@pytest.mark.parametrize("case,check_grad", ALL_CASES)
+def test_op(case, check_grad):
+    case.check_output()
+    if check_grad:
+        case.check_grad()
