@@ -41,6 +41,9 @@ __all__ = [
 def pvary(x, axis_name: str):
     """Mark `x` as device-varying over `axis_name` — needed for scan carries
     inside shard_map whose value becomes varying (e.g. after a ppermute)."""
+    vma = getattr(jax.typeof(x), "vma", None) if hasattr(jax, "typeof") else None
+    if vma is not None and axis_name in vma:
+        return x  # already varying over this axis
     if hasattr(lax, "pcast"):
         return lax.pcast(x, (axis_name,), to="varying")
     if hasattr(lax, "pvary"):

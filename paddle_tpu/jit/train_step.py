@@ -76,9 +76,14 @@ class TrainStep:
                 # (dp/fsdp/tp/ep); drop the ones absent from this mesh so a
                 # ('dp','ep') mesh accepts Llama-style tp rules unchanged
                 axes = set(mesh.axis_names)
-                keep = lambda e: (e if e is None or (
-                    e in axes if not isinstance(e, tuple)
-                    else all(a in axes for a in e)) else None)
+
+                def keep(e):
+                    if e is None or (not isinstance(e, tuple) and e in axes):
+                        return e
+                    if isinstance(e, tuple):
+                        kept = tuple(a for a in e if a in axes)
+                        return kept if kept else None
+                    return None
                 return P(*(keep(e) for e in spec))
 
             to_sh = lambda spec: NamedSharding(mesh, sanitize(spec))

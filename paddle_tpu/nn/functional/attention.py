@@ -56,8 +56,10 @@ def _use_pallas(q_shape, head_dim):
     import jax as _j
     if _j.default_backend() != "tpu":
         return False
-    # pallas kernel wants lane-aligned head_dim and big enough seq
-    return head_dim % 128 == 0 and q_shape[1] >= 128
+    # pallas kernel wants lane-aligned head_dim and block-aligned seq
+    # (the kernel picks block sizes of 128 and requires seq % block == 0)
+    return head_dim % 128 == 0 and q_shape[1] >= 128 and \
+        q_shape[1] % 128 == 0
 
 
 @eager_op

@@ -26,6 +26,7 @@ Usage:
 
 from __future__ import annotations
 
+import zlib
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
@@ -289,8 +290,10 @@ class op_case(OpTest):
 
 
 def _rand(shape, dtype=np.float32, lo=-1.0, hi=1.0, seed=None):
-    rng = np.random.default_rng(seed if seed is not None else abs(hash(
-        (tuple(shape), str(dtype)))) % (2 ** 31))
+    # deterministic across interpreter runs (hash() is salted per process)
+    if seed is None:
+        seed = zlib.crc32(repr((tuple(shape), str(dtype))).encode())
+    rng = np.random.default_rng(seed)
     return (rng.uniform(lo, hi, shape)).astype(dtype)
 
 
