@@ -38,14 +38,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         inputs = [inputs]
     if isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True on the eager tape is not supported; use the "
-            "functional API (paddle_tpu.incubate.autograd / jax.grad) for "
-            "higher-order derivatives.")
-    retain = bool(retain_graph) if retain_graph is not None else False
+    retain = bool(retain_graph) if retain_graph is not None else bool(create_graph)
     return calc_gradients(outputs, inputs, grad_outputs, retain_graph=retain,
-                          allow_unused=allow_unused)
+                          allow_unused=allow_unused, create_graph=create_graph)
 
 
 class PyLayerContext:
@@ -112,6 +107,34 @@ class PyLayer:
             return grads
 
         node = GradNode(vjp_fn, diff, avals, treedef, name=cls.__name__)
+
+        def _cg_apply(cot_flat):
+            """create_graph path: run user backward under the tape so the
+            produced grads are differentiable."""
+            import jax.numpy as jnp
+            cots = []
+            for c, (shape, dtype) in zip(cot_flat, avals):
+                if c is None:
+                    c = wrap_like(jnp.zeros(shape, dtype))
+                elif not isinstance(c, Tensor):
+                    c = wrap_like(c)
+                cots.append(c)
+            with enable_grad():
+                grads = cls.backward(ctx, *cots)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            grads = list(grads)
+            if len(grads) != len(diff):
+                if len(grads) == len(tensor_args):
+                    grads = [g for g, t in zip(grads, tensor_args)
+                             if not t.stop_gradient]
+                else:
+                    raise RuntimeError(
+                        f"PyLayer.backward returned {len(grads)} grads, "
+                        f"expected {len(diff)}")
+            return grads
+
+        node.create_graph_apply = _cg_apply
         wrapped = []
         for i, o in enumerate(outs):
             t = Tensor._wrap(o._data, stop_gradient=False, node=node, out_index=i)
@@ -119,12 +142,111 @@ class PyLayer:
         return tuple(wrapped) if multi else wrapped[0]
 
 
+def _dense_jacobian(y: Tensor, x: Tensor, create_graph=False):
+    """Rows of d(y_flat)/d(x) via one seeded backward per output element.
+
+    Eager convenience API (reference: python/paddle/autograd/autograd.py
+    Jacobian); O(numel(y)) pullback calls, each taped when create_graph.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import wrap_like
+    from paddle_tpu import ops as _ops
+
+    y_n = max(1, int(np.prod(y.shape)))
+    rows = []
+    for i in range(y_n):
+        seed = np.zeros((y_n,), np.float32)
+        seed[i] = 1.0
+        seed_t = wrap_like(jnp.asarray(seed.reshape(y.shape or ()),
+                                       y._data.dtype))
+        g = grad([y], [x], grad_outputs=[seed_t], retain_graph=True,
+                 create_graph=create_graph, allow_unused=True)[0]
+        if g is None:
+            from paddle_tpu.ops.creation import zeros
+            g = zeros(x.shape, dtype=x.dtype)
+        rows.append(g)
+    from paddle_tpu.ops.manipulation import stack, reshape
+    out = stack(rows, axis=0)
+    return reshape(out, list(y.shape) + list(x.shape))
+
+
+def _batched_jacobian(y: Tensor, x: Tensor, create_graph=False):
+    """Batch-diagonal Jacobian: y (B, M...), x (B, N...) -> (B, M..., N...).
+
+    Valid under batch_axis semantics (batch rows independent): seeding output
+    element m across ALL batch rows at once yields J[:, m, :] in one pullback.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.core.dispatch import wrap_like
+    from paddle_tpu.ops.manipulation import stack, reshape
+
+    B = y.shape[0]
+    per = max(1, int(np.prod(y.shape[1:])))
+    rows = []
+    for m in range(per):
+        seed = np.zeros((B, per), np.float32)
+        seed[:, m] = 1.0
+        seed_t = wrap_like(jnp.asarray(seed.reshape(y.shape), y._data.dtype))
+        g = grad([y], [x], grad_outputs=[seed_t], retain_graph=True,
+                 create_graph=create_graph, allow_unused=True)[0]
+        if g is None:
+            from paddle_tpu.ops.creation import zeros
+            g = zeros(x.shape, dtype=x.dtype)
+        rows.append(g)
+    out = stack(rows, axis=1)  # (B, per, N...)
+    return reshape(out, [B] + list(y.shape[1:]) + list(x.shape[1:]))
+
+
 def jacobian(ys, xs, batch_axis=None):
-    """Functional jacobian on eager tensors via jax.jacrev (stateless)."""
-    raise NotImplementedError(
-        "Use paddle_tpu.incubate.autograd.jacobian on a pure function.")
+    """Dense Jacobian of ys wrt xs.
+
+    Reference: python/paddle/autograd/autograd.py (paddle.autograd.jacobian).
+    batch_axis=None -> shape ys.shape + xs.shape; batch_axis=0 -> batched
+    Jacobian of shape (B,) + ys.shape[1:] + xs.shape[1:] (batch rows treated
+    as independent, as the reference's semantics require).
+    """
+    if batch_axis not in (None, 0):
+        raise ValueError("jacobian: batch_axis must be None or 0")
+    jac = _dense_jacobian if batch_axis is None else _batched_jacobian
+    multi_y = not isinstance(ys, Tensor)
+    multi_x = not isinstance(xs, Tensor)
+    ys_l = list(ys) if multi_y else [ys]
+    xs_l = list(xs) if multi_x else [xs]
+    out = [[jac(y, x) for x in xs_l] for y in ys_l]
+    if not multi_y and not multi_x:
+        return out[0][0]
+    if not multi_y:
+        return out[0]
+    if not multi_x:
+        return [row[0] for row in out]
+    return out
 
 
 def hessian(ys, xs, batch_axis=None):
-    raise NotImplementedError(
-        "Use paddle_tpu.incubate.autograd.hessian on a pure function.")
+    """Dense Hessian of a scalar ys wrt xs.
+
+    Single x: shape xs.shape + xs.shape.  List of xs: full block matrix
+    H[i][j] = d2 ys / (dx_i dx_j), cross blocks included.
+    """
+    if not isinstance(ys, Tensor):
+        raise ValueError("hessian expects a scalar Tensor output")
+    if batch_axis is not None:
+        raise ValueError("hessian: batch_axis is not supported for a scalar "
+                         "output; take jacobian(grad, x, batch_axis=0)")
+    multi_x = not isinstance(xs, Tensor)
+    xs_l = list(xs) if multi_x else [xs]
+    firsts = grad([ys], xs_l, create_graph=True, allow_unused=True)
+    from paddle_tpu.ops.creation import zeros
+    out = []
+    for g1, xi in zip(firsts, xs_l):
+        row = []
+        for xj in xs_l:
+            if g1 is None:
+                row.append(zeros(list(xi.shape) + list(xj.shape),
+                                 dtype=xi.dtype))
+            else:
+                row.append(_dense_jacobian(g1, xj))
+        out.append(row)
+    return out if multi_x else out[0][0]

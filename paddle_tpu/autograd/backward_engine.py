@@ -36,25 +36,59 @@ def _topo_order(roots: List[GradNode]):
     return indeg, seen
 
 
+def _add(a, b):
+    """Pairwise grad accumulation; taped when either side carries history."""
+    if isinstance(a, Tensor) or isinstance(b, Tensor):
+        # both sides must be Tensors: a raw jax.Array's __add__ would coerce
+        # the Tensor via __jax_array__ and silently drop its grad history
+        if not isinstance(a, Tensor):
+            a = Tensor._wrap(a)
+        if not isinstance(b, Tensor):
+            b = Tensor._wrap(b)
+        from paddle_tpu.core.tensor import enable_grad
+        with enable_grad():
+            return a + b
+    return a + b
+
+
 def _accumulate(store, key, value):
     cur = store.get(key)
-    store[key] = value if cur is None else cur + value
+    store[key] = value if cur is None else _add(cur, value)
 
 
-def run_backward(tensors: List[Tensor], grad_tensors=None, retain_graph=False):
+def _apply_node(node, cots, create_graph):
+    """Run one node's pullback; taped (create_graph) or raw."""
+    if create_graph and node.create_graph_apply is not None:
+        from paddle_tpu.core.tensor import enable_grad
+        with enable_grad():
+            return node.create_graph_apply(cots)
+    cots = [c._data if isinstance(c, Tensor) else c for c in cots]
+    return node.apply(cots)
+
+
+def run_backward(tensors: List[Tensor], grad_tensors=None, retain_graph=False,
+                 create_graph=False):
     """Standard .backward(): writes .grad on leaf tensors (and on tensors that
     called retain_grads())."""
     grads = _backward_impl(tensors, grad_tensors, retain_graph,
-                           accumulate_into_grad=True, wanted=None)
+                           accumulate_into_grad=True, wanted=None,
+                           create_graph=create_graph)
     return grads
 
 
 def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
-                   allow_unused=False):
-    """paddle.grad parity: return grads of outputs wrt inputs, no .grad writes."""
+                   allow_unused=False, create_graph=False):
+    """paddle.grad parity: return grads of outputs wrt inputs, no .grad writes.
+
+    With create_graph=True each node is applied through the taped
+    double-backward (GradNode.create_graph_apply), so the returned grads carry
+    their own grad history — reference: paddle.grad(create_graph=True)
+    (python/paddle/autograd/__init__).
+    """
     wanted = {id(t): t for t in inputs}
     grads = _backward_impl(outputs, grad_outputs, retain_graph,
-                           accumulate_into_grad=False, wanted=wanted)
+                           accumulate_into_grad=False, wanted=wanted,
+                           create_graph=create_graph)
     result = []
     for t in inputs:
         g = grads.get(id(t))
@@ -62,12 +96,15 @@ def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
             raise RuntimeError(
                 "One of the differentiated tensors appears to not have been "
                 "used in the graph. Set allow_unused=True if this is desired.")
-        result.append(None if g is None else Tensor._wrap(g))
+        if g is None:
+            result.append(None)
+        else:
+            result.append(g if isinstance(g, Tensor) else Tensor._wrap(g))
     return result
 
 
 def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
-                   wanted):
+                   wanted, create_graph=False):
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
     # Pending cotangents per (node, out_index); plus leaf grads keyed by id(tensor)
@@ -86,6 +123,8 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
             g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        elif create_graph and isinstance(g, Tensor):
+            g_arr = g  # keep grad history through the seed cotangent
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._grad_node
@@ -99,7 +138,7 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
                 roots.append(node)
             slot = node_cots[key]
             cur = slot[t._out_index]
-            slot[t._out_index] = g_arr if cur is None else cur + g_arr
+            slot[t._out_index] = g_arr if cur is None else _add(cur, g_arr)
 
     indeg, reachable = _topo_order(roots)
     # ready queue: nodes whose consumers (within reachable set) are all done.
@@ -117,9 +156,10 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
             continue
         done.add(id(node))
         cots = node_cots.pop(id(node), [None] * node.n_outputs)
-        in_grads = node.apply(cots)
-        if not retain_graph:
+        in_grads = _apply_node(node, cots, create_graph)
+        if not retain_graph and not create_graph:
             node.vjp_fn = None  # free saved activations
+            node.create_graph_apply = None  # also pins the op closure
         for t, g in zip(node.inputs, in_grads):
             parent = t._grad_node
             if g is not None and (parent is None or t._retain_grads
@@ -135,7 +175,7 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
                     if g is not None:
                         slot = node_cots[key]
                         cur = slot[t._out_index]
-                        slot[t._out_index] = g if cur is None else cur + g
+                        slot[t._out_index] = g if cur is None else _add(cur, g)
                     indeg[key] -= 1
                     if indeg[key] <= 0:
                         ready.append(parent)
@@ -151,9 +191,10 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
             node = nodes_by_id[key]
             done.add(key)
             cots = node_cots.pop(key)
-            in_grads = node.apply(cots)
-            if not retain_graph:
+            in_grads = _apply_node(node, cots, create_graph)
+            if not retain_graph and not create_graph:
                 node.vjp_fn = None
+                node.create_graph_apply = None
             progressed = True
             for t, g in zip(node.inputs, in_grads):
                 if g is None:
@@ -168,7 +209,7 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
                         nodes_by_id[id(parent)] = parent
                     slot = node_cots[id(parent)]
                     cur = slot[t._out_index]
-                    slot[t._out_index] = g if cur is None else cur + g
+                    slot[t._out_index] = g if cur is None else _add(cur, g)
             break
         if not progressed:
             break
@@ -178,8 +219,11 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
             t = tensor_by_id[tid]
             if t.stop_gradient and t._grad_node is not None:
                 continue
+            g_t = g if isinstance(g, Tensor) else Tensor._wrap(g)
             if t._grad is None:
-                t._grad = Tensor._wrap(g)
+                t._grad = g_t
             else:
-                t._grad = Tensor._wrap(t._grad._data + g)
+                acc = _add(t._grad if create_graph else t._grad._data,
+                           g_t if create_graph else g_t._data)
+                t._grad = acc if isinstance(acc, Tensor) else Tensor._wrap(acc)
     return leaf_grads

@@ -147,6 +147,24 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     avals = [(o.shape, o.dtype) for o in flat_out]
     node = GradNode(vjp_fn, uniq_diff, avals, treedef,
                     name=op_name or getattr(fn, "__name__", "op"))
+
+    def _cg_apply(cot_flat, _avals=avals, _treedef=treedef,
+                  _closure=closure, _inputs=uniq_diff, _name=op_name):
+        """Taped double-backward: re-enter jax.vjp over the op closure so the
+        produced grads are themselves tape-recorded (create_graph=True)."""
+        filled = [c if c is not None else jnp.zeros(s, d)
+                  for c, (s, d) in zip(cot_flat, _avals)]
+
+        def double_fn(cots, *primals):
+            cot_tree = jax.tree.unflatten(_treedef, list(cots))
+            _, vjp = jax.vjp(_closure, *primals)
+            return tuple(vjp(cot_tree))
+
+        out = dispatch(double_fn, tuple(filled), *_inputs,
+                       op_name=f"{_name or 'op'}_grad")
+        return list(out) if isinstance(out, (tuple, list)) else [out]
+
+    node.create_graph_apply = _cg_apply
     wrapped = []
     for i, o in enumerate(flat_out):
         sg = not _dtypes.is_floating(o.dtype)

@@ -101,6 +101,7 @@ class GradNode:
         "out_treedef",
         "n_outputs",
         "name",
+        "create_graph_apply",
         "__weakref__",
     )
 
@@ -112,6 +113,11 @@ class GradNode:
         self.out_treedef = out_treedef
         self.n_outputs = len(out_avals)
         self.name = name
+        # Optional taped double-backward: list[Tensor|None] -> list[Tensor|None].
+        # Set by the dispatcher (re-entrant jax.vjp over the op closure) and by
+        # PyLayer (user backward under enable_grad); used by
+        # grad(create_graph=True) so grads themselves carry grad history.
+        self.create_graph_apply = None
 
     def apply(self, cotangents):
         """cotangents: flat list aligned with out_avals (None → zeros)."""
