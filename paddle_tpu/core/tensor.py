@@ -157,7 +157,7 @@ def _as_jax_array(data, dtype=None):
         if dtype is None and data.dtype == np.float64:
             data = data.astype(np.float32)
         return jnp.asarray(data, dtype=None if dtype is None else _dtypes.to_jax(dtype))
-    if isinstance(data, (bool, int, float, complex, list, tuple)):
+    if isinstance(data, (bool, int, float, complex, list, tuple, np.generic)):
         arr = np.asarray(data)
         if dtype is None and arr.dtype == np.float64:
             arr = arr.astype(np.float32)

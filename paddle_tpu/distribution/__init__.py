@@ -1,0 +1,31 @@
+"""paddle.distribution parity surface.
+
+Reference: python/paddle/distribution/__init__.py — Bernoulli, Beta,
+Categorical, Cauchy, Dirichlet, Distribution, ExponentialFamily, Geometric,
+Gumbel, Independent, Laplace, LogNormal, Multinomial, Normal, Uniform,
+TransformedDistribution, kl_divergence/register_kl, and the transform zoo.
+"""
+
+from paddle_tpu.distribution.distribution import Distribution  # noqa: F401
+from paddle_tpu.distribution.exponential_family import (  # noqa: F401
+    ExponentialFamily)
+from paddle_tpu.distribution.normal import LogNormal, Normal  # noqa: F401
+from paddle_tpu.distribution.discrete import (  # noqa: F401
+    Bernoulli, Categorical, Geometric, Multinomial)
+from paddle_tpu.distribution.simplex import Beta, Dirichlet  # noqa: F401
+from paddle_tpu.distribution.location_scale import (  # noqa: F401
+    Cauchy, Gumbel, Laplace, Uniform)
+from paddle_tpu.distribution.independent import Independent  # noqa: F401
+from paddle_tpu.distribution.transform import *  # noqa: F401,F403
+from paddle_tpu.distribution.transform import __all__ as _transform_all
+from paddle_tpu.distribution.transformed_distribution import (  # noqa: F401
+    TransformedDistribution)
+from paddle_tpu.distribution.kl import (  # noqa: F401
+    kl_divergence, register_kl)
+
+__all__ = [
+    "Bernoulli", "Beta", "Categorical", "Cauchy", "Dirichlet", "Distribution",
+    "ExponentialFamily", "Geometric", "Gumbel", "Independent", "Laplace",
+    "LogNormal", "Multinomial", "Normal", "TransformedDistribution",
+    "Uniform", "kl_divergence", "register_kl",
+] + list(_transform_all)

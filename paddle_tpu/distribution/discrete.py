@@ -1,0 +1,222 @@
+"""Discrete distributions: Bernoulli, Categorical, Multinomial, Geometric.
+
+Parity: reference python/paddle/distribution/{bernoulli,categorical,
+multinomial,geometric}.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import wrap_like
+from paddle_tpu.distribution.distribution import (Distribution, _as_tensor,
+                                                  _broadcast_shape)
+
+__all__ = ["Bernoulli", "Categorical", "Multinomial", "Geometric"]
+
+_EPS = 1e-7
+
+
+def _clip_prob(p):
+    return pp.clip(p, _EPS, 1.0 - _EPS)
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _as_tensor(probs)
+        self.logits = pp.log(_clip_prob(self.probs)) - pp.log1p(
+            -_clip_prob(self.probs))
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxed sample (reference bernoulli.py rsample)."""
+        out_shape = self._extend_shape(tuple(shape))
+        u = wrap_like(jax.random.uniform(_state.next_key(), out_shape,
+                                         jnp.float32, minval=_EPS,
+                                         maxval=1.0 - _EPS))
+        logistic = pp.log(u) - pp.log1p(-u)
+        return pp.nn.functional.sigmoid((self.logits + logistic) / temperature)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(tuple(shape))
+        p = jnp.broadcast_to(self.probs._data, out_shape)
+        return wrap_like(jax.random.bernoulli(_state.next_key(), p)
+                         .astype(jnp.float32))
+
+    def entropy(self):
+        p = _clip_prob(self.probs)
+        return -(p * pp.log(p) + (1.0 - p) * pp.log1p(-p))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        p = _clip_prob(self.probs)
+        return value * pp.log(p) + (1.0 - value) * pp.log1p(-p)
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        zero = pp.zeros_like(value * self.probs)
+        one = pp.ones_like(zero)
+        mid = one - self.probs
+        out = pp.where(value < 0.0, zero, pp.where(value < 1.0, mid, one))
+        return out
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits``
+    (reference categorical.py:87 — constructor takes logits)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+        super().__init__(batch_shape=tuple(self.logits.shape[:-1]))
+        self._n = int(self.logits.shape[-1])
+
+    @property
+    def probs_param(self):
+        from paddle_tpu.nn.functional import softmax
+        return softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        out_shape = tuple(shape) + self.batch_shape
+        idx = jax.random.categorical(
+            _state.next_key(), self.logits._data, axis=-1,
+            shape=out_shape or None)
+        return wrap_like(idx)  # int32 under x32, int64 when x64 enabled
+
+    def entropy(self):
+        from paddle_tpu.nn.functional import log_softmax, softmax
+        logp = log_softmax(self.logits, axis=-1)
+        p = softmax(self.logits, axis=-1)
+        return -(p * logp).sum(axis=-1)
+
+    def log_prob(self, value):
+        from paddle_tpu.nn.functional import log_softmax
+        logp = log_softmax(self.logits, axis=-1)
+        idx = value if isinstance(value, pp.Tensor) else pp.to_tensor(value)
+        idx_i = pp.cast(idx, "int32")
+        onehot = pp.cast(
+            wrap_like(jax.nn.one_hot(idx_i._data, self._n)), "float32")
+        return (onehot * logp).sum(axis=-1)
+
+    def probs(self, value):
+        return pp.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        from paddle_tpu.distribution.kl import kl_divergence
+        return kl_divergence(self, other)
+
+
+class Multinomial(Distribution):
+    """total_count trials over the category axis
+    (reference multinomial.py:70)."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _as_tensor(probs)
+        p = self.probs
+        self.probs = p / p.sum(axis=-1, keepdim=True)
+        super().__init__(batch_shape=tuple(self.probs.shape[:-1]),
+                         event_shape=(int(self.probs.shape[-1]),))
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        logits = pp.log(_clip_prob(self.probs))._data
+        n = self.total_count
+        out_shape = tuple(shape) + self.batch_shape
+        draws = jax.random.categorical(
+            _state.next_key(), logits, axis=-1,
+            shape=(n,) + out_shape if out_shape else (n,))
+        k = int(self.probs.shape[-1])
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return wrap_like(counts.astype(jnp.float32))
+
+    def entropy(self):
+        """Exact: H = -lgamma(n+1) - n·Σ p_i log p_i + Σ_i E[lgamma(X_i+1)]
+        with X_i ~ Binomial(n, p_i); the expectation is an explicit O(n)
+        sum over the binomial pmf (no closed form exists)."""
+        import numpy as np
+        n = self.total_count
+        p = _clip_prob(self.probs)
+        k = pp.to_tensor(np.arange(n + 1, dtype=np.float32))
+        lg_k1 = pp.lgamma(k + 1.0)
+        # binomial log-pmf over a trailing k axis: (..., K, n+1)
+        pk = pp.unsqueeze(p, -1)
+        log_pmf = (pp.lgamma(pp.full_like(pk, float(n + 1)))
+                   - lg_k1 - pp.lgamma(float(n) - k + 1.0)
+                   + k * pp.log(pk) + (float(n) - k) * pp.log1p(-pk))
+        e_lgamma = (pp.exp(log_pmf) * lg_k1).sum(axis=-1)
+        import math
+        return (-math.lgamma(n + 1)
+                - float(n) * (p * pp.log(p)).sum(axis=-1)
+                + e_lgamma.sum(axis=-1))
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        p = _clip_prob(self.probs)
+        coeff = pp.lgamma(value.sum(axis=-1) + 1.0) \
+            - pp.lgamma(value + 1.0).sum(axis=-1)
+        return coeff + (value * pp.log(p)).sum(axis=-1)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^(k-1) p for k = 1, 2, ...
+    (reference geometric.py:70,126)."""
+
+    def __init__(self, probs):
+        self.probs = _as_tensor(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs * self.probs)
+
+    def pmf(self, k):
+        k = _as_tensor(k)
+        return pp.pow(1.0 - self.probs, k - 1.0) * self.probs
+
+    def log_pmf(self, k):
+        k = _as_tensor(k)
+        p = _clip_prob(self.probs)
+        return (k - 1.0) * pp.log1p(-p) + pp.log(p)
+
+    def log_prob(self, value):
+        return self.log_pmf(value)
+
+    def sample(self, shape=()):
+        out_shape = self._extend_shape(tuple(shape))
+        u = jax.random.uniform(_state.next_key(), out_shape, jnp.float32,
+                               minval=_EPS, maxval=1.0 - _EPS)
+        p = jnp.broadcast_to(_clip_prob(self.probs)._data, out_shape)
+        k = jnp.floor(jnp.log(u) / jnp.log1p(-p)) + 1.0
+        return wrap_like(k)
+
+    def entropy(self):
+        p = _clip_prob(self.probs)
+        q = 1.0 - p
+        return -(q * pp.log(q) + p * pp.log(p)) / p
+
+    def cdf(self, k):
+        k = _as_tensor(k)
+        p = _clip_prob(self.probs)
+        return 1.0 - pp.pow(1.0 - p, k)

@@ -1,0 +1,98 @@
+"""Distribution base class.
+
+Parity target: reference python/paddle/distribution/distribution.py:33
+(Distribution: batch_shape/event_shape, sample/rsample, prob/log_prob,
+entropy, kl_divergence).  TPU-native notes: all math routes through the
+dispatcher ops so log_prob/entropy are tape-differentiable eagerly and
+trace-transparent under jit; sampling draws from the process-global
+splitting key (ops/random.py) so eager sampling is reproducible under
+paddle.seed while rsample stays reparameterized (differentiable wrt the
+distribution parameters).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as pp
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+def _as_tensor(v, dtype="float32"):
+    if isinstance(v, Tensor):
+        return v
+    arr = np.asarray(v)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    if np.issubdtype(arr.dtype, np.integer):
+        arr = arr.astype(np.float32)
+    return pp.to_tensor(arr)
+
+
+def _broadcast_shape(*tensors):
+    shape = ()
+    for t in tensors:
+        shape = np.broadcast_shapes(shape, tuple(t.shape))
+    return tuple(shape)
+
+
+class Distribution:
+    """Abstract base for probability distributions."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return pp.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        """Non-differentiable draw of shape ``shape + batch + event``."""
+        with pp.autograd.no_grad():
+            out = self.rsample(shape)
+        return out.detach() if hasattr(out, "detach") else out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return pp.exp(self.log_prob(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from paddle_tpu.distribution.kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(batch_shape={self._batch_shape}, "
+                f"event_shape={self._event_shape})")

@@ -1,0 +1,41 @@
+"""ExponentialFamily: generic entropy via the log-normalizer gradient.
+
+Parity: reference python/paddle/distribution/exponential_family.py —
+entropy = -[sum_i eta_i * dA/deta_i - A(eta) + E[carrier measure]] computed
+by differentiating the log normalizer; here that derivative comes from the
+eager tape (grad on a taped A), exercising the same machinery as
+paddle.grad(create_graph=...).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as pp
+from paddle_tpu.distribution.distribution import Distribution
+
+__all__ = ["ExponentialFamily"]
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        etas = [n.detach().clone() for n in self._natural_parameters]
+        for e in etas:
+            e.stop_gradient = False
+        log_norm = self._log_normalizer(*etas)
+        grads = pp.grad(log_norm.sum(), etas, create_graph=False,
+                        allow_unused=True)
+        result = -self._mean_carrier_measure + log_norm
+        for eta, g in zip(etas, grads):
+            if g is not None:
+                result = result - eta.detach() * g
+        return result

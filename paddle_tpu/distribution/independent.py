@@ -1,0 +1,49 @@
+"""Independent: reinterpret trailing batch dims as event dims.
+
+Parity: reference python/paddle/distribution/independent.py.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.distribution.distribution import Distribution
+
+__all__ = ["Independent"]
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_ndims):
+        if reinterpreted_batch_ndims > len(base.batch_shape):
+            raise ValueError(
+                "reinterpreted_batch_ndims exceeds base batch rank")
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        n = len(base.batch_shape) - self.reinterpreted_batch_ndims
+        super().__init__(
+            batch_shape=base.batch_shape[:n],
+            event_shape=base.batch_shape[n:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        if self.reinterpreted_batch_ndims == 0:
+            return x
+        axes = list(range(-self.reinterpreted_batch_ndims, 0))
+        return x.sum(axis=axes)
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self.base.entropy())

@@ -1,0 +1,156 @@
+"""KL divergence registry with (type(p), type(q)) multi-dispatch.
+
+Parity: reference python/paddle/distribution/kl.py:37 (kl_divergence,
+register_kl, MRO-based most-specific-match dispatch).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as pp
+from paddle_tpu.distribution.discrete import Bernoulli, Categorical, Geometric
+from paddle_tpu.distribution.location_scale import Gumbel, Laplace, Uniform
+from paddle_tpu.distribution.normal import LogNormal, Normal
+from paddle_tpu.distribution.simplex import Beta, Dirichlet
+from paddle_tpu.distribution.exponential_family import ExponentialFamily
+
+__all__ = ["register_kl", "kl_divergence"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(f):
+        _REGISTRY[(cls_p, cls_q)] = f
+        return f
+    return decorator
+
+
+def _match_score(cls, reg_cls):
+    try:
+        return cls.__mro__.index(reg_cls)
+    except ValueError:
+        return None
+
+
+def _dispatch(cls_p, cls_q):
+    best, best_score = None, None
+    for (rp, rq), fn in _REGISTRY.items():
+        sp = _match_score(cls_p, rp)
+        sq = _match_score(cls_q, rq)
+        if sp is None or sq is None:
+            continue
+        score = (sp, sq)
+        if best_score is None or score < best_score:
+            best, best_score = fn, score
+    return best
+
+
+def kl_divergence(p, q):
+    fn = _dispatch(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__}); use register_kl.")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1.0 - pp.log(var_ratio))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    eps = 1e-7
+    a = pp.clip(p.probs, eps, 1 - eps)
+    b = pp.clip(q.probs, eps, 1 - eps)
+    return a * (pp.log(a) - pp.log(b)) + \
+        (1.0 - a) * (pp.log1p(-a) - pp.log1p(-b))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    from paddle_tpu.nn.functional import log_softmax, softmax
+    lp = log_softmax(p.logits, axis=-1)
+    lq = log_softmax(q.logits, axis=-1)
+    return (softmax(p.logits, axis=-1) * (lp - lq)).sum(axis=-1)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # defined when support(p) ⊆ support(q)
+    return pp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    r = p.scale / q.scale
+    d = pp.abs(p.loc - q.loc) / q.scale
+    return -pp.log(r) + r * pp.exp(-pp.abs(p.loc - q.loc) / p.scale) \
+        + d - 1.0
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # KL = log(βq/βp) + γ(βp/βq - 1) + (μp-μq)/βq
+    #      + exp((μq-μp)/βq)·Γ(1+βp/βq) - 1
+    euler = 0.5772156649015329
+    beta_r = p.scale / q.scale
+    t = pp.exp((q.loc - p.loc) / q.scale) * pp.exp(pp.lgamma(1.0 + beta_r))
+    return pp.log(q.scale) - pp.log(p.scale) + euler * (beta_r - 1.0) \
+        + (p.loc - q.loc) / q.scale + t - 1.0
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    s_p = p.alpha + p.beta
+    return (q._log_beta_fn() - p._log_beta_fn()
+            + (p.alpha - q.alpha) * pp.digamma(p.alpha)
+            + (p.beta - q.beta) * pp.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * pp.digamma(s_p))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = a.sum(axis=-1)
+    return (pp.lgamma(a0) - pp.lgamma(b.sum(axis=-1))
+            - (pp.lgamma(a) - pp.lgamma(b)).sum(axis=-1)
+            + ((a - b) * (pp.digamma(a)
+                          - pp.unsqueeze(pp.digamma(a0), -1))).sum(axis=-1))
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    eps = 1e-7
+    a = pp.clip(p.probs, eps, 1 - eps)
+    b = pp.clip(q.probs, eps, 1 - eps)
+    return (pp.log(a) - pp.log(b)) \
+        + (1.0 / a - 1.0) * (pp.log1p(-a) - pp.log1p(-b))
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily_expfamily(p, q):
+    """Bregman-divergence fallback for same-family pairs; requires matching
+    natural parameterizations (reference kl.py _kl_expfamily_expfamily)."""
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "generic exponential-family KL needs p and q of the same family")
+    # KL(p||q) = A(η_q) - A(η_p) - <η_q - η_p, ∇A(η_p)>
+    p_nat = [n.detach().clone() for n in p._natural_parameters]
+    for e in p_nat:
+        e.stop_gradient = False
+    lp = p._log_normalizer(*p_nat)
+    grads = pp.grad(lp.sum(), p_nat, allow_unused=True)
+    kl = q._log_normalizer(*q._natural_parameters) - lp
+    for pn, qn, g in zip(p_nat, q._natural_parameters, grads):
+        if g is not None:
+            kl = kl - (qn - pn.detach()) * g
+    return kl

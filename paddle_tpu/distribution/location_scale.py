@@ -1,0 +1,167 @@
+"""Uniform, Laplace, Gumbel, Cauchy.
+
+Parity: reference python/paddle/distribution/{uniform,laplace,gumbel,
+cauchy}.py.  All rsamples are inverse-CDF reparameterizations: a raw
+uniform draw is the constant, the parameter math is taped.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import wrap_like
+from paddle_tpu.distribution.distribution import (Distribution, _as_tensor,
+                                                  _broadcast_shape)
+
+__all__ = ["Uniform", "Laplace", "Gumbel", "Cauchy"]
+
+_EULER = 0.5772156649015329
+
+
+def _std_uniform(shape, lo=1e-7, hi=1.0 - 1e-7):
+    return wrap_like(jax.random.uniform(_state.next_key(), shape,
+                                        jnp.float32, minval=lo, maxval=hi))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+        super().__init__(batch_shape=_broadcast_shape(self.low, self.high))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def rsample(self, shape=()):
+        u = _std_uniform(self._extend_shape(tuple(shape)), lo=0.0, hi=1.0)
+        return self.low + (self.high - self.low) * u
+
+    def entropy(self):
+        return pp.log(self.high - self.low)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        inside = pp.logical_and(value >= self.low, value < self.high)
+        lp = -pp.log(self.high - self.low)
+        neg_inf = pp.full_like(value * lp, -float("inf"))
+        return pp.where(inside, value * 0.0 + lp, neg_inf)
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        return pp.clip((value - self.low) / (self.high - self.low), 0.0, 1.0)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(batch_shape=_broadcast_shape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def rsample(self, shape=()):
+        u = _std_uniform(self._extend_shape(tuple(shape))) - 0.5
+        return self.loc - self.scale * pp.sign(u) * pp.log1p(-2.0 * pp.abs(u))
+
+    def entropy(self):
+        return 1.0 + pp.log(2.0 * self.scale)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        return -pp.log(2.0 * self.scale) - pp.abs(value - self.loc) / self.scale
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * pp.sign(z) * pp.expm1(-pp.abs(z))
+
+    def icdf(self, value):
+        value = _as_tensor(value)
+        term = value - 0.5
+        return self.loc - self.scale * pp.sign(term) * pp.log1p(
+            -2.0 * pp.abs(term))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(batch_shape=_broadcast_shape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc + _EULER * self.scale
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def rsample(self, shape=()):
+        u = _std_uniform(self._extend_shape(tuple(shape)))
+        return self.loc - self.scale * pp.log(-pp.log(u))
+
+    def entropy(self):
+        return pp.log(self.scale) + 1.0 + _EULER
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return -(z + pp.exp(-z)) - pp.log(self.scale)
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return pp.exp(-pp.exp(-z))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(batch_shape=_broadcast_shape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean.")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance.")
+
+    def rsample(self, shape=()):
+        u = _std_uniform(self._extend_shape(tuple(shape)))
+        return self.loc + self.scale * pp.tan(math.pi * (u - 0.5))
+
+    def entropy(self):
+        return pp.log(4.0 * math.pi * self.scale)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return -math.log(math.pi) - pp.log(self.scale) - pp.log1p(z * z)
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return pp.atan(z) / math.pi + 0.5

@@ -1,0 +1,97 @@
+"""Normal and LogNormal.
+
+Parity: reference python/paddle/distribution/normal.py:89,
+lognormal.py (LogNormal = exp-transformed Normal).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import wrap_like
+from paddle_tpu.distribution.distribution import (Distribution, _as_tensor,
+                                                  _broadcast_shape)
+
+__all__ = ["Normal", "LogNormal"]
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+        super().__init__(batch_shape=_broadcast_shape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return pp.broadcast_to(self.loc, list(self.batch_shape)) \
+            if self.batch_shape else self.loc
+
+    @property
+    def variance(self):
+        v = self.scale * self.scale
+        return pp.broadcast_to(v, list(self.batch_shape)) \
+            if self.batch_shape else v
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(tuple(shape))
+        eps = wrap_like(jax.random.normal(_state.next_key(), out_shape,
+                                          jnp.float32))
+        return self.loc + self.scale * eps
+
+    def entropy(self):
+        e = 0.5 + _HALF_LOG_2PI + pp.log(self.scale)
+        return pp.broadcast_to(e, list(self.batch_shape)) \
+            if self.batch_shape else e
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / self.scale
+        return -0.5 * z * z - pp.log(self.scale) - _HALF_LOG_2PI
+
+    def cdf(self, value):
+        value = _as_tensor(value)
+        z = (value - self.loc) / (self.scale * math.sqrt(2.0))
+        return 0.5 * (1.0 + pp.erf(z))
+
+    def icdf(self, value):
+        value = _as_tensor(value)
+        return self.loc + self.scale * math.sqrt(2.0) * pp.erfinv(
+            2.0 * value - 1.0)
+
+
+class LogNormal(Distribution):
+    """exp(Normal(loc, scale)); direct closed forms instead of the
+    reference's TransformedDistribution composition (lognormal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self._base = Normal(loc, scale)
+        self.loc = self._base.loc
+        self.scale = self._base.scale
+        super().__init__(batch_shape=self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return pp.exp(self.loc + 0.5 * self.scale * self.scale)
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return pp.expm1(s2) * pp.exp(2.0 * self.loc + s2)
+
+    def rsample(self, shape=()):
+        return pp.exp(self._base.rsample(shape))
+
+    def entropy(self):
+        return self._base.entropy() + self._base.mean
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        logv = pp.log(value)
+        return self._base.log_prob(logv) - logv

@@ -1,0 +1,107 @@
+"""Beta and Dirichlet.
+
+Parity: reference python/paddle/distribution/{beta,dirichlet}.py.
+rsample uses jax.random.gamma/beta/dirichlet, which carry implicit
+reparameterization gradients wrt the concentration parameters — routed
+through the dispatcher so the draw is taped eagerly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+from paddle_tpu.core import state as _state
+from paddle_tpu.core.dispatch import dispatch
+from paddle_tpu.distribution.distribution import (Distribution, _as_tensor,
+                                                  _broadcast_shape)
+
+__all__ = ["Beta", "Dirichlet"]
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_tensor(alpha)
+        self.beta = _as_tensor(beta)
+        super().__init__(
+            batch_shape=_broadcast_shape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def _log_beta_fn(self):
+        return pp.lgamma(self.alpha) + pp.lgamma(self.beta) \
+            - pp.lgamma(self.alpha + self.beta)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(tuple(shape))
+        key = _state.next_key()
+
+        def draw(a, b):
+            ga = jax.random.gamma(key, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(jax.random.fold_in(key, 1),
+                                  jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        return dispatch(draw, self.alpha, self.beta, op_name="beta_sample")
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        return self._log_beta_fn() - (a - 1.0) * pp.digamma(a) \
+            - (b - 1.0) * pp.digamma(b) + (s - 2.0) * pp.digamma(s)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        return (self.alpha - 1.0) * pp.log(value) \
+            + (self.beta - 1.0) * pp.log1p(-value) - self._log_beta_fn()
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _as_tensor(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(batch_shape=shape[:-1], event_shape=shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / self.concentration.sum(axis=-1,
+                                                           keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def rsample(self, shape=()):
+        out_shape = self._extend_shape(tuple(shape))
+        key = _state.next_key()
+
+        def draw(conc):
+            g = jax.random.gamma(key, jnp.broadcast_to(conc, out_shape))
+            return g / g.sum(axis=-1, keepdims=True)
+
+        return dispatch(draw, self.concentration, op_name="dirichlet_sample")
+
+    def entropy(self):
+        a = self.concentration
+        a0 = a.sum(axis=-1)
+        k = float(a.shape[-1])
+        log_b = pp.lgamma(a).sum(axis=-1) - pp.lgamma(a0)
+        return log_b + (a0 - k) * pp.digamma(a0) \
+            - ((a - 1.0) * pp.digamma(a)).sum(axis=-1)
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        a = self.concentration
+        a0 = a.sum(axis=-1)
+        log_b = pp.lgamma(a).sum(axis=-1) - pp.lgamma(a0)
+        return ((a - 1.0) * pp.log(value)).sum(axis=-1) - log_b

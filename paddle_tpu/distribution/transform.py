@@ -1,0 +1,310 @@
+"""Bijective transforms for TransformedDistribution.
+
+Parity: reference python/paddle/distribution/transform.py:59 (Transform,
+Abs/Affine/Chain/Exp/Independent/Power/Reshape/Sigmoid/Softmax/Stack/
+StickBreaking/Tanh transforms).  The constraint/variable machinery is
+replaced by the minimal injectivity flag the user API observes.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import reduce
+
+import numpy as np
+
+import paddle_tpu as pp
+from paddle_tpu.distribution.distribution import _as_tensor
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Transform:
+    _is_injective = True
+    # event dims consumed by one application of the transform
+    _domain_event_dim = 0
+    _codomain_event_dim = 0
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def forward(self, x):
+        return self._forward(_as_tensor(x))
+
+    def inverse(self, y):
+        return self._inverse(_as_tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        if hasattr(self, "_forward_log_det_jacobian"):
+            return self._forward_log_det_jacobian(x)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return -self._inverse_log_det_jacobian(self._forward(x))
+        raise NotImplementedError(
+            f"{type(self).__name__} defines no log-det jacobian")
+
+    def inverse_log_det_jacobian(self, y):
+        y = _as_tensor(y)
+        if hasattr(self, "_inverse_log_det_jacobian"):
+            return self._inverse_log_det_jacobian(y)
+        # public fallback so composite transforms that only override the
+        # public forward_log_det_jacobian (Chain/Independent/Stack) work
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+
+class AbsTransform(Transform):
+    """y = |x| — non-injective; inverse returns the positive branch."""
+    _is_injective = False
+
+    def _forward(self, x):
+        return pp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return pp.log(pp.abs(self.scale)) + x * 0.0
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return pp.exp(x)
+
+    def _inverse(self, y):
+        return pp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _as_tensor(power)
+
+    def _forward(self, x):
+        return pp.pow(x, self.power)
+
+    def _inverse(self, y):
+        return pp.pow(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return pp.log(pp.abs(self.power * pp.pow(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return pp.nn.functional.sigmoid(x)
+
+    def _inverse(self, y):
+        return pp.log(y) - pp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        from paddle_tpu.nn.functional import softplus
+        return -softplus(-x) - softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return pp.tanh(x)
+
+    def _inverse(self, y):
+        return 0.5 * (pp.log1p(y) - pp.log1p(-y))
+
+    def _forward_log_det_jacobian(self, x):
+        from paddle_tpu.nn.functional import softplus
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._is_injective = all(t._is_injective for t in self.transforms)
+
+    def _forward(self, x):
+        return reduce(lambda v, t: t.forward(v), self.transforms, x)
+
+    def _inverse(self, y):
+        return reduce(lambda v, t: t.inverse(v), reversed(self.transforms), y)
+
+    def forward_log_det_jacobian(self, x):
+        x = _as_tensor(x)
+        total = None
+        for t in self.transforms:
+            term = t.forward_log_det_jacobian(x)
+            total = term if total is None else total + term
+            x = t.forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        return reduce(lambda s, t: t.forward_shape(s), self.transforms,
+                      tuple(shape))
+
+    def inverse_shape(self, shape):
+        return reduce(lambda s, t: t.inverse_shape(s),
+                      reversed(self.transforms), tuple(shape))
+
+
+class IndependentTransform(Transform):
+    """Sums the log-det over the trailing ``reinterpreted_batch_ndims``."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        self._is_injective = base._is_injective
+
+    def _forward(self, x):
+        return self.base.forward(x)
+
+    def _inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(_as_tensor(x))
+        axes = list(range(-self.reinterpreted_batch_ndims, 0))
+        return ld.sum(axis=axes)
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._domain_event_dim = len(self.in_event_shape)
+        self._codomain_event_dim = len(self.out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("in_event_shape and out_event_shape must have "
+                             "the same number of elements")
+
+    def _forward(self, x):
+        batch = list(x.shape[:len(x.shape) - len(self.in_event_shape)])
+        return pp.reshape(x, batch + list(self.out_event_shape))
+
+    def _inverse(self, y):
+        batch = list(y.shape[:len(y.shape) - len(self.out_event_shape)])
+        return pp.reshape(y, batch + list(self.in_event_shape))
+
+    def _forward_log_det_jacobian(self, x):
+        batch = list(x.shape[:len(x.shape) - len(self.in_event_shape)])
+        return pp.zeros(batch or [1], dtype="float32")
+
+    def forward_shape(self, shape):
+        n = len(shape) - len(self.in_event_shape)
+        return tuple(shape[:n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(shape) - len(self.out_event_shape)
+        return tuple(shape[:n]) + self.in_event_shape
+
+
+class SoftmaxTransform(Transform):
+    """Normalizes exp(x) over the last axis; not bijective on R^n (the
+    simplex loses one degree of freedom), so no log-det."""
+    _is_injective = False
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        from paddle_tpu.nn.functional import softmax
+        return softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return pp.log(y)
+
+
+class StackTransform(Transform):
+    """Applies a different transform to each slice along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+        self._is_injective = all(t._is_injective for t in self.transforms)
+
+    def _map(self, value, method):
+        parts = pp.unbind(value, axis=self.axis)
+        outs = [getattr(t, method)(v)
+                for t, v in zip(self.transforms, parts)]
+        return pp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "forward")
+
+    def _inverse(self, y):
+        return self._map(y, "inverse")
+
+    def forward_log_det_jacobian(self, x):
+        return self._map(_as_tensor(x), "forward_log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """R^(n) -> open simplex of dim n+1 via stick-breaking
+    (reference transform.py StickBreakingTransform)."""
+    _domain_event_dim = 1
+    _codomain_event_dim = 1
+
+    def _forward(self, x):
+        from paddle_tpu.nn.functional import sigmoid
+        n = int(x.shape[-1])
+        offset = pp.to_tensor(
+            np.arange(n, 0, -1, dtype=np.float32))
+        z = sigmoid(x - pp.log(offset))
+        one = pp.ones(list(x.shape[:-1]) + [1], dtype="float32")
+        zpad = pp.concat([1.0 - z, one], axis=-1)
+        cum = pp.cumprod(zpad, dim=-1)
+        cum_shifted = pp.concat([one, cum[..., :-1]], axis=-1)
+        zfull = pp.concat([z, one], axis=-1)
+        return zfull * cum_shifted
+
+    def _inverse(self, y):
+        n = int(y.shape[-1]) - 1
+        cum = 1.0 - pp.cumsum(y, axis=-1)
+        cum = cum[..., :-1]
+        offset = pp.to_tensor(np.arange(n, 0, -1, dtype=np.float32))
+        yk = y[..., :-1]
+        z = yk / (yk + cum)
+        return pp.log(z) - pp.log1p(-z) + pp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        from paddle_tpu.nn.functional import softplus
+        n = int(x.shape[-1])
+        offset = pp.to_tensor(np.arange(n, 0, -1, dtype=np.float32))
+        xo = x - pp.log(offset)
+        z = pp.nn.functional.sigmoid(xo)
+        one = pp.ones(list(x.shape[:-1]) + [1], dtype="float32")
+        rem = pp.cumprod(pp.concat([1.0 - z, one], axis=-1), dim=-1)
+        rem_shifted = pp.concat([one, rem[..., :-1]], axis=-1)[..., :n]
+        return (pp.log(rem_shifted) - softplus(-xo) - softplus(xo)).sum(axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)

@@ -1,0 +1,66 @@
+"""TransformedDistribution: push a base distribution through transforms.
+
+Parity: reference python/paddle/distribution/transformed_distribution.py.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.distribution.distribution import Distribution, _as_tensor
+from paddle_tpu.distribution.transform import ChainTransform, Transform
+
+__all__ = ["TransformedDistribution"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        for t in transforms:
+            if not isinstance(t, Transform):
+                raise TypeError(f"expected Transform, got {type(t)}")
+        self.base = base
+        self.transforms = list(transforms)
+        chain = ChainTransform(self.transforms)
+        shape = chain.forward_shape(base.batch_shape + base.event_shape)
+        # event rank can only grow through transforms; batch rank preserved
+        nb = len(base.batch_shape)
+        super().__init__(batch_shape=tuple(shape[:nb]),
+                         event_shape=tuple(shape[nb:]))
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        value = _as_tensor(value)
+        lp = 0.0
+        y = value
+        # event rank is tracked per stage: each transform maps a domain of
+        # _domain_event_dim event dims onto _codomain_event_dim of them
+        event_rank = len(self.event_shape)
+        for t in reversed(self.transforms):
+            if not t._is_injective:
+                raise ValueError(
+                    f"log_prob is undefined through non-injective transform "
+                    f"{type(t).__name__}")
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            # sum the per-element log-det over event dims the transform does
+            # not already reduce (torch/paddle rule: event_dim - codomain dim)
+            extra = event_rank - t._codomain_event_dim
+            if hasattr(ld, "shape") and extra > 0 and len(ld.shape) > 0:
+                axes = list(range(-min(extra, len(ld.shape)), 0))
+                ld = ld.sum(axis=axes)
+            lp = lp - ld
+            y = x
+            event_rank = event_rank - t._codomain_event_dim \
+                + t._domain_event_dim
+        return lp + self.base.log_prob(y)

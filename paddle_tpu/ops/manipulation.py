@@ -23,6 +23,14 @@ def reshape(x, shape):
     return jnp.reshape(x, _static_ints(shape))
 
 
+def cast(x, dtype):
+    """paddle.cast parity (dtype change through the dispatcher, taped)."""
+    if isinstance(x, Tensor):
+        return x.cast(dtype)
+    from paddle_tpu.core import dtypes as _dtypes
+    return jnp.asarray(x).astype(_dtypes.to_jax(dtype))
+
+
 @eager_op
 def flatten(x, start_axis=0, stop_axis=-1):
     nd = x.ndim

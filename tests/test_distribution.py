@@ -1,0 +1,399 @@
+"""paddle.distribution parity tests.
+
+Reference test strategy: test/distribution/test_distribution_*.py — each
+distribution's log_prob/entropy/mean/variance against scipy-style closed
+forms, sample statistics against analytic moments, KL pairs against closed
+forms, transforms against forward/inverse roundtrips.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu import distribution as D
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    pp.seed(1234)
+
+
+class TestNormal:
+    def test_log_prob_entropy(self):
+        n = D.Normal(1.0, 2.0)
+        x = np.array([0.0, 1.0, 3.0], np.float32)
+        expect = -0.5 * ((x - 1) / 2) ** 2 - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(_np(n.log_prob(x)), expect, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(n.entropy())), 0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0),
+            rtol=1e-6)
+
+    def test_sample_moments(self):
+        n = D.Normal(-0.5, 1.5)
+        s = _np(n.sample([40000]))
+        assert abs(s.mean() + 0.5) < 0.05
+        assert abs(s.std() - 1.5) < 0.05
+
+    def test_rsample_grad(self):
+        loc = pp.to_tensor(np.float32(0.0))
+        loc.stop_gradient = False
+        scale = pp.to_tensor(np.float32(1.0))
+        scale.stop_gradient = False
+        y = D.Normal(loc, scale).rsample([256]).mean()
+        gl, gs = pp.grad(y, [loc, scale])
+        np.testing.assert_allclose(float(_np(gl)), 1.0, rtol=1e-5)
+        assert np.isfinite(float(_np(gs)))
+
+    def test_cdf_icdf_roundtrip(self):
+        n = D.Normal(0.3, 1.2)
+        x = np.array([-1.0, 0.3, 2.0], np.float32)
+        np.testing.assert_allclose(_np(n.icdf(n.cdf(x))), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_batch_broadcast(self):
+        n = D.Normal(np.zeros((3,), np.float32), np.ones((1,), np.float32))
+        assert n.batch_shape == (3,)
+        assert n.sample([5]).shape == [5, 3]
+
+
+class TestLogNormal:
+    def test_moments_and_log_prob(self):
+        ln = D.LogNormal(0.2, 0.5)
+        np.testing.assert_allclose(float(_np(ln.mean)),
+                                   math.exp(0.2 + 0.125), rtol=1e-5)
+        s = _np(ln.sample([60000]))
+        assert abs(s.mean() - math.exp(0.325)) < 0.03
+        # matches exp-transformed normal
+        td = D.TransformedDistribution(D.Normal(0.2, 0.5), [D.ExpTransform()])
+        x = np.array([0.5, 1.0, 2.5], np.float32)
+        np.testing.assert_allclose(_np(ln.log_prob(x)), _np(td.log_prob(x)),
+                                   rtol=1e-5)
+
+
+class TestBernoulli:
+    def test_stats(self):
+        b = D.Bernoulli(0.3)
+        np.testing.assert_allclose(float(_np(b.mean)), 0.3, rtol=1e-6)
+        np.testing.assert_allclose(float(_np(b.variance)), 0.21, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(_np(b.entropy())),
+            -(0.3 * np.log(0.3) + 0.7 * np.log(0.7)), rtol=1e-5)
+        s = _np(b.sample([20000]))
+        assert abs(s.mean() - 0.3) < 0.02
+
+    def test_log_prob(self):
+        b = D.Bernoulli(0.25)
+        np.testing.assert_allclose(float(_np(b.log_prob(1.0))), np.log(0.25),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(_np(b.log_prob(0.0))), np.log(0.75),
+                                   rtol=1e-5)
+
+
+class TestCategorical:
+    def test_log_prob_entropy_sample(self):
+        probs = np.array([0.2, 0.3, 0.5], np.float32)
+        c = D.Categorical(np.log(probs))
+        np.testing.assert_allclose(float(_np(c.log_prob(2))), np.log(0.5),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(_np(c.entropy())),
+                                   -(probs * np.log(probs)).sum(), rtol=1e-5)
+        s = _np(c.sample([20000])).astype(int)
+        freq = np.bincount(s, minlength=3) / s.size
+        np.testing.assert_allclose(freq, probs, atol=0.02)
+
+
+class TestBetaDirichlet:
+    def test_beta(self):
+        b = D.Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(_np(b.mean)), 0.4, rtol=1e-6)
+        np.testing.assert_allclose(float(_np(b.variance)), 0.04, rtol=1e-5)
+        # log_prob vs closed form at x=0.5: log(x^(a-1)(1-x)^(b-1)/B(a,b))
+        from scipy.stats import beta as sp_beta
+        np.testing.assert_allclose(float(_np(b.log_prob(0.5))),
+                                   sp_beta.logpdf(0.5, 2, 3), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(b.entropy())),
+                                   sp_beta.entropy(2, 3), rtol=1e-5)
+        s = _np(b.sample([30000]))
+        assert abs(s.mean() - 0.4) < 0.01
+
+    def test_beta_rsample_grad(self):
+        a = pp.to_tensor(np.float32(2.0))
+        a.stop_gradient = False
+        y = D.Beta(a, 3.0).rsample([128]).mean()
+        (g,) = pp.grad(y, [a])
+        assert np.isfinite(float(_np(g)))
+
+    def test_dirichlet(self):
+        conc = np.array([1.0, 2.0, 3.0], np.float32)
+        d = D.Dirichlet(conc)
+        np.testing.assert_allclose(_np(d.mean), conc / 6.0, rtol=1e-5)
+        from scipy.stats import dirichlet as sp_dir
+        x = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(float(_np(d.log_prob(x))),
+                                   sp_dir.logpdf(x, conc), rtol=1e-4)
+        np.testing.assert_allclose(float(_np(d.entropy())),
+                                   sp_dir.entropy(conc), rtol=1e-4)
+        s = _np(d.sample([20000]))
+        np.testing.assert_allclose(s.mean(axis=0), conc / 6.0, atol=0.01)
+
+
+class TestLocationScale:
+    def test_uniform(self):
+        u = D.Uniform(1.0, 3.0)
+        np.testing.assert_allclose(float(_np(u.entropy())), np.log(2.0),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(_np(u.log_prob(2.0))), -np.log(2.0),
+                                   rtol=1e-6)
+        assert float(_np(u.log_prob(0.5))) == -np.inf
+        s = _np(u.sample([20000]))
+        assert abs(s.mean() - 2.0) < 0.02
+        assert (s >= 1.0).all() and (s <= 3.0).all()
+
+    def test_laplace(self):
+        la = D.Laplace(0.5, 2.0)
+        from scipy.stats import laplace as sp
+        x = np.array([-1.0, 0.5, 4.0], np.float32)
+        np.testing.assert_allclose(_np(la.log_prob(x)),
+                                   sp.logpdf(x, 0.5, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(_np(la.entropy())),
+                                   sp.entropy(0.5, 2.0), rtol=1e-5)
+        s = _np(la.sample([40000]))
+        assert abs(s.mean() - 0.5) < 0.05
+        np.testing.assert_allclose(_np(la.icdf(la.cdf(x))), x, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_gumbel(self):
+        g = D.Gumbel(1.0, 2.0)
+        from scipy.stats import gumbel_r as sp
+        x = np.array([0.0, 1.0, 5.0], np.float32)
+        np.testing.assert_allclose(_np(g.log_prob(x)), sp.logpdf(x, 1.0, 2.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(_np(g.entropy())),
+                                   sp.entropy(1.0, 2.0), rtol=1e-5)
+        s = _np(g.sample([40000]))
+        assert abs(s.mean() - sp.mean(1.0, 2.0)) < 0.1
+
+    def test_cauchy(self):
+        c = D.Cauchy(0.0, 1.0)
+        from scipy.stats import cauchy as sp
+        x = np.array([-2.0, 0.0, 3.0], np.float32)
+        np.testing.assert_allclose(_np(c.log_prob(x)), sp.logpdf(x),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(_np(c.cdf(x)), sp.cdf(x), rtol=1e-5)
+        with pytest.raises(ValueError):
+            _ = c.mean
+
+
+class TestGeometricMultinomial:
+    def test_geometric(self):
+        g = D.Geometric(0.25)
+        np.testing.assert_allclose(float(_np(g.mean)), 4.0, rtol=1e-6)
+        np.testing.assert_allclose(float(_np(g.variance)), 12.0, rtol=1e-5)
+        # pmf(k) = (1-p)^(k-1) p, k = 1, 2, ...
+        np.testing.assert_allclose(float(_np(g.pmf(2))), 0.75 * 0.25,
+                                   rtol=1e-5)
+        s = _np(g.sample([40000]))
+        assert abs(s.mean() - 4.0) < 0.1
+        assert s.min() >= 1.0
+
+    def test_multinomial(self):
+        m = D.Multinomial(10, np.array([0.2, 0.8], np.float32))
+        s = _np(m.sample([500]))
+        assert s.shape == (500, 2)
+        np.testing.assert_allclose(s.sum(axis=-1), 10.0)
+        assert abs(s[:, 0].mean() - 2.0) < 0.3
+        from scipy.stats import multinomial as sp
+        np.testing.assert_allclose(
+            float(_np(m.log_prob(np.array([2.0, 8.0], np.float32)))),
+            sp.logpmf([2, 8], 10, [0.2, 0.8]), rtol=1e-4)
+
+    def test_multinomial_entropy_exact(self):
+        from scipy.stats import multinomial as sp
+        for n, probs in [(10, [0.2, 0.8]), (2, [0.5, 0.5]),
+                         (6, [0.1, 0.3, 0.6])]:
+            m = D.Multinomial(n, np.asarray(probs, np.float32))
+            np.testing.assert_allclose(float(_np(m.entropy())),
+                                       sp.entropy(n, probs), rtol=1e-4)
+
+
+class TestKL:
+    def test_normal_normal(self):
+        kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+        expect = np.log(2.0) + (1.0 + 1.0) / (2 * 4.0) - 0.5
+        np.testing.assert_allclose(float(_np(kl)), expect, rtol=1e-5)
+
+    def test_kl_monte_carlo(self):
+        # KL(p||q) ≈ E_p[log p - log q] for several pairs
+        pairs = [
+            (D.Beta(2.0, 3.0), D.Beta(1.5, 1.5)),
+            (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+            (D.Gumbel(0.0, 1.0), D.Gumbel(0.3, 1.4)),
+            (D.Dirichlet(np.array([1.0, 2.0], np.float32)),
+             D.Dirichlet(np.array([2.0, 2.0], np.float32))),
+        ]
+        for p, q in pairs:
+            s = p.sample([60000])
+            mc = float(_np((p.log_prob(s) - q.log_prob(s)).mean()))
+            kl = float(_np(D.kl_divergence(p, q)))
+            assert abs(mc - kl) < 0.05, (type(p).__name__, mc, kl)
+
+    def test_bernoulli_categorical_geometric(self):
+        kl = D.kl_divergence(D.Bernoulli(0.3), D.Bernoulli(0.5))
+        expect = 0.3 * np.log(0.3 / 0.5) + 0.7 * np.log(0.7 / 0.5)
+        np.testing.assert_allclose(float(_np(kl)), expect, rtol=1e-4)
+        c1 = D.Categorical(np.log(np.array([0.5, 0.5], np.float32)))
+        c2 = D.Categorical(np.log(np.array([0.2, 0.8], np.float32)))
+        expect = 0.5 * np.log(0.5 / 0.2) + 0.5 * np.log(0.5 / 0.8)
+        np.testing.assert_allclose(float(_np(D.kl_divergence(c1, c2))),
+                                   expect, rtol=1e-4)
+        kl_g = float(_np(D.kl_divergence(D.Geometric(0.3), D.Geometric(0.5))))
+        # MC check on the pmf over a truncated support
+        k = np.arange(1, 200, dtype=np.float64)
+        pk = (0.7 ** (k - 1)) * 0.3
+        qk = (0.5 ** (k - 1)) * 0.5
+        np.testing.assert_allclose(kl_g, (pk * np.log(pk / qk)).sum(),
+                                   rtol=1e-3)
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Distribution):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return pp.to_tensor(np.float32(42.0))
+
+        assert float(_np(D.kl_divergence(MyDist(), MyDist()))) == 42.0
+        with pytest.raises(NotImplementedError):
+            D.kl_divergence(MyDist(), D.Normal(0.0, 1.0))
+
+
+class TestTransforms:
+    def test_roundtrips(self):
+        x = np.array([-0.7, 0.2, 1.3], np.float32)
+        cases = [
+            D.AffineTransform(1.0, 2.0),
+            D.ExpTransform(),
+            D.SigmoidTransform(),
+            D.TanhTransform(),
+            D.ChainTransform([D.AffineTransform(0.0, 0.5), D.TanhTransform()]),
+        ]
+        for t in cases:
+            y = t.forward(pp.to_tensor(x))
+            xr = t.inverse(y)
+            np.testing.assert_allclose(_np(xr), x, rtol=1e-4, atol=1e-5,
+                                       err_msg=type(t).__name__)
+
+    def test_log_det_numeric(self):
+        # fldj == log |dy/dx| elementwise, checked by finite differences
+        x = np.array([-0.5, 0.4, 1.1], np.float32)
+        eps = 1e-3
+        for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform(),
+                  D.PowerTransform(2.0)]:
+            xv = np.abs(x) + 0.1 if isinstance(t, D.PowerTransform) else x
+            y1 = _np(t.forward(pp.to_tensor((xv + eps).astype(np.float32))))
+            y0 = _np(t.forward(pp.to_tensor((xv - eps).astype(np.float32))))
+            num = np.log(np.abs((y1 - y0) / (2 * eps)))
+            ld = _np(t.forward_log_det_jacobian(pp.to_tensor(xv.astype(np.float32))))
+            np.testing.assert_allclose(ld, num, rtol=1e-2, atol=1e-3,
+                                       err_msg=type(t).__name__)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = np.array([0.3, -0.2, 0.8], np.float32)
+        y = _np(t.forward(pp.to_tensor(x)))
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-6)
+        xr = _np(t.inverse(pp.to_tensor(y)))
+        np.testing.assert_allclose(xr, x, rtol=1e-4, atol=1e-5)
+
+    def test_inverse_log_det_composites(self):
+        x = pp.to_tensor(np.array([0.5, 1.0], np.float32))
+        chain = D.ChainTransform([D.ExpTransform()])
+        y = chain.forward(x)
+        np.testing.assert_allclose(_np(chain.inverse_log_det_jacobian(y)),
+                                   -_np(x), rtol=1e-5)
+
+    def test_stack_injective_guard(self):
+        st = D.StackTransform([D.AbsTransform(), D.ExpTransform()], axis=0)
+        assert not st._is_injective
+        base = D.Independent(
+            D.Normal(np.zeros((2, 3), np.float32),
+                     np.ones((2, 3), np.float32)), 2)
+        td = D.TransformedDistribution(base, [st])
+        with pytest.raises(ValueError):
+            td.log_prob(np.ones((2, 3), np.float32))
+
+    def test_reshape_transformed_log_prob(self):
+        base = D.Independent(
+            D.Normal(np.zeros((2, 4), np.float32),
+                     np.ones((2, 4), np.float32)), 1)
+        td = D.TransformedDistribution(
+            base, [D.ReshapeTransform((4,), (2, 2))])
+        v = np.zeros((2, 2, 2), np.float32)
+        lp = td.log_prob(v)
+        assert list(lp.shape) == [2]
+        expect = 4 * (-0.5 * np.log(2 * np.pi))
+        np.testing.assert_allclose(_np(lp), [expect, expect], rtol=1e-5)
+
+    def test_reshape_stack(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        y = t.forward(pp.to_tensor(x))
+        assert list(y.shape) == [2, 2, 2]
+        np.testing.assert_allclose(_np(t.inverse(y)), x)
+        st = D.StackTransform([D.ExpTransform(), D.AffineTransform(0.0, 2.0)],
+                              axis=0)
+        x2 = np.array([[0.0, 1.0], [1.0, 2.0]], np.float32)
+        y2 = _np(st.forward(pp.to_tensor(x2)))
+        np.testing.assert_allclose(y2[0], np.exp(x2[0]), rtol=1e-5)
+        np.testing.assert_allclose(y2[1], 2 * x2[1], rtol=1e-5)
+
+
+class TestComposite:
+    def test_independent(self):
+        base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == () and ind.event_shape == (3,)
+        x = np.array([0.1, -0.2, 0.3], np.float32)
+        np.testing.assert_allclose(float(_np(ind.log_prob(x))),
+                                   _np(base.log_prob(x)).sum(), rtol=1e-5)
+
+    def test_transformed_distribution_sampling(self):
+        td = D.TransformedDistribution(
+            D.Normal(0.0, 1.0),
+            [D.AffineTransform(1.0, 0.5), D.ExpTransform()])
+        s = _np(td.sample([50000]))
+        assert (s > 0).all()
+        # lognormal(1, 0.5) mean = exp(1 + 0.125)
+        assert abs(s.mean() - math.exp(1.125)) < 0.05
+
+    def test_expfamily_entropy_via_grad(self):
+        class NormalEF(D.ExponentialFamily):
+            def __init__(self, loc, scale):
+                self.loc = pp.to_tensor(np.float32(loc))
+                self.scale = pp.to_tensor(np.float32(scale))
+                super().__init__(batch_shape=())
+
+            @property
+            def _natural_parameters(self):
+                return [self.loc / (self.scale ** 2),
+                        -0.5 / (self.scale ** 2)]
+
+            def _log_normalizer(self, n1, n2):
+                return -n1 * n1 / (4.0 * n2) - 0.5 * pp.log(-2.0 * n2)
+
+            @property
+            def _mean_carrier_measure(self):
+                return -0.5 * float(np.log(2 * np.pi))
+
+        ef = NormalEF(0.3, 1.7)
+        np.testing.assert_allclose(
+            float(_np(ef.entropy())),
+            0.5 + 0.5 * np.log(2 * np.pi) + np.log(1.7), rtol=1e-5)
