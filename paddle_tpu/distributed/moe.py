@@ -29,7 +29,7 @@ from paddle_tpu.nn import functional as F
 from paddle_tpu.distributed.mpu import constrain
 
 __all__ = ["top_k_gating", "NaiveGate", "SwitchGate", "GShardGate",
-           "MoELayer", "ExpertFFN"]
+           "MoELayer", "ExpertFFN", "moe_shard_a2a", "moe_forward_a2a"]
 
 
 def top_k_gating(gate_logits, k: int, capacity: int,
@@ -151,12 +151,93 @@ class ExpertFFN(Layer):
     def forward(self, expert_inputs):
         """expert_inputs: [E, C, d] -> [E, C, d]."""
         from paddle_tpu.core.dispatch import unwrap
-        w1, w2 = unwrap(self.w1), unwrap(self.w2)
-        b1, b2 = unwrap(self.b1), unwrap(self.b2)
-        x = unwrap(expert_inputs)
-        h = jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :]
-        h = unwrap(self.activation(h))
-        return jnp.einsum("ech,ehd->ecd", h, w2) + b2[:, None, :]
+        return _expert_ffn(unwrap(expert_inputs), unwrap(self.w1),
+                           unwrap(self.b1), unwrap(self.w2), unwrap(self.b2),
+                           lambda v: unwrap(self.activation(v)))
+
+
+def _expert_ffn(x, w1, b1, w2, b2, act):
+    """Stacked-expert FFN compute shared by ExpertFFN.forward and the
+    all_to_all dispatch path: [E, C, d] -> [E, C, d]."""
+    h = jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :]
+    return jnp.einsum("ech,ehd->ecd", act(h), w2) + b2[:, None, :]
+
+
+def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
+                  capacity: int, activation=None, ep_axis: str = "ep"):
+    """Explicit all_to_all expert dispatch — runs INSIDE shard_map.
+
+    Semantic parity with the reference's global_scatter/global_gather
+    collectives (operators/collective/global_scatter_op.cu.cc): each ep
+    shard routes its local tokens into per-expert capacity buffers, an
+    all_to_all exchanges the expert axis for a source-shard axis, local
+    experts run, and the inverse all_to_all returns results.
+
+    Args:
+      x2d: [T_loc, d] local tokens.
+      gate_w: [d, E] replicated router weight (E = global expert count).
+      w1/b1/w2/b2: LOCAL expert slices [E_loc, ...] (ep-sharded).
+      capacity: per (source shard, expert) buffer slots.
+    Returns:
+      out: [T_loc, d]; aux: global mean load-balance loss.
+    """
+    act = activation or jax.nn.gelu
+    logits = x2d @ gate_w                                     # [T_loc, E]
+    combine, dispatch, aux = top_k_gating(logits, k=top_k, capacity=capacity)
+
+    buf = jnp.einsum("tec,td->ecd", dispatch.astype(x2d.dtype), x2d)
+    # [E, C, d] -> split experts to their shards, gather source chunks:
+    # [E_loc, n_shards*C, d]
+    recv = jax.lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+    out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
+    # inverse exchange: [E_loc, n*C, d] -> [E, C, d]
+    back = jax.lax.all_to_all(out_loc, ep_axis, split_axis=1, concat_axis=0,
+                              tiled=True)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x2d.dtype), back)
+    return out, jax.lax.pmean(aux, ep_axis)
+
+
+def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
+                    capacity_factor: float = 1.25, dropless: bool = False,
+                    activation=None, ep_axis: str = "ep"):
+    """Jit-callable wrapper: shard_maps :func:`moe_shard_a2a` over the ep
+    axis of ``mesh``.
+
+    x: [B, S, d] — flattened to [B*S, d] and sharded on the token axis
+    (constraint: B*S divisible by the ep mesh size); expert weights
+    [E, ...] sharded on ep (E divisible by ep size); gate replicated."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    shape = x.shape
+    d = shape[-1]
+    x2d = x.reshape(-1, d)  # shard the flat token axis, not the batch axis
+    n = mesh.shape[ep_axis]
+    E = gate_w.shape[-1]
+    T = x2d.shape[0]
+    if T % n:
+        raise ValueError(f"token count {T} not divisible by ep={n}")
+    if E % n:
+        raise ValueError(f"expert count {E} not divisible by ep={n}")
+    t_loc = T // n
+    if dropless:
+        capacity = t_loc  # an expert can receive at most every local token
+    else:
+        capacity = max(1, int(capacity_factor * top_k * t_loc / E))
+
+    def fn(xs, gw, a1, c1, a2, c2):
+        return moe_shard_a2a(xs, gw, a1, c1, a2, c2, top_k=top_k,
+                             capacity=capacity, activation=activation,
+                             ep_axis=ep_axis)
+
+    mapped = shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis), P(ep_axis),
+                  P(ep_axis)),
+        out_specs=(P(ep_axis), P()))
+    out, aux = mapped(x2d, gate_w, w1, b1, w2, b2)
+    return out.reshape(shape), aux
 
 
 class MoELayer(Layer):
@@ -171,12 +252,21 @@ class MoELayer(Layer):
                  d_hidden: Optional[int] = None, gate: str = "gshard",
                  top_k: Optional[int] = None,
                  capacity_factor: float = 1.25,
-                 experts: Optional[Layer] = None, ep_axis: str = "ep"):
+                 experts: Optional[Layer] = None, ep_axis: str = "ep",
+                 dispatch_mode: str = "einsum", dropless: bool = False,
+                 mesh=None):
         super().__init__()
+        if dispatch_mode not in ("einsum", "all_to_all"):
+            raise ValueError(f"unknown dispatch_mode {dispatch_mode}")
+        if dispatch_mode == "all_to_all" and mesh is None:
+            raise ValueError("dispatch_mode='all_to_all' needs mesh=")
         self.d_model = d_model
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
         self.ep_axis = ep_axis
+        self.dispatch_mode = dispatch_mode
+        self.dropless = dropless
+        self.mesh = mesh
         if gate == "gshard":
             self.gate = GShardGate(d_model, num_experts, capacity_factor)
         elif gate == "switch":
@@ -202,14 +292,40 @@ class MoELayer(Layer):
         data = unwrap(x)
         B, S, d = data.shape
         T = B * S
+
+        if self.dispatch_mode == "all_to_all":
+            if not isinstance(self.experts, ExpertFFN):
+                raise ValueError("all_to_all dispatch requires the stacked "
+                                 "ExpertFFN experts")
+            out, aux = moe_forward_a2a(
+                data, unwrap(self.gate.gate),
+                unwrap(self.experts.w1), unwrap(self.experts.b1),
+                unwrap(self.experts.w2), unwrap(self.experts.b2),
+                mesh=self.mesh, top_k=self.gate.top_k,
+                capacity_factor=self.capacity_factor,
+                dropless=self.dropless, ep_axis=self.ep_axis,
+                activation=lambda v: unwrap(self.experts.activation(v)))
+            self.aux_loss = aux
+            if hasattr(x, "_data"):
+                from paddle_tpu.core.tensor import Tensor
+                t = Tensor(out)
+                t.stop_gradient = x.stop_gradient
+                return t
+            return out
+
         E = self.num_experts
         x2d = data.reshape(T, d)
-
         # expected assignments are top_k*T/E under balanced routing, so
         # capacity must scale with k (reference GShardGate caps per expert
-        # at ceil(cap_rate * tokens), similarly k-aware in effect)
-        capacity = max(1, int(self.capacity_factor * self.gate.top_k
-                              * T / E))
+        # at ceil(cap_rate * tokens), similarly k-aware in effect);
+        # dropless pins capacity at T so no token can ever be dropped —
+        # exact but O(T^2·E) dispatch memory, toy/test scale only (the
+        # all_to_all path bounds capacity at tokens-per-shard instead)
+        if self.dropless:
+            capacity = T
+        else:
+            capacity = max(1, int(self.capacity_factor * self.gate.top_k
+                                  * T / E))
         logits = unwrap(self.gate.logits(x2d))
         combine, dispatch, aux = top_k_gating(
             logits, k=self.gate.top_k, capacity=capacity)

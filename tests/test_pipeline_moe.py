@@ -245,6 +245,80 @@ class TestMoELayer:
         np.testing.assert_allclose(np.asarray(got), serial, rtol=2e-4,
                                    atol=2e-4)
 
+    def test_dropless_never_drops(self):
+        """Adversarial routing (every token to expert 0): capacity mode
+        zeroes overflow tokens, dropless mode keeps them all."""
+        pp.seed(4)
+        d, E, T = 4, 4, 16
+        for dropless, expect_zero_rows in [(False, True), (True, False)]:
+            moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=8,
+                                gate="switch", capacity_factor=0.25,
+                                dropless=dropless)
+            moe.gate.jitter_eps = 0.0
+            # zero gate weight -> all logits tie at 0 -> argmax routes every
+            # token to expert 0 (true adversarial all-to-one load)
+            moe.gate.gate.set_value(pp.to_tensor(np.zeros((d, E), np.float32)))
+            x = pp.randn([1, T, d])
+            out = np.asarray(moe(x).numpy())
+            zero_rows = (np.abs(out.reshape(T, d)).sum(-1) < 1e-9).sum()
+            if expect_zero_rows:
+                assert zero_rows > 0
+            else:
+                assert zero_rows == 0
+
+    def test_a2a_matches_einsum_dropless(self):
+        """all_to_all dispatch over an 8-way ep mesh == dense einsum
+        dispatch, when dropless (no capacity drops on either path)."""
+        pp.seed(5)
+        d, E = 8, 8
+        B, S = 4, 16  # 64 tokens, 8 per shard
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=16,
+                            dropless=True, capacity_factor=999.0)
+        x = pp.randn([B, S, d])
+        serial = moe(x).numpy()
+
+        from paddle_tpu.core.dispatch import unwrap
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("ep",))
+        gate_w = unwrap(moe.gate.gate)
+        w1, b1 = unwrap(moe.experts.w1), unwrap(moe.experts.b1)
+        w2, b2 = unwrap(moe.experts.w2), unwrap(moe.experts.b2)
+
+        @jax.jit
+        def f(xd, gw, a1, c1, a2, c2):
+            out, aux = dist.moe_forward_a2a(
+                xd, gw, a1, c1, a2, c2, mesh=mesh, top_k=2, dropless=True,
+                activation=lambda v: unwrap(moe.experts.activation(v)))
+            return out, aux
+
+        got, aux = f(x._data, gate_w, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(got), serial, rtol=2e-4,
+                                   atol=2e-4)
+        assert np.isfinite(float(aux))
+
+    def test_a2a_layer_mode_and_grads(self):
+        """MoELayer(dispatch_mode='all_to_all') trains: grads flow through
+        router + experts under jit over the ep mesh."""
+        pp.seed(6)
+        d, E = 4, 8
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("ep",))
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=8,
+                            dispatch_mode="all_to_all", mesh=mesh,
+                            dropless=True)
+        from paddle_tpu.core.functional import functional_call, params_of
+        params = params_of(moe)
+
+        def loss(ps, xd):
+            out = functional_call(moe, ps, pp.Tensor(xd))
+            return (out._data ** 2).sum()
+
+        x = np.random.default_rng(0).normal(size=(2, 8, d)).astype("float32")
+        val, g = jax.value_and_grad(loss)(params, jnp.asarray(x))
+        assert np.isfinite(float(val))
+        gate_g = next(v for k, v in g.items() if "gate" in k)
+        assert float(jnp.abs(gate_g).sum()) > 0
+        expert_g = next(v for k, v in g.items() if k.endswith("w1"))
+        assert float(jnp.abs(expert_g).sum()) > 0
+
     def test_grads_flow_through_router_in_jit(self):
         pp.seed(3)
         moe = dist.MoELayer(d_model=4, num_experts=2, d_hidden=8,
