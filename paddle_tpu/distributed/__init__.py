@@ -27,8 +27,9 @@ from paddle_tpu.distributed.fleet import (  # noqa: F401
     DistributedStrategy, fleet)
 from paddle_tpu.distributed import mpu  # noqa: F401
 from paddle_tpu.distributed.pipeline import (  # noqa: F401
-    LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc, spmd_pipeline,
-    stack_stage_params)
+    LayerDesc, PipelineLayer, PipelineTrainStep, SegmentLayers,
+    SharedLayerDesc, build_1f1b_schedule, build_interleaved_schedule,
+    pipeline_1f1b, pipeline_interleaved, spmd_pipeline, stack_stage_params)
 from paddle_tpu.distributed.moe import (  # noqa: F401
     ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate,
     moe_forward_a2a, moe_shard_a2a, top_k_gating)

@@ -39,7 +39,9 @@ from jax import lax
 from paddle_tpu.nn.layer import Layer
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
-           "spmd_pipeline"]
+           "spmd_pipeline", "build_1f1b_schedule", "pipeline_1f1b",
+           "build_interleaved_schedule", "pipeline_interleaved",
+           "PipelineTrainStep"]
 
 
 class LayerDesc:
@@ -342,6 +344,26 @@ def build_1f1b_schedule(num_stages: int, num_microbatches: int):
     return (np.asarray(ops, np.int32), np.asarray(mbs, np.int32))
 
 
+
+def _varying_axes(axis_name, *trees):
+    """Union of manual axes any leaf varies over, plus the pipeline axis —
+    under a multi-axis mesh (pp x dp x tp) compute mixes them all, so every
+    branch output / scan carry is marked varying over the full set."""
+    axes = {axis_name}
+    for v in jax.tree.leaves(trees):
+        vma = getattr(jax.typeof(v), "vma", None)
+        if vma:
+            axes |= set(vma)
+    return tuple(sorted(axes))
+
+
+def _pvary_axes(x, axes):
+    from paddle_tpu.distributed.communication import pvary
+    for ax in axes:
+        x = pvary(x, ax)
+    return x
+
+
 def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                   stage_params: Any, mb_inputs, mb_labels, *,
                   num_microbatches: int, axis_name: str = "pp",
@@ -456,8 +478,10 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
         # 2) compute — switch so idle ticks cost nothing and fwd ticks
         #    don't pay the vjp.  Every branch output is pvary'd so the
         #    branches agree on varying-manual-axes types.
-        from paddle_tpu.distributed.communication import pvary as _pv
-        pv = lambda *tree: jax.tree.map(lambda a: _pv(a, axis_name), tree)
+        def pv(y, dx, gtree, l):
+            return (_pvary_axes(y, act_axes), _pvary_axes(dx, act_axes),
+                    jax.tree.map(lambda a: _pvary_axes(a, vaxes), gtree),
+                    _pvary_axes(l, vaxes))
 
         def do_idle(_):
             return pv(zeros_b(), zeros_b(), jax.tree.map(
@@ -472,7 +496,12 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
             def run(loss_like):
                 from paddle_tpu.distributed.communication import pvary
                 val, pull = jax.vjp(loss_like, stage_params, x_saved)
-                dp, dx = pull(pvary(jnp.ones((), val.dtype), axis_name))
+                # the seed's varying-axes set must match val's (under a
+                # multi-axis mesh the loss also varies over dp/tp axes)
+                vma = getattr(jax.typeof(val), "vma", None)
+                seed = _pvary_axes(jnp.ones((), val.dtype),
+                                   vma or (axis_name,))
+                dp, dx = pull(seed)
                 return val, dp, dx
 
             def last_branch(_):
@@ -505,14 +534,426 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                                [(i, (i - 1) % S) for i in range(S)])
         return (new_fwd, new_bwd, in_buf, cot_buf, grads, loss_acc), None
 
-    init = (pvary(zeros_b(), axis_name),
-            pvary(zeros_b(), axis_name),
-            pvary(jnp.zeros((S,) + bshape, bdtype), axis_name),
-            pvary(jnp.zeros((S,) + bshape, bdtype), axis_name),
-            jax.tree.map(lambda z: pvary(z, axis_name), grad_zero),
-            pvary(jnp.zeros((), jnp.float32), axis_name))
+    # activations only vary over the pipeline axis and whatever the batch is
+    # sharded on (e.g. dp) — marking them varying over tp too would insert a
+    # spurious psum in the transpose, double-counting every gradient
+    act_axes = _varying_axes(axis_name, mb_inputs, mb_labels)
+    vaxes = _varying_axes(axis_name, stage_params, mb_inputs, mb_labels)
+    init = (_pvary_axes(zeros_b(), act_axes),
+            _pvary_axes(zeros_b(), act_axes),
+            _pvary_axes(jnp.zeros((S,) + bshape, bdtype), act_axes),
+            _pvary_axes(jnp.zeros((S,) + bshape, bdtype), act_axes),
+            jax.tree.map(lambda z: _pvary_axes(z, vaxes), grad_zero),
+            _pvary_axes(jnp.zeros((), jnp.float32), vaxes))
     (_, _, _, _, grads, loss_acc), _ = lax.scan(tick, init, jnp.arange(T))
 
     # every stage reports the (last-stage-only) loss
     loss = lax.psum(loss_acc, axis_name)
     return loss, grads
+
+
+# -- interleaved virtual stages ----------------------------------------------
+
+def build_interleaved_schedule(num_stages: int, num_chunks: int,
+                               num_microbatches: int):
+    """Static schedule for interleaved virtual stages (reference
+    PipelineParallel._forward_backward_pipeline with virtual_pp_degree,
+    pipeline_parallel.py:565,642; PipelineLayerChunk pp_layers.py:214).
+
+    Device s holds chunks c=0..V-1; chunk c on device s is GLOBAL stage
+    g = c*S + s (the reference's interleaved layout: consecutive model
+    slices round-robin over devices).  Discrete-event simulation: one op
+    per device per tick, backward preferred once warmup completes, with
+    the same arrival constraints as 1F1B (one hop per tick both ways).
+
+    Returns (op[T,S], chunk[T,S], mb[T,S]); op: 0 idle, 1 fwd, 2 bwd.
+    """
+    S, V, M = num_stages, num_chunks, num_microbatches
+    G = S * V
+    dev = lambda g: g % S
+    fwd_ready = [set() for _ in range(G)]
+    bwd_ready = [set() for _ in range(G)]
+    fwd_ready[0] = set(range(M))
+    fwd_done = [0] * G
+    bwd_done = [0] * G
+    ops, chunks, mbs = [], [], []
+    guard = 0
+    while any(b < M for b in bwd_done):
+        guard += 1
+        if guard > 8 * (M * V + G) + 16:
+            raise RuntimeError("interleaved schedule did not converge")
+        row_op = [0] * S
+        row_ch = [0] * S
+        row_mb = [0] * S
+        events = []
+        for s in range(S):
+            # candidate ops among this device's chunks, deepest global
+            # stage first so drains happen promptly
+            pick = None
+            for c in reversed(range(V)):
+                g = c * S + s
+                bm = bwd_done[g]
+                if bm < fwd_done[g] and bm in bwd_ready[g]:
+                    pick = (2, c, bm)
+                    break
+            if pick is None:
+                # forward: lowest chunk whose next microbatch arrived and
+                # whose in-flight count stays within the warmup bound
+                for c in range(V):
+                    g = c * S + s
+                    fm = fwd_done[g]
+                    warmup = min(G - 1 - g, M)
+                    if fm < M and fm in fwd_ready[g] and \
+                            (fwd_done[g] - bwd_done[g]) <= warmup:
+                        pick = (1, c, fm)
+                        break
+            if pick is None:
+                continue
+            kind, c, m = pick
+            g = c * S + s
+            row_op[s], row_ch[s], row_mb[s] = kind, c, m
+            if kind == 1:
+                fwd_done[g] += 1
+                if g < G - 1:
+                    events.append((g + 1, "fwd", m))
+                else:
+                    events.append((g, "bwd", m))
+            else:
+                bwd_done[g] += 1
+                if g > 0:
+                    events.append((g - 1, "bwd", m))
+        for g, kind, m in events:
+            (fwd_ready if kind == "fwd" else bwd_ready)[g].add(m)
+        ops.append(row_op)
+        chunks.append(row_ch)
+        mbs.append(row_mb)
+    return (np.asarray(ops, np.int32), np.asarray(chunks, np.int32),
+            np.asarray(mbs, np.int32))
+
+
+def pipeline_interleaved(stage_fn: Callable, first_fn: Callable,
+                         last_fn: Callable, chunk_params: Any,
+                         mb_inputs, mb_labels, *, num_microbatches: int,
+                         num_chunks: int, axis_name: str = "pp",
+                         remat: bool = True):
+    """Interleaved-virtual-stage fused fwd+bwd pipeline INSIDE shard_map.
+
+    chunk_params: this device's [V, ...] chunk param stack (the global
+    stack is [S, V, ...], shard_map split axis 0; element [s][c] serves
+    global stage c*S + s).  Contract otherwise as :func:`pipeline_1f1b`.
+
+    Wire routing differs from plain 1F1B in that the ring wrap is REAL:
+    a forward boundary leaving device S-1 (chunk c) lands on device 0
+    as the input of chunk c+1, and symmetrically for cotangents — the
+    banking tables below encode exactly which (chunk, mb) each tick's
+    incoming payload belongs to.
+    """
+    S = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = num_microbatches
+    V = num_chunks
+    G = S * V
+    from paddle_tpu.distributed.communication import pvary
+
+    # contract: called inside shard_map with the [S, V, ...] global stack
+    # split by in_specs=P('pp'), so every local leaf arrives as [1, V, ...];
+    # normalise to [V, ...] here and restore the leading pp axis on the
+    # returned grads so out_specs=P('pp') reassembles the global stack
+    for a in jax.tree.leaves(chunk_params):
+        if a.ndim < 2 or a.shape[0] != 1 or a.shape[1] != V:
+            raise ValueError(
+                "pipeline_interleaved expects chunk_params leaves shaped "
+                f"[1, V={V}, ...] (the shard_map-split [S, V, ...] stack); "
+                f"got {a.shape}")
+    chunk_params = jax.tree.map(lambda a: a.reshape(a.shape[1:]),
+                                chunk_params)
+
+    op_np, ch_np, mb_np = build_interleaved_schedule(S, V, M)
+    T = op_np.shape[0]
+    op_table = jnp.asarray(op_np)
+    ch_table = jnp.asarray(ch_np)
+    mb_table = jnp.asarray(mb_np)
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    # host-side banking tables: validity + (chunk, mb) of each incoming wire
+    up_valid = np.zeros((T, S), bool)
+    up_ch = np.zeros((T, S), np.int32)
+    up_mb = np.zeros((T, S), np.int32)
+    dn_valid = np.zeros((T, S), bool)
+    dn_ch = np.zeros((T, S), np.int32)
+    dn_mb = np.zeros((T, S), np.int32)
+    for t in range(1, T):
+        for s in range(S):
+            u = (s - 1) % S
+            if op_np[t - 1, u] == 1:
+                c = int(ch_np[t - 1, u])
+                tc = c if s > 0 else c + 1
+                if tc < V and (c * S + u) < G - 1:
+                    up_valid[t, s] = True
+                    up_ch[t, s] = tc
+                    up_mb[t, s] = mb_np[t - 1, u]
+            w = (s + 1) % S
+            if op_np[t - 1, w] == 2:
+                c = int(ch_np[t - 1, w])
+                tc = c if s < S - 1 else c - 1
+                if tc >= 0 and (c * S + w) > 0:
+                    dn_valid[t, s] = True
+                    dn_ch[t, s] = tc
+                    dn_mb[t, s] = mb_np[t - 1, w]
+    up_valid_t = jnp.asarray(up_valid)
+    up_ch_t = jnp.asarray(up_ch)
+    up_mb_t = jnp.asarray(up_mb)
+    dn_valid_t = jnp.asarray(dn_valid)
+    dn_ch_t = jnp.asarray(dn_ch)
+    dn_mb_t = jnp.asarray(dn_mb)
+
+    # probe boundary shape
+    x0 = jax.eval_shape(
+        first_fn, jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape[1:],
+                                                              a.dtype),
+                               chunk_params),
+        jax.ShapeDtypeStruct(mb_inputs.shape[1:], mb_inputs.dtype))
+    bshape, bdtype = x0.shape, x0.dtype
+    y0 = jax.eval_shape(fn, jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), chunk_params),
+        x0)
+    if (y0.shape, y0.dtype) != (bshape, bdtype):
+        raise ValueError(f"stage must preserve boundary: {x0} -> {y0}")
+
+    B = min(M, G + 2)  # slots per chunk: in-flight per stage <= G+1
+    zeros_b = lambda: jnp.zeros(bshape, bdtype)
+    pslice = lambda c: jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, c, 0, keepdims=False),
+        chunk_params)
+    grad_zero = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.promote_types(a.dtype, jnp.float32)
+                            if jnp.issubdtype(a.dtype, jnp.floating)
+                            else a.dtype),
+        chunk_params)
+    inv_m = 1.0 / M
+
+    def _store2(buf, valid, c, m, payload):
+        """buf[c, m % B] = payload where valid."""
+        cur = lax.dynamic_slice(
+            buf, (c, m % B) + (0,) * len(bshape), (1, 1) + bshape)
+        new = jnp.where(valid, payload.reshape((1, 1) + bshape), cur)
+        return lax.dynamic_update_slice(buf, new,
+                                        (c, m % B) + (0,) * len(bshape))
+
+    def _load2(buf, c, m):
+        return lax.dynamic_slice(
+            buf, (c, m % B) + (0,) * len(bshape),
+            (1, 1) + bshape).reshape(bshape)
+
+    def tick(carry, t):
+        fwd_wire, bwd_wire, in_buf, cot_buf, grads, loss_acc = carry
+        op = op_table[t, idx]
+        c = ch_table[t, idx]
+        m = mb_table[t, idx]
+
+        in_buf = _store2(in_buf, up_valid_t[t, idx], up_ch_t[t, idx],
+                         up_mb_t[t, idx], fwd_wire)
+        cot_buf = _store2(cot_buf, dn_valid_t[t, idx], dn_ch_t[t, idx],
+                          dn_mb_t[t, idx], bwd_wire)
+
+        raw = lax.dynamic_index_in_dim(mb_inputs, m, 0, keepdims=False)
+        lab = lax.dynamic_index_in_dim(mb_labels, m, 0, keepdims=False)
+        x_saved = _load2(in_buf, c, m)
+        g_recv = _load2(cot_buf, c, m)
+        params_c = pslice(c)
+        is_first = (idx == 0) & (c == 0)
+        is_last = (idx == S - 1) & (c == V - 1)
+
+        def thread_first(p, x):
+            x_in = jnp.where(is_first, first_fn(p, raw), x)
+            return fn(p, x_in)
+
+        def pv(y, dx, gtree, l):
+            return (_pvary_axes(y, act_axes), _pvary_axes(dx, act_axes),
+                    jax.tree.map(lambda a: _pvary_axes(a, vaxes), gtree),
+                    _pvary_axes(l, vaxes))
+
+        def do_idle(_):
+            return pv(zeros_b(), zeros_b(), jax.tree.map(
+                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+
+        def do_fwd(_):
+            y = thread_first(params_c, x_saved)
+            return pv(y, zeros_b(), jax.tree.map(
+                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+
+        def do_bwd(_):
+            def run(loss_like):
+                val, pull = jax.vjp(loss_like, params_c, x_saved)
+                vma = getattr(jax.typeof(val), "vma", None)
+                seed = _pvary_axes(jnp.ones((), val.dtype),
+                                   vma or (axis_name,))
+                dp, dx = pull(seed)
+                return val, dp, dx
+
+            def last_branch(_):
+                return run(lambda p, x: last_fn(p, thread_first(p, x), lab)
+                           * inv_m)
+
+            def mid_branch(_):
+                return run(lambda p, x: jnp.sum(
+                    thread_first(p, x).astype(jnp.float32)
+                    * g_recv.astype(jnp.float32)))
+
+            val, dp, dx = lax.cond(is_last, last_branch, mid_branch, None)
+            loss_c = jnp.where(is_last, val, 0.0)
+            # scatter this chunk's grads into the [V, ...] accumulator
+            dpf = jax.tree.map(
+                lambda d, z: lax.dynamic_update_index_in_dim(
+                    jnp.zeros_like(z), d.astype(z.dtype), c, 0),
+                dp, grad_zero)
+            return pv(zeros_b(), dx.astype(bdtype), dpf,
+                      loss_c.astype(jnp.float32).reshape(()))
+
+        send_y, send_dx, dp, loss_c = lax.switch(
+            jnp.clip(op, 0, 2), [do_idle, do_fwd, do_bwd], None)
+
+        grads = jax.tree.map(lambda g, d: g + d, grads, dp)
+        loss_acc = loss_acc + loss_c
+
+        new_fwd = lax.ppermute(send_y, axis_name,
+                               [(i, (i + 1) % S) for i in range(S)])
+        new_bwd = lax.ppermute(send_dx, axis_name,
+                               [(i, (i - 1) % S) for i in range(S)])
+        return (new_fwd, new_bwd, in_buf, cot_buf, grads, loss_acc), None
+
+    act_axes = _varying_axes(axis_name, mb_inputs, mb_labels)
+    vaxes = _varying_axes(axis_name, chunk_params, mb_inputs, mb_labels)
+    init = (_pvary_axes(zeros_b(), act_axes),
+            _pvary_axes(zeros_b(), act_axes),
+            _pvary_axes(jnp.zeros((V, B) + bshape, bdtype), act_axes),
+            _pvary_axes(jnp.zeros((V, B) + bshape, bdtype), act_axes),
+            jax.tree.map(lambda z: _pvary_axes(z, vaxes), grad_zero),
+            _pvary_axes(jnp.zeros((), jnp.float32), vaxes))
+    (_, _, _, _, grads, loss_acc), _ = lax.scan(tick, init, jnp.arange(T))
+    loss = lax.psum(loss_acc, axis_name)
+    grads = jax.tree.map(lambda g: g[None], grads)
+    return loss, grads
+
+
+# -- PP composed with dp/tp: the 3-D training step ---------------------------
+
+class PipelineTrainStep:
+    """Compiled hybrid-parallel training step: 1F1B pipeline over ``pp``,
+    data parallelism over ``dp``, tensor parallelism over ``tp`` — one mesh,
+    one jitted program.
+
+    Reference role: PipelineParallel inside HybridParallelClipGrad/fleet
+    (meta_parallel/pipeline_parallel.py + hybrid_parallel_optimizer.py) where
+    pp/dp/mp process groups compose.  Here the composition is a single
+    fully-manual shard_map: the 1F1B tick scan runs over the pp axis;
+    each microbatch's SAMPLE axis is split over dp — batch shape
+    [M, mb, ...] with mb divisible by the dp size, every dp shard running
+    all M microbatches on its slice, grads normalized back to the
+    global-batch mean — and ``stage_fn`` is
+    written Megatron-style against LOCAL tp shards (explicit lax.psum over
+    the tp axis where its math requires it — same contract as mpu layers).
+
+    Args:
+      stage_fn/first_fn/last_fn: as :func:`pipeline_1f1b`, but operating on
+        local tp param shards.
+      stacked_params: dict name -> global [S, ...] stacked arrays.
+      param_specs: dict name -> PartitionSpec with the leading pp axis and
+        any tp placements, e.g. P('pp', None, 'tp').
+      optimizer: a paddle_tpu optimizer (init_state_pytree/apply_gradients).
+      batch: step() takes {'inputs': [M, mb, ...], 'labels': [M, mb, ...]};
+        the microbatch axis is split over dp.
+    """
+
+    def __init__(self, stage_fn, first_fn, last_fn, stacked_params,
+                 optimizer, mesh, num_microbatches, param_specs, *,
+                 pp_axis: str = "pp", dp_axis: Optional[str] = "dp",
+                 remat: bool = True):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        self.optimizer = optimizer
+        self.num_microbatches = num_microbatches
+        self._pp = pp_axis
+        self._dp = dp_axis if dp_axis in mesh.axis_names else None
+        self._specs = dict(param_specs)
+
+        self._param_sh = {n: NamedSharding(mesh, self._specs[n])
+                          for n in stacked_params}
+        self.params = {n: jax.device_put(jnp.asarray(a), self._param_sh[n])
+                       for n, a in stacked_params.items()}
+        self.opt_state = optimizer.init_state_pytree(self.params)
+        self.opt_state = {
+            n: jax.tree.map(
+                lambda a: jax.device_put(a, self._param_sh[n])
+                if hasattr(a, "shape") and a.shape == self.params[n].shape
+                else a, st)
+            for n, st in self.opt_state.items()}
+        self.step_count = jnp.zeros((), jnp.int32)
+
+        manual = set(mesh.axis_names)
+
+        def body(params, mb_inputs, mb_labels):
+            loss, grads = pipeline_1f1b(
+                stage_fn, first_fn, last_fn, params, mb_inputs, mb_labels,
+                num_microbatches=num_microbatches, axis_name=pp_axis,
+                remat=remat)
+            # dp semantics: each dp shard computes the mean loss of ITS
+            # microbatch slice; the vjp transpose has already psum'd the
+            # per-shard grads over dp, so divide by dp size to get the
+            # global-batch mean.  Then pmean over any axis a leaf's grad
+            # still varies on but its out_spec omits (vma cleanup; values
+            # are already equal across those shards).
+            if self._dp:
+                dp_size = lax.axis_size(self._dp)
+                grads = {n: g / dp_size for n, g in grads.items()}
+                loss = lax.pmean(loss, self._dp)
+
+            def reduce_leaf(g, spec):
+                present = set()
+                for e in spec:
+                    if isinstance(e, tuple):
+                        present.update(e)
+                    elif e is not None:
+                        present.add(e)
+                vma = getattr(jax.typeof(g), "vma", None) or ()
+                for ax in manual - present - {pp_axis}:
+                    if ax in vma:
+                        g = lax.pmean(g, ax)
+                return g
+            grads = {n: reduce_leaf(g, self._specs[n])
+                     for n, g in grads.items()}
+            vma_l = getattr(jax.typeof(loss), "vma", None) or ()
+            for ax in manual - {pp_axis}:
+                if ax in vma_l:
+                    loss = lax.pmean(loss, ax)
+            return loss, grads
+
+        batch_spec = P(None, self._dp) if self._dp else P()
+        self._shmap = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=({n: self._specs[n] for n in self.params},
+                      batch_spec, batch_spec),
+            out_specs=(P(), {n: self._specs[n] for n in self.params}))
+
+        def step_impl(params, opt_state, step_count, mb_inputs, mb_labels,
+                      lr):
+            loss, grads = self._shmap(params, mb_inputs, mb_labels)
+            step_count = step_count + 1
+            new_params, new_state = optimizer.apply_gradients(
+                params, grads, opt_state, step_count, lr=lr)
+            return loss, new_params, new_state, step_count
+
+        self._jitted = jax.jit(step_impl, donate_argnums=(0, 1, 2))
+
+    def __call__(self, batch):
+        mb_inputs = jnp.asarray(batch["inputs"])
+        mb_labels = jnp.asarray(batch["labels"])
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.params, self.opt_state, self.step_count = self._jitted(
+            self.params, self.opt_state, self.step_count, mb_inputs,
+            mb_labels, lr)
+        if self.optimizer._lr_scheduler is not None:
+            self.optimizer._lr_scheduler.step()
+        return loss

@@ -159,3 +159,205 @@ def test_1f1b_with_remat_matches():
     want = jax.grad(_serial_loss)(stacked, mb_inputs, mb_labels)
     np.testing.assert_allclose(np.asarray(grads["W"]),
                                np.asarray(want["W"]), rtol=2e-4, atol=1e-5)
+
+
+# -- interleaved virtual stages ----------------------------------------------
+
+from paddle_tpu.distributed.pipeline import (build_interleaved_schedule,
+                                             pipeline_interleaved,
+                                             PipelineTrainStep)
+
+
+class TestInterleavedSchedule:
+    @pytest.mark.parametrize("S,V,M", [(2, 2, 4), (2, 3, 5), (4, 2, 8),
+                                       (2, 1, 4), (3, 2, 6)])
+    def test_valid_and_complete(self, S, V, M):
+        op, ch, mb = build_interleaved_schedule(S, V, M)
+        T, G = op.shape[0], S * V
+        fwd_at, bwd_at = {}, {}
+        for t in range(T):
+            for s in range(S):
+                g = int(ch[t, s]) * S + s
+                if op[t, s] == 1:
+                    fwd_at[(g, mb[t, s])] = t
+                elif op[t, s] == 2:
+                    bwd_at[(g, mb[t, s])] = t
+        assert len(fwd_at) == G * M and len(bwd_at) == G * M
+        for m in range(M):
+            for g in range(1, G):
+                assert fwd_at[(g, m)] > fwd_at[(g - 1, m)]
+                assert bwd_at[(g - 1, m)] > bwd_at[(g, m)]
+            assert bwd_at[(G - 1, m)] >= fwd_at[(G - 1, m)]
+
+
+def _make_chunk_params(key, S, V, d_in, d, d_out):
+    ks = jax.random.split(key, 4)
+    scale = 1.0 / np.sqrt(d)
+    p = {
+        "W": jax.random.normal(ks[0], (S, V, d, d)) * scale,
+        "b": jnp.zeros((S, V, d)),
+        "Win": jnp.zeros((S, V, d_in, d)).at[0, 0].set(
+            jax.random.normal(ks[1], (d_in, d)) * 0.5),
+        "Wout": jnp.zeros((S, V, d, d_out)).at[S - 1, V - 1].set(
+            jax.random.normal(ks[2], (d, d_out)) * 0.5),
+    }
+    return p
+
+
+@pytest.mark.parametrize("S,V,M", [(2, 2, 4), (2, 3, 6)])
+def test_interleaved_matches_serial(S, V, M):
+    mesh = Mesh(np.array(jax.devices("cpu")[:S]), ("pp",))
+    d_in, d, d_out, mbs = 6, 8, 5, 3
+    G = S * V
+    stacked = _make_chunk_params(jax.random.PRNGKey(0), S, V, d_in, d, d_out)
+    rng = np.random.default_rng(0)
+    mb_in = jnp.asarray(rng.standard_normal((M, mbs, d_in)), jnp.float32)
+    mb_lab = jnp.asarray(rng.standard_normal((M, mbs, d_out)), jnp.float32)
+
+    def serial(stacked, mb_in, mb_lab):
+        def one(m):
+            x = _first_fn(jax.tree.map(lambda a: a[0, 0], stacked), mb_in[m])
+            for g in range(G):
+                s, c = g % S, g // S
+                x = _stage_fn(jax.tree.map(lambda a: a[s, c], stacked), x)
+            return _last_fn(jax.tree.map(lambda a: a[S - 1, V - 1], stacked),
+                            x, mb_lab[m])
+        return sum(one(m) for m in range(M)) / M
+
+    def body(p, i, l):
+        return pipeline_interleaved(_stage_fn, _first_fn, _last_fn, p, i, l,
+                                    num_microbatches=M, num_chunks=V,
+                                    remat=False)
+
+    loss, grads = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("pp"), P(), P()),
+        out_specs=(P(), P("pp"))))(stacked, mb_in, mb_lab)
+    np.testing.assert_allclose(float(loss),
+                               float(serial(stacked, mb_in, mb_lab)),
+                               rtol=1e-5)
+    want = jax.grad(serial)(stacked, mb_in, mb_lab)
+    for n in stacked:
+        np.testing.assert_allclose(np.asarray(grads[n]),
+                                   np.asarray(want[n]), rtol=2e-4,
+                                   atol=1e-5, err_msg=n)
+
+
+# -- 3-D composition: pp x dp x tp ------------------------------------------
+
+def _tp_block_params(key, S, d, H, hd, f, vocab):
+    """Llama-shaped decoder stage params, tp-shardable dims last-but-one."""
+    ks = jax.random.split(key, 8)
+    s_attn, s_ffn = 1 / np.sqrt(d), 1 / np.sqrt(f)
+    return {
+        "wq": jax.random.normal(ks[0], (S, d, H, hd)) * s_attn,
+        "wk": jax.random.normal(ks[1], (S, d, H, hd)) * s_attn,
+        "wv": jax.random.normal(ks[2], (S, d, H, hd)) * s_attn,
+        "wo": jax.random.normal(ks[3], (S, H, hd, d)) * s_attn,
+        "win": jax.random.normal(ks[4], (S, d, f)) * s_attn,
+        "wout": jax.random.normal(ks[5], (S, f, d)) * s_ffn,
+        "embed": jnp.zeros((S, vocab, d)).at[0].set(
+            jax.random.normal(ks[6], (vocab, d)) * 0.5),
+        "head": jnp.zeros((S, d, vocab)).at[S - 1].set(
+            jax.random.normal(ks[7], (d, vocab)) * 0.5),
+    }
+
+
+def _causal_attn(x, wq, wk, wv, wo):
+    """x [mb,T,d]; w* head-split (possibly local tp shards)."""
+    q = jnp.einsum("btd,dhk->bhtk", x, wq)
+    k = jnp.einsum("btd,dhk->bhtk", x, wk)
+    v = jnp.einsum("btd,dhk->bhtk", x, wv)
+    Tn = x.shape[-2]
+    scores = jnp.einsum("bhqk,bhmk->bhqm", q, k) / np.sqrt(q.shape[-1])
+    mask = jnp.tril(jnp.ones((Tn, Tn), bool))
+    scores = jnp.where(mask, scores, -1e9)
+    attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqm,bhmk->bhqk", attn, v)
+    return jnp.einsum("bhtk,hkd->btd", out, wo)
+
+
+def _tp_stage_fn(p, x):
+    """Megatron-style block on LOCAL tp shards: heads + ffn column-split,
+    row-parallel outputs psum'd over the tp axis."""
+    sq = lambda a: a[0]  # drop the size-1 pp remnant axis
+    attn = _causal_attn(x, sq(p["wq"]), sq(p["wk"]), sq(p["wv"]),
+                        sq(p["wo"]))
+    x = x + jax.lax.psum(attn, "tp")
+    h = jax.nn.relu(jnp.einsum("btd,df->btf", x, sq(p["win"])))
+    y = jnp.einsum("btf,fd->btd", h, sq(p["wout"]))
+    return x + jax.lax.psum(y, "tp")
+
+
+def _serial_stage_fn(p, x):
+    attn = _causal_attn(x, p["wq"], p["wk"], p["wv"], p["wo"])
+    x = x + attn
+    h = jax.nn.relu(jnp.einsum("btd,df->btf", x, p["win"]))
+    return x + jnp.einsum("btf,fd->btd", h, p["wout"])
+
+
+def _tp_first_fn(p, raw):
+    return p["embed"][0][raw]
+
+
+def _ce(logits, labels):
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(lse - gold)
+
+
+def _tp_last_fn(p, y, lab):
+    return _ce(jnp.einsum("btd,dv->btv", y, p["head"][0]), lab)
+
+
+def test_3d_pp_dp_tp_llama_block_parity():
+    """VERDICT item 4 'done' criterion: 2-stage x 2-dp x 2-tp decoder
+    trains via PipelineTrainStep with loss parity vs the serial model."""
+    S, DP, TP, M = 2, 2, 2, 4
+    d, H, hd, f, vocab = 8, 2, 4, 16, 32
+    mbs, T = 4, 6
+    devs = np.array(jax.devices("cpu")[:S * DP * TP]).reshape(S, DP, TP)
+    mesh = Mesh(devs, ("pp", "dp", "tp"))
+    params = _tp_block_params(jax.random.PRNGKey(0), S, d, H, hd, f, vocab)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, vocab, (M, mbs, T + 1))
+    mb_in = jnp.asarray(ids[..., :-1], jnp.int32)
+    mb_lab = jnp.asarray(ids[..., 1:], jnp.int32)
+
+    specs = {
+        "wq": P("pp", None, "tp", None), "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None), "wo": P("pp", "tp", None, None),
+        "win": P("pp", None, "tp"), "wout": P("pp", "tp", None),
+        "embed": P("pp", None, None), "head": P("pp", None, None),
+    }
+    import paddle_tpu as pp_mod
+    opt = pp_mod.optimizer.SGD(learning_rate=0.1)
+    step = PipelineTrainStep(_tp_stage_fn, _tp_first_fn, _tp_last_fn,
+                             params, opt, mesh, M, specs, remat=True)
+
+    def serial(ps, mb_in, mb_lab):
+        def one(m):
+            x = ps["embed"][0][mb_in[m]]
+            for s in range(S):
+                x = _serial_stage_fn(jax.tree.map(lambda a: a[s], ps), x)
+            return _ce(jnp.einsum("btd,dv->btv", x, ps["head"][S - 1]),
+                       mb_lab[m])
+        return sum(one(m) for m in range(M)) / M
+
+    want0 = float(serial(params, mb_in, mb_lab))
+    loss0 = float(step({"inputs": mb_in, "labels": mb_lab}))
+    np.testing.assert_allclose(loss0, want0, rtol=1e-4)
+
+    # parity of the updated params vs one serial SGD step
+    g = jax.grad(serial)(params, mb_in, mb_lab)
+    manual = jax.tree.map(lambda p_, g_: p_ - 0.1 * g_, params, g)
+    got_w = np.asarray(jax.device_get(step.params["wq"]))
+    np.testing.assert_allclose(
+        got_w, np.asarray(manual["wq"]), rtol=5e-3, atol=5e-4)
+
+    # and it actually trains: loss drops over a few steps
+    losses = [loss0]
+    for _ in range(4):
+        losses.append(float(step({"inputs": mb_in, "labels": mb_lab})))
+    assert losses[-1] < losses[0], losses
