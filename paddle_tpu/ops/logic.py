@@ -1,54 +1,17 @@
-"""Comparison / logical ops (parity: python/paddle/tensor/logic.py)."""
+"""Comparison / logical ops (parity: python/paddle/tensor/logic.py).
+
+The op wrappers are GENERATED from the schema (ops/gen/ops.yaml ->
+ops/generated_math.py); this module re-exports the logic subset and keeps
+the non-op type predicates.
+"""
 
 from __future__ import annotations
 
-import jax.numpy as jnp
-
-from paddle_tpu.core.dispatch import eager_op
-
-equal = eager_op(name="equal")(lambda x, y: jnp.equal(x, y))
-not_equal = eager_op(name="not_equal")(lambda x, y: jnp.not_equal(x, y))
-greater_than = eager_op(name="greater_than")(lambda x, y: jnp.greater(x, y))
-greater_equal = eager_op(name="greater_equal")(lambda x, y: jnp.greater_equal(x, y))
-less_than = eager_op(name="less_than")(lambda x, y: jnp.less(x, y))
-less_equal = eager_op(name="less_equal")(lambda x, y: jnp.less_equal(x, y))
-logical_and = eager_op(name="logical_and")(lambda x, y: jnp.logical_and(x, y))
-logical_or = eager_op(name="logical_or")(lambda x, y: jnp.logical_or(x, y))
-logical_xor = eager_op(name="logical_xor")(lambda x, y: jnp.logical_xor(x, y))
-logical_not = eager_op(name="logical_not")(lambda x: jnp.logical_not(x))
-bitwise_and = eager_op(name="bitwise_and")(lambda x, y: jnp.bitwise_and(x, y))
-bitwise_or = eager_op(name="bitwise_or")(lambda x, y: jnp.bitwise_or(x, y))
-bitwise_xor = eager_op(name="bitwise_xor")(lambda x, y: jnp.bitwise_xor(x, y))
-bitwise_not = eager_op(name="bitwise_not")(lambda x: jnp.bitwise_not(x))
-bitwise_left_shift = eager_op(name="bitwise_left_shift")(lambda x, y: jnp.left_shift(x, y))
-bitwise_right_shift = eager_op(name="bitwise_right_shift")(lambda x, y: jnp.right_shift(x, y))
-
-
-@eager_op
-def equal_all(x, y):
-    return jnp.array_equal(x, y)
-
-
-@eager_op(name="all")
-def all(x, axis=None, keepdim=False):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-    return jnp.all(x, axis=ax, keepdims=keepdim)
-
-
-@eager_op(name="any")
-def any(x, axis=None, keepdim=False):
-    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
-    return jnp.any(x, axis=ax, keepdims=keepdim)
-
-
-@eager_op
-def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
-    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
-
-
-@eager_op
-def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
-    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+from paddle_tpu.ops.generated_math import (  # noqa: F401
+    all, allclose, any, bitwise_and, bitwise_left_shift, bitwise_not,
+    bitwise_or, bitwise_right_shift, bitwise_xor, equal, equal_all,
+    greater_equal, greater_than, isclose, less_equal, less_than,
+    logical_and, logical_not, logical_or, logical_xor, not_equal)
 
 
 def is_tensor(x):
@@ -74,9 +37,10 @@ def is_complex(x):
     return dtypes.from_jax(unwrap(x).dtype) in dtypes.COMPLEX
 
 
-# Public surface: only ops defined in this module (tape-aware wrappers carry
-# __wrapped_pure__; plain helpers must be defined here, not imported).
-__all__ = [_n for _n, _v in list(globals().items())
-           if not _n.startswith("_") and callable(_v)
-           and (hasattr(_v, "__wrapped_pure__")
-                or getattr(_v, "__module__", None) == __name__)]
+__all__ = [
+    "all", "allclose", "any", "bitwise_and", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "equal", "equal_all", "greater_equal", "greater_than", "isclose",
+    "is_complex", "is_floating_point", "is_integer", "is_tensor",
+    "less_equal", "less_than", "logical_and", "logical_not", "logical_or",
+    "logical_xor", "not_equal"]
