@@ -70,15 +70,29 @@ class _Handle:
 
     def __init__(self):
         self._value = None
+        self._shape = None
 
     def copy_from_cpu(self, arr: np.ndarray):
-        self._value = np.asarray(arr)
+        arr = np.asarray(arr)
+        if self._shape is not None:
+            arr = arr.reshape(self._shape)
+        self._value = arr
+        self._shape = None
 
     def copy_to_cpu(self) -> np.ndarray:
         return np.asarray(self._value)
 
     def reshape(self, shape):
-        pass
+        """ZeroCopyTensor::Reshape parity: reallocates to any shape — the
+        held value is reshaped when element counts match, otherwise dropped
+        and the shape applies to the next copy_from_cpu."""
+        shape = tuple(shape)
+        if self._value is not None and \
+                int(np.prod(self._value.shape)) == int(np.prod(shape)):
+            self._value = self._value.reshape(shape)
+        else:
+            self._value = None
+            self._shape = shape
 
     @property
     def shape(self):
@@ -86,11 +100,16 @@ class _Handle:
 
 
 class Predictor:
-    def __init__(self, config: Config):
-        from paddle_tpu.jit.save_load import load
-        self._layer = load(config.model_dir())
+    def __init__(self, config: Config, _shared_layer=None):
+        if _shared_layer is not None:
+            self._layer = _shared_layer
+        else:
+            from paddle_tpu.jit.save_load import load
+            self._layer = load(config.model_dir())
+        meta = self._layer._meta
         n_in = len(self._layer.input_specs)
-        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._input_names = list(
+            meta.get("input_names") or [f"x{i}" for i in range(n_in)])
         self._inputs = {n: _Handle() for n in self._input_names}
         self._outputs: List[np.ndarray] = []
 
@@ -128,8 +147,15 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorPool:
+    """Pool sharing ONE loaded executable + parameter set across
+    predictors (each has its own input/output handles — reference
+    PredictorPool clones the program, shares the weights)."""
+
     def __init__(self, config: Config, size: int = 1):
-        self._predictors = [Predictor(config) for _ in range(size)]
+        first = Predictor(config)
+        self._predictors = [first] + [
+            Predictor(config, _shared_layer=first._layer)
+            for _ in range(size - 1)]
 
     def retrieve(self, idx: int) -> Predictor:
         return self._predictors[idx]

@@ -112,11 +112,20 @@ def save(layer, path: str, input_spec: Optional[List] = None, **configs):
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:
         f.write(exp.serialize())
+    # raw StableHLO for the native predictor (csrc/predictor): PJRT
+    # compiles this text directly, no jax at serving time
+    with open(path + ".pdstablehlo", "w") as f:
+        f.write(exp.mlir_module())
     np.savez(path + ".pdiparams",
              **{n: np.asarray(params[n]) for n in param_names})
+    input_names = []
+    for i, spec in enumerate(input_spec):
+        name = getattr(spec, "name", None)
+        input_names.append(name if name else f"x{i}")
     meta = {
         "format": "stablehlo-jax-export-v1",
         "param_names": param_names,
+        "input_names": input_names,
         "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
                    for s in sds],
         "mlir_preview": exp.mlir_module()[:2000],

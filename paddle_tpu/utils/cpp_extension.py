@@ -45,6 +45,10 @@ def load_native(name: str, build_if_missing: bool = True
             if not build_if_missing:
                 return None
             _build()
+        if not os.path.exists(path):
+            # optional component whose build prerequisites are absent
+            # (e.g. the predictor needs the PJRT C API header)
+            return None
         lib = ctypes.CDLL(path)
         _cache[name] = lib
         return lib
