@@ -393,7 +393,13 @@ struct Predictor {
       bool is_int = !v.empty() &&
           v.find_first_not_of("-0123456789") == std::string::npos;
       if (is_int) {
-        int_store[i] = std::stoll(v);
+        try {
+          int_store[i] = std::stoll(v);
+        } catch (const std::exception&) {
+          set_error("bad integer option value '" + v + "' for key '" +
+                    kv[i].first + "'");
+          return false;
+        }
         nv.type = PJRT_NamedValue_kInt64;
         nv.int64_value = int_store[i];
         nv.value_size = 1;

@@ -88,8 +88,17 @@ class Server {
     ::shutdown(listen_fd_, SHUT_RDWR);
     ::close(listen_fd_);
     if (accept_thread_.joinable()) accept_thread_.join();
-    std::lock_guard<std::mutex> lk(conn_mu_);
-    for (auto& t : conn_threads_)
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      // unblock Serve threads still parked in recv on live client
+      // connections (clients need not have closed their end)
+      for (int fd : conn_fds_)
+        ::shutdown(fd, SHUT_RDWR);
+      to_join.swap(conn_threads_);
+    }
+    // join OUTSIDE the lock: Serve threads take conn_mu_ to deregister
+    for (auto& t : to_join)
       if (t.joinable()) t.join();
   }
 
@@ -104,6 +113,7 @@ class Server {
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(conn_mu_);
+      conn_fds_.push_back(fd);
       conn_threads_.emplace_back([this, fd] { Serve(fd); });
     }
   }
@@ -174,6 +184,17 @@ class Server {
         break;
       }
     }
+    {
+      // drop from conn_fds_ BEFORE closing so stop() can never shutdown a
+      // recycled descriptor number belonging to something else
+      std::lock_guard<std::mutex> lk(conn_mu_);
+      for (auto it = conn_fds_.begin(); it != conn_fds_.end(); ++it) {
+        if (*it == fd) {
+          conn_fds_.erase(it);
+          break;
+        }
+      }
+    }
     ::close(fd);
   }
 
@@ -183,6 +204,7 @@ class Server {
   std::thread accept_thread_;
   std::mutex conn_mu_;
   std::vector<std::thread> conn_threads_;
+  std::vector<int> conn_fds_;
 
   std::mutex mu_;
   std::condition_variable cv_;

@@ -37,6 +37,8 @@ from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
     make_ring_attention, make_ulysses_attention, ring_attention,
     ulysses_attention)
 from paddle_tpu.distributed import checkpoint  # noqa: F401
+from paddle_tpu.distributed.elastic import (  # noqa: F401
+    ElasticAgent, ElasticManager)
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     AutoCheckpoint, Converter, async_save_state_dict, load_state_dict,
     save_state_dict)
@@ -61,4 +63,5 @@ __all__ = [
     "make_ulysses_attention",
     "checkpoint", "save_state_dict", "load_state_dict",
     "async_save_state_dict", "Converter", "AutoCheckpoint",
+    "ElasticAgent", "ElasticManager",
 ]

@@ -1,0 +1,201 @@
+"""Elastic checkpoint-restart orchestration.
+
+Reference parity: ``ElasticManager`` (fleet/elastic/manager.py:124 — etcd
+heartbeat watch + job restart), the launch master/watcher
+(launch/controllers/master.py:65,175, controllers/watcher.py).
+
+TPU-native translation (SURVEY §5.3): TPU pods can't hot-swap a failed
+worker into a live NCCL ring the way parameter-server jobs can — the
+recovery unit is the whole SPMD program.  So elasticity = fast detect +
+relaunch + resume: workers heartbeat into the native TCPStore
+(csrc/store), the manager watches heartbeats and process exits, and on
+any failure it kills the generation, bumps the generation counter, and
+relaunches; workers resume from the latest AutoCheckpoint step.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from paddle_tpu.distributed.tcp_store import TCPStore
+
+__all__ = ["ElasticAgent", "ElasticManager", "free_port"]
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ElasticAgent:
+    """Worker-side heartbeat (reference: elastic/manager.py worker lease).
+
+    Reads PADDLE_ELASTIC_STORE / PADDLE_ELASTIC_GEN / PADDLE_TRAINER_ID
+    from the env the manager sets; a daemon thread refreshes
+    ``hb/<gen>/<rank>`` every ``interval`` seconds.
+    """
+
+    def __init__(self, rank: Optional[int] = None,
+                 store: Optional[TCPStore] = None, interval: float = 0.5):
+        addr = os.environ.get("PADDLE_ELASTIC_STORE")
+        if store is None:
+            if not addr:
+                raise RuntimeError("PADDLE_ELASTIC_STORE not set (worker "
+                                   "not launched by ElasticManager?)")
+            host, port = addr.rsplit(":", 1)
+            store = TCPStore(host, int(port), is_master=False)
+        self._store = store
+        self.rank = rank if rank is not None else \
+            int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.generation = int(os.environ.get("PADDLE_ELASTIC_GEN", "0"))
+        self._key = f"hb/{self.generation}/{self.rank}"
+        self._interval = interval
+        self._stop = threading.Event()
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _beat(self):
+        self._store.set(self._key, repr(time.time()).encode())
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._beat()
+            except Exception:
+                return  # store gone: manager is tearing the generation down
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticManager:
+    """Launcher-side watcher + relaunch loop.
+
+    cmd: worker argv (sys.executable script args...).  Spawns ``nproc``
+    workers per generation with PADDLE_TRAINER_ID / PADDLE_ELASTIC_*
+    env; any non-zero exit or heartbeat staleness fails the generation,
+    which is killed and relaunched up to ``max_restarts`` times.
+    Training scripts resume via AutoCheckpoint.restore_latest().
+    """
+
+    def __init__(self, cmd: Sequence[str], nproc: int = 1,
+                 max_restarts: int = 3, heartbeat_timeout: float = 10.0,
+                 poll_interval: float = 0.2,
+                 env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None):
+        self.cmd = list(cmd)
+        self.nproc = nproc
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout = heartbeat_timeout
+        self.poll_interval = poll_interval
+        self.extra_env = dict(env or {})
+        self.log_dir = log_dir
+        self.restarts = 0
+        self.generation = 0
+        self._port = free_port()
+        self._store = TCPStore("127.0.0.1", self._port, is_master=True)
+
+    # -- generation lifecycle ------------------------------------------------
+    def _spawn(self) -> List[subprocess.Popen]:
+        procs = []
+        self._log_files = []
+        # fresh rendezvous endpoint per generation: survivors of the old
+        # coordinator must not collide with the relaunched group
+        master = f"127.0.0.1:{free_port()}"
+        for rank in range(self.nproc):
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update({
+                # same rendezvous contract as the non-elastic launcher
+                "PADDLE_MASTER": master,
+                "COORDINATOR_ADDRESS": master,
+                "PADDLE_TRAINERS_NUM": str(self.nproc),
+                "NUM_PROCESSES": str(self.nproc),
+                "PADDLE_TRAINER_ID": str(rank),
+                "PROCESS_ID": str(rank),
+                "PADDLE_LOCAL_RANK": str(rank),
+                "PADDLE_ELASTIC_STORE": f"127.0.0.1:{self._port}",
+                "PADDLE_ELASTIC_GEN": str(self.generation),
+                "PADDLE_ELASTIC_RESTARTS": str(self.restarts),
+            })
+            stdout = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(os.path.join(
+                    self.log_dir,
+                    f"workerlog.g{self.generation}.{rank}"), "w")
+                self._log_files.append(stdout)
+            procs.append(subprocess.Popen(
+                self.cmd, env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+        return procs
+
+    def _heartbeats_fresh(self, now: float) -> bool:
+        """False when any rank that has EVER beaten this generation has
+        gone stale (a never-started worker is covered by process polling)."""
+        for rank in range(self.nproc):
+            key = f"hb/{self.generation}/{rank}"
+            if not self._store.check(key):
+                continue
+            last = float(self._store.get(key, wait=False).decode())
+            if now - last > self.heartbeat_timeout:
+                return False
+        return True
+
+    def _watch(self, procs: List[subprocess.Popen]) -> bool:
+        """True when all workers exit 0; False on any failure."""
+        while True:
+            alive = False
+            for p in procs:
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    return False
+            if not alive:
+                return True
+            if not self._heartbeats_fresh(time.time()):
+                return False
+            time.sleep(self.poll_interval)
+
+    def _kill_all(self, procs: List[subprocess.Popen]):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5.0
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def run(self) -> int:
+        """Blocks until the job succeeds (0) or restarts are exhausted (1)."""
+        while True:
+            procs = self._spawn()
+            try:
+                ok = self._watch(procs)
+            finally:
+                self._kill_all(procs)
+                for f in getattr(self, "_log_files", []):
+                    f.close()
+            if ok:
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                return 1
+            self.generation += 1
+
+    def close(self):
+        self._store.close()

@@ -39,6 +39,13 @@ def _parse():
                    help="write per-rank logs to <log_dir>/workerlog.N")
     p.add_argument("--devices", default=None,
                    help="ignored on TPU (chips are slice-assigned); parity")
+    p.add_argument("--elastic", action="store_true",
+                   help="watch heartbeats and relaunch on worker failure "
+                        "(reference fleet/elastic/manager.py role)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="elastic: generations to retry before giving up")
+    p.add_argument("--elastic_timeout", type=float, default=30.0,
+                   help="elastic: heartbeat staleness limit in seconds")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -47,6 +54,17 @@ def _parse():
 def main():
     args = _parse()
     nproc = args.nproc_per_node
+
+    if args.elastic:
+        from paddle_tpu.distributed.elastic import ElasticManager
+        mgr = ElasticManager(
+            [sys.executable, args.script, *args.script_args],
+            nproc=max(1, nproc), max_restarts=args.max_restarts,
+            heartbeat_timeout=args.elastic_timeout, log_dir=args.log_dir)
+        try:
+            sys.exit(mgr.run())
+        finally:
+            mgr.close()
 
     if nproc <= 1 and args.nnodes <= 1:
         # degenerate: exec in place

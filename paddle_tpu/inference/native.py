@@ -123,6 +123,17 @@ class NativePredictor:
         if plugin is None:
             raise RuntimeError(
                 "no PJRT plugin .so found; set PADDLE_TPU_PJRT_PLUGIN")
+        meta_path = model_prefix + ".pdmeta"
+        if os.path.exists(meta_path):
+            import json
+            with open(meta_path) as f:
+                meta = json.load(f)
+            for spec in meta.get("inputs", []):
+                if any(not isinstance(d, int) for d in spec.get("shape", [])):
+                    raise ValueError(
+                        "artifact was saved with dynamic (symbolic) input "
+                        "dims; the native predictor compiles static shapes "
+                        "only — re-save with concrete InputSpec shapes")
         if options is None:
             options = _default_options(plugin)
         self._h = self._lib.pd_predictor_create(

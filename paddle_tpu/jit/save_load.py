@@ -126,7 +126,9 @@ def save(layer, path: str, input_spec: Optional[List] = None, **configs):
         "format": "stablehlo-jax-export-v1",
         "param_names": param_names,
         "input_names": input_names,
-        "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
+        "inputs": [{"shape": [d if isinstance(d, int) else str(d)
+                              for d in s.shape],
+                    "dtype": str(s.dtype)}
                    for s in sds],
         "mlir_preview": exp.mlir_module()[:2000],
     }

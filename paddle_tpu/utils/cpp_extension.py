@@ -47,7 +47,9 @@ def load_native(name: str, build_if_missing: bool = True
             _build()
         if not os.path.exists(path):
             # optional component whose build prerequisites are absent
-            # (e.g. the predictor needs the PJRT C API header)
+            # (e.g. the predictor needs the PJRT C API header); cache the
+            # miss so the make subprocess isn't re-run on every probe
+            _cache[name] = None
             return None
         lib = ctypes.CDLL(path)
         _cache[name] = lib
