@@ -40,6 +40,7 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
+    import os
     if on_tpu:
         # Llama-3-8B-proportioned, scaled to fit one 16G-HBM chip with the
         # full AdamW training state (bf16 params + f32 master + f32 m/v
@@ -49,16 +50,23 @@ def main():
             num_hidden_layers=8, num_attention_heads=16,
             num_key_value_heads=8, max_position_embeddings=4096,
             rope_theta=500000.0, dtype="bfloat16")
+        # measured on v5e: batch 4 / no-remat = 0.51 MFU; remat drops to
+        # 0.39 (recompute tax), batch 8 OOMs even with dots-saveable
         batch, seq, iters, warmup = 4, 2048, 10, 3
     else:  # CI/CPU smoke
         cfg = LlamaConfig.tiny()
         batch, seq, iters, warmup = 4, 64, 3, 1
+    batch = int(os.environ.get("PT_BENCH_BATCH", batch))
+    seq = int(os.environ.get("PT_BENCH_SEQ", seq))
+    remat = os.environ.get("PT_BENCH_REMAT", "0") == "1"
+    remat_policy = os.environ.get("PT_BENCH_REMAT_POLICY") or None
 
     model = LlamaForCausalLM(cfg)
     opt = pp.optimizer.AdamW(learning_rate=1e-4,
                              parameters=model.parameters(),
                              multi_precision=True)
-    step = TrainStep(model, opt, remat=on_tpu)
+    step = TrainStep(model, opt, remat=on_tpu and remat,
+                     remat_policy=remat_policy)
 
     n_params = sum(int(np.prod(a.shape)) for a in step.params.values())
     rng = np.random.default_rng(0)

@@ -390,8 +390,10 @@ struct Predictor {
       nv.name = kv[i].first.c_str();
       nv.name_size = kv[i].first.size();
       const std::string& v = kv[i].second;
-      bool is_int = !v.empty() &&
-          v.find_first_not_of("-0123456789") == std::string::npos;
+      size_t digits_from = (v.size() > 1 && v[0] == '-') ? 1 : 0;
+      bool is_int = v.size() > digits_from &&
+          v.find_first_not_of("0123456789", digits_from) ==
+              std::string::npos;
       if (is_int) {
         try {
           int_store[i] = std::stoll(v);

@@ -140,10 +140,14 @@ class ElasticManager:
                 stderr=subprocess.STDOUT if stdout else None))
         return procs
 
-    def _heartbeats_fresh(self, now: float) -> bool:
-        """False when any rank that has EVER beaten this generation has
-        gone stale (a never-started worker is covered by process polling)."""
+    def _heartbeats_fresh(self, now: float,
+                          procs: List[subprocess.Popen]) -> bool:
+        """False when any STILL-RUNNING rank that has beaten this
+        generation has gone stale (cleanly-exited ranks naturally stop
+        beating; a never-started worker is covered by process polling)."""
         for rank in range(self.nproc):
+            if procs[rank].poll() is not None:
+                continue  # exited; exit-code handling belongs to _watch
             key = f"hb/{self.generation}/{rank}"
             if not self._store.check(key):
                 continue
@@ -164,7 +168,7 @@ class ElasticManager:
                     return False
             if not alive:
                 return True
-            if not self._heartbeats_fresh(time.time()):
+            if not self._heartbeats_fresh(time.time(), procs):
                 return False
             time.sleep(self.poll_interval)
 
@@ -183,8 +187,9 @@ class ElasticManager:
     def run(self) -> int:
         """Blocks until the job succeeds (0) or restarts are exhausted (1)."""
         while True:
-            procs = self._spawn()
+            procs = []
             try:
+                procs = self._spawn()
                 ok = self._watch(procs)
             finally:
                 self._kill_all(procs)

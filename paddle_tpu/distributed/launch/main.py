@@ -56,6 +56,10 @@ def main():
     nproc = args.nproc_per_node
 
     if args.elastic:
+        if args.nnodes > 1 or args.master:
+            sys.exit("--elastic currently orchestrates a single node; "
+                     "run one elastic launcher per host (multi-host "
+                     "rendezvous via --master is not supported with it)")
         from paddle_tpu.distributed.elastic import ElasticManager
         mgr = ElasticManager(
             [sys.executable, args.script, *args.script_args],

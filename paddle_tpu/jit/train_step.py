@@ -53,7 +53,7 @@ class TrainStep:
     def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
                  mesh=None, param_specs: Optional[Dict[str, Any]] = None,
                  batch_spec=None, compute_dtype=None, seed: int = 0,
-                 remat: bool = False):
+                 remat: bool = False, remat_policy: Optional[str] = None):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -67,6 +67,24 @@ class TrainStep:
         self._mask = trainable_mask(model)
         self._key = jax.random.PRNGKey(seed)
         self._remat = remat
+        # named XLA remat policies (SURVEY hard-part: trade FLOPs for HBM);
+        # 'dots' saves matmul outputs and recomputes elementwise — near
+        # no-remat throughput at a fraction of the activation memory
+        if remat_policy is None:
+            self._remat_policy = None
+        else:
+            from jax.ad_checkpoint import checkpoint_policies as cp
+            policies = {
+                "dots": cp.checkpoint_dots,
+                "dots_no_batch": cp.checkpoint_dots_with_no_batch_dims,
+                "nothing": cp.nothing_saveable,
+                "everything": cp.everything_saveable,
+            }
+            if remat_policy not in policies:
+                raise ValueError(
+                    f"unknown remat_policy {remat_policy!r}; "
+                    f"choose from {sorted(policies)}")
+            self._remat_policy = policies[remat_policy]
 
         if mesh is not None and param_specs is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -114,7 +132,7 @@ class TrainStep:
             f = lambda p: _loss_of(model, self.loss_fn, p, batch,
                                    {"dropout": key})
             if self._remat:
-                f = jax.checkpoint(f)
+                f = jax.checkpoint(f, policy=self._remat_policy)
             return f(full)
 
         train_p = {n: v for n, v in params.items() if self._mask.get(n)}
