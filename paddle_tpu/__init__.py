@@ -45,6 +45,7 @@ from paddle_tpu import distributed  # noqa: F401,E402
 from paddle_tpu import distribution  # noqa: F401,E402
 from paddle_tpu import framework  # noqa: F401,E402
 from paddle_tpu import hapi  # noqa: F401,E402
+from paddle_tpu import incubate  # noqa: F401,E402
 from paddle_tpu.hapi import Model  # noqa: F401,E402
 from paddle_tpu import io  # noqa: F401,E402
 from paddle_tpu import jit  # noqa: F401,E402
@@ -52,6 +53,7 @@ from paddle_tpu import metric  # noqa: F401,E402
 from paddle_tpu import nn  # noqa: F401,E402
 from paddle_tpu import optimizer  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
+from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import static  # noqa: F401,E402
 from paddle_tpu import utils  # noqa: F401,E402
 from paddle_tpu import vision  # noqa: F401,E402

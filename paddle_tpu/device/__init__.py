@@ -92,4 +92,69 @@ def current_stream(device=None):
     return Stream(device)
 
 
-cuda = None  # no CUDA in the build, by design (BASELINE.md constraint)
+# -- memory statistics (reference: paddle.device.cuda.memory_allocated /
+# platform/monitor.cc + memory/stats.cc counters).  TPU-native: XLA/PJRT
+# owns allocation; per-device stats surface through Device.memory_stats().
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT allocator counters for one device ({} when the backend
+    does not expose them, e.g. tunneled/experimental platforms)."""
+    devs = jax.devices()
+    idx = 0
+    if isinstance(device, int):
+        idx = device
+    elif isinstance(device, str) and ":" in device:
+        idx = int(device.rsplit(":", 1)[1])
+    stats = devs[idx].memory_stats()
+    return dict(stats) if stats else {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently held by live buffers on the device."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark of live-buffer bytes."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Bytes the allocator has reserved from the device (pool size)."""
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("peak_bytes_in_use", 0)))
+
+
+def device_memory_limit(device=None) -> int:
+    """Total memory the allocator may use (HBM capacity budget)."""
+    return int(memory_stats(device).get("bytes_limit", 0))
+
+
+class _CudaNamespace:
+    """paddle.device.cuda parity veneer over the XLA stats — the reference
+    API names kept so monitoring code ports unchanged (no CUDA exists in
+    this build; numbers are the accelerator's)."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+
+    @staticmethod
+    def empty_cache():
+        pass  # XLA manages its pools; nothing to drop
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+
+cuda = _CudaNamespace()

@@ -1,0 +1,3 @@
+"""paddle.incubate parity namespace."""
+from paddle_tpu.incubate import nn  # noqa: F401
+from paddle_tpu.incubate import autograd  # noqa: F401

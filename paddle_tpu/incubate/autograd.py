@@ -1,0 +1,88 @@
+"""Functional autodiff transforms (parity: python/paddle/incubate/autograd):
+jvp/vjp/jacobian/hessian/vhp over pure functions — thin veneers on jax's
+transforms, unwrapping/wrapping eager Tensors at the boundary."""
+
+from __future__ import annotations
+
+import jax
+
+from paddle_tpu.core.dispatch import unwrap, wrap_like
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "jacobian", "hessian",
+           "vhp"]
+
+
+def _uw(tree):
+    return jax.tree.map(unwrap, tree,
+                        is_leaf=lambda t: hasattr(t, "_data"))
+
+
+def _w(tree):
+    return jax.tree.map(wrap_like, tree)
+
+
+def _fn_raw(func):
+    def f(*args):
+        return _uw(func(*args))
+    return f
+
+
+def jvp(func, xs, v=None):
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    v = v if v is None or isinstance(v, (tuple, list)) else (v,)
+    primals = tuple(_uw(x) for x in xs)
+    tangents = tuple(_uw(t) for t in v) if v is not None else \
+        tuple(jax.numpy.ones_like(p) for p in primals)
+    out, jv = jax.jvp(_fn_raw(func), primals, tangents)
+    return _w(out), _w(jv)
+
+
+def vjp(func, xs, v=None):
+    xs = xs if isinstance(xs, (tuple, list)) else (xs,)
+    primals = tuple(_uw(x) for x in xs)
+    out, pull = jax.vjp(_fn_raw(func), *primals)
+    if v is None:
+        v = jax.tree.map(jax.numpy.ones_like, out)
+    else:
+        v = _uw(v)
+    grads = pull(v)
+    return _w(out), _w(grads)
+
+
+def jacobian(func, xs):
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    primals = tuple(_uw(x) for x in xs_t)
+    jac = jax.jacrev(_fn_raw(func), argnums=tuple(range(len(primals))))(
+        *primals)
+    out = _w(jac)
+    return out if isinstance(xs, (tuple, list)) else out[0]
+
+
+Jacobian = jacobian
+
+
+def hessian(func, xs):
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    primals = tuple(_uw(x) for x in xs_t)
+    hes = jax.hessian(_fn_raw(func), argnums=tuple(range(len(primals))))(
+        *primals)
+    out = _w(hes)
+    return out if isinstance(xs, (tuple, list)) else out[0][0]
+
+
+Hessian = hessian
+
+
+def vhp(func, xs, v=None):
+    """vector-Hessian product: v^T H of a scalar func."""
+    xs_t = xs if isinstance(xs, (tuple, list)) else (xs,)
+    primals = tuple(_uw(x) for x in xs_t)
+    vg = jax.value_and_grad(_fn_raw(func),
+                            argnums=tuple(range(len(primals))))
+    if v is None:
+        v = tuple(jax.numpy.ones_like(p) for p in primals)
+    else:
+        v = tuple(_uw(t) for t in (v if isinstance(v, (tuple, list))
+                                   else (v,)))
+    (out, _), (_, vhp_val) = jax.jvp(vg, primals, v)
+    return _w(out), _w(vhp_val)

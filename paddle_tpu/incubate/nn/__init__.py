@@ -1,0 +1,169 @@
+"""paddle.incubate.nn parity: fused transformer building blocks.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py
+(FusedMultiHeadAttention :272, FusedFeedForward :559,
+FusedTransformerEncoderLayer), fused_linear.py, fused_dropout_add.py.
+On TPU the fusion is the compiler's: these layers express the whole
+block as one traceable region (attention routes to the Pallas flash
+kernel when shapes allow).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.incubate.nn import functional as FF
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedLinear",
+           "FusedDropoutAdd"]
+
+
+class FusedLinear(Layer):
+    """reference incubate/nn/layer/fused_linear.py."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = [out_features, in_features] if transpose_weight else \
+            [in_features, out_features]
+        self.weight = self.create_parameter(shape)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([out_features], is_bias=True)
+
+    def forward(self, x):
+        return FF.fused_linear(x, self.weight, self.bias,
+                               transpose_weight=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """reference incubate/nn/layer/fused_dropout_add.py."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return FF.fused_dropout_add(x, y, p=self.p,
+                                    training=self.training, mode=self.mode)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference fused_transformer.py:272 — pre/post-LN + fused QKV +
+    attention + out-proj + dropout + residual in one layer."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("num_heads must divide embed_dim")
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        h, hd, e = num_heads, self.head_dim, embed_dim
+        self.qkv_weight = self.create_parameter([3, h, hd, e])
+        self.qkv_bias = None if qkv_bias_attr is False else \
+            self.create_parameter([3, h, hd], is_bias=True)
+        self.linear_weight = self.create_parameter([e, e])
+        self.linear_bias = None if linear_bias_attr is False else \
+            self.create_parameter([e], is_bias=True)
+        self.pre_ln_scale = self.create_parameter([e], is_bias=False)
+        self.pre_ln_scale.set_value(np.ones(e, np.float32))
+        self.pre_ln_bias = self.create_parameter([e], is_bias=True)
+        self.ln_scale = self.create_parameter([e], is_bias=False)
+        self.ln_scale.set_value(np.ones(e, np.float32))
+        self.ln_bias = self.create_parameter([e], is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return FF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            num_heads=self.num_heads, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate, attn_mask=attn_mask,
+            training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """reference fused_transformer.py:559."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None \
+            else act_dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward])
+        self.linear1_bias = self.create_parameter([dim_feedforward],
+                                                  is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model])
+        self.linear2_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln1_scale = self.create_parameter([d_model])
+        self.ln1_scale.set_value(np.ones(d_model, np.float32))
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter([d_model])
+        self.ln2_scale.set_value(np.ones(d_model, np.float32))
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, x):
+        return FF.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias, linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation,
+            pre_layer_norm=self.normalize_before, epsilon=self.epsilon,
+            training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference fused_transformer.py FusedTransformerEncoderLayer:
+    FusedMultiHeadAttention + FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate if attn_dropout_rate is None
+            else attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

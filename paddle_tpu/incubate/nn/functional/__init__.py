@@ -1,0 +1,142 @@
+"""Fused functional ops (parity: python/paddle/incubate/nn/functional/).
+
+TPU-native: "fused" means expressed as one jit-traceable expression XLA
+fuses (elementwise epilogues fold into the matmul) or routed to the Pallas
+flash-attention kernel — the reference's hand-written fused CUDA kernels
+(fused_multi_transformer_op.cu, fused_gemm_epilogue) become compiler work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+__all__ = ["fused_matmul_bias", "fused_linear",
+           "fused_multi_head_attention", "fused_feedforward",
+           "fused_dropout_add", "memory_efficient_attention"]
+
+
+@eager_op
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False):
+    """matmul + bias epilogue (reference fused_gemm_epilogue kernel)."""
+    out = jnp.matmul(jnp.swapaxes(x, -1, -2) if transpose_x else x,
+                     jnp.swapaxes(y, -1, -2) if transpose_y else y)
+    return out if bias is None else out + bias
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    return fused_matmul_bias(x, weight, bias, transpose_y=transpose_weight)
+
+
+@eager_op
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
+    """dropout(x) + y in one fused expression
+    (reference incubate/nn/layer/fused_dropout_add.py)."""
+    if not training or p == 0.0:
+        return x + y
+    from paddle_tpu.core import state as _cs
+    keep = jax.random.bernoulli(_cs.next_key(), 1.0 - p, jnp.shape(x))
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0) + y
+    return jnp.where(keep, x, 0.0) + y
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """reference incubate/nn/memory_efficient_attention.py: O(s) memory
+    attention — on TPU this IS the flash/sdpa path (online softmax in the
+    Pallas kernel; XLA-fused reference math otherwise).
+    q/k/v: [batch, seq, heads, head_dim]."""
+    from paddle_tpu.nn.functional.attention import scaled_dot_product_attention
+    return scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_bias,
+        dropout_p=p if training else 0.0, is_causal=False, scale=scale)
+
+
+@eager_op
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *,
+                               qkv_bias=None, linear_bias=None,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None,
+                               pre_layer_norm=False, epsilon=1e-5,
+                               num_heads=None,
+                               dropout_rate=0.0, attn_dropout_rate=0.0,
+                               attn_mask=None, training=True):
+    """One-call transformer attention block (reference
+    incubate/nn/functional/fused_transformer.py fused_multi_head_attention):
+    [pre-LN] -> fused QKV -> SDPA -> out proj -> dropout -> residual
+    [-> post-LN].  qkv_weight: [3, heads, head_dim, embed]."""
+    from paddle_tpu.core.dispatch import unwrap
+    from paddle_tpu.nn import functional as F
+
+    xr = x
+    qkv_w = qkv_weight
+    three, h, hd, e = qkv_w.shape
+    assert three == 3
+    residual = xr
+    if pre_layer_norm:
+        xr = unwrap(F.layer_norm(xr, [e], pre_ln_scale, pre_ln_bias,
+                                 epsilon))
+    qkv = jnp.einsum("bse,thde->bsthd", xr, qkv_w)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [b,s,h,hd]
+    out = unwrap(F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0))
+    # linear_weight: [embed, embed] viewed as [heads, head_dim, embed]
+    out = jnp.einsum("bshd,hde->bse", out, linear_weight.reshape(h, hd, e))
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate and training:
+        from paddle_tpu.core import state as _cs
+        keep = jax.random.bernoulli(_cs.next_key(), 1.0 - dropout_rate,
+                                    out.shape)
+        out = jnp.where(keep, out / (1.0 - dropout_rate), 0.0)
+    out = residual + out
+    if not pre_layer_norm:
+        out = unwrap(F.layer_norm(out, [e], ln_scale, ln_bias, epsilon))
+    return out
+
+
+@eager_op
+def fused_feedforward(x, linear1_weight, linear2_weight, *,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None,
+                      dropout1_rate=0.5, dropout2_rate=0.5,
+                      activation="relu", pre_layer_norm=False,
+                      epsilon=1e-5, training=True):
+    """reference fused_feedforward: [pre-LN] -> linear -> act -> dropout ->
+    linear -> dropout -> residual [-> post-LN]."""
+    from paddle_tpu.core.dispatch import unwrap
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.core import state as _cs
+
+    xr = x
+    e = xr.shape[-1]
+    residual = xr
+    if pre_layer_norm:
+        xr = unwrap(F.layer_norm(xr, [e], ln1_scale, ln1_bias, epsilon))
+    h = jnp.matmul(xr, linear1_weight)
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    act = getattr(F, activation)
+    h = unwrap(act(h))
+    if dropout1_rate and training:
+        keep = jax.random.bernoulli(_cs.next_key(), 1.0 - dropout1_rate,
+                                    h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout1_rate), 0.0)
+    out = jnp.matmul(h, linear2_weight)
+    if linear2_bias is not None:
+        out = out + linear2_bias
+    if dropout2_rate and training:
+        keep = jax.random.bernoulli(_cs.next_key(), 1.0 - dropout2_rate,
+                                    out.shape)
+        out = jnp.where(keep, out / (1.0 - dropout2_rate), 0.0)
+    out = residual + out
+    if not pre_layer_norm:
+        out = unwrap(F.layer_norm(out, [e], ln2_scale, ln2_bias, epsilon))
+    return out

@@ -108,9 +108,62 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
                         causal=False, return_softmax=False, training=True):
-    # variable-length packed attention: fall back to dense with a block mask
-    raise NotImplementedError(
-        "flash_attn_unpadded: use dense attention with attn_mask for now")
+    """Variable-length packed attention (reference:
+    nn/functional/flash_attention.py flash_attn_unpadded — FlashAttention's
+    varlen kernel over cu_seqlens-packed sequences).
+
+    q/k/v: [total_tokens, num_heads, head_dim] with sequences concatenated;
+    cu_seqlens_*: [batch+1] int32 prefix offsets.  TPU-native realisation:
+    segment-id block masking over the packed token axis — XLA fuses the
+    mask into the attention matmuls, and cross-sequence pairs are masked
+    exactly like the reference kernel skips them.  Memory is O(total^2)
+    (dense scores) — fine for packed batches up to a few thousand tokens;
+    larger packs should run the Pallas flash path with segment ids.
+    Causal masking is bottom-right aligned (flash-attn >= 2.1 varlen
+    semantics).  Returns (out, softmax).
+    """
+    tq, h, d = query.shape
+    tk = key.shape[0]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    # segment id of each packed token: seg[i] = #offsets <= i  (tokens past
+    # the last offset land in segment batch+1 == padding, matching nothing)
+    pos_q = jnp.arange(tq)
+    pos_k = jnp.arange(tk)
+    seg_q = jnp.searchsorted(cu_seqlens_q.astype(jnp.int32), pos_q,
+                             side="right")
+    seg_k = jnp.searchsorted(cu_seqlens_k.astype(jnp.int32), pos_k,
+                             side="right")
+    # position within the sequence (for causal masking)
+    start_q = cu_seqlens_q[jnp.clip(seg_q - 1, 0, None)]
+    start_k = cu_seqlens_k[jnp.clip(seg_k - 1, 0, None)]
+    rel_q = pos_q - start_q
+    rel_k = pos_k - start_k
+
+    mask = seg_q[:, None] == seg_k[None, :]
+    if causal:
+        # bottom-right alignment (flash-attn >= 2.1 varlen semantics):
+        # when a sequence has fewer queries than keys (decode with cache),
+        # the last query aligns with the last key.  The shift is per
+        # SEQUENCE, gathered onto each query token via its segment id.
+        seq_len_q = cu_seqlens_q[1:] - cu_seqlens_q[:-1]   # [batch]
+        seq_len_k = cu_seqlens_k[1:] - cu_seqlens_k[:-1]
+        nb = seq_len_q.shape[0]
+        shift = (seq_len_k - seq_len_q)[jnp.clip(seg_q - 1, 0, nb - 1)]
+        mask = mask & ((rel_q + shift)[:, None] >= rel_k[None, :])
+
+    qf = query.astype(jnp.float32) * scale
+    scores = jnp.einsum("qhd,khd->hqk", qf, key.astype(jnp.float32))
+    scores = jnp.where(mask[None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0 and training:
+        from paddle_tpu.core import state as _cs
+        keyr = _cs.next_key()
+        keep = jax.random.bernoulli(keyr, 1.0 - dropout, probs.shape)
+        probs = probs * keep / (1.0 - dropout)
+    out = jnp.einsum("hqk,khd->qhd", probs, value.astype(jnp.float32))
+    out = out.astype(query.dtype)
+    return (out, probs if return_softmax else None)
 
 
 def rotary_freqs(head_dim, max_position, base=10000.0, dtype=jnp.float32):

@@ -22,7 +22,8 @@ import numpy as np
 from paddle_tpu.nn.layer import Layer
 from paddle_tpu.core.dispatch import eager_op, unwrap, wrap_like
 
-__all__ = ["AbsMaxObserver", "MovingAverageAbsMaxObserver", "QuantConfig",
+__all__ = ["AbsMaxObserver", "MovingAverageAbsMaxObserver",
+           "HistogramObserver", "KLObserver", "QuantConfig",
            "PTQ", "QAT", "FakeQuantLinear", "QuantedLinear",
            "quant_dequant", "quantize_weight"]
 
@@ -90,6 +91,96 @@ class AbsMaxObserver:
     def scale(self):
         qmax = 2.0 ** (self.bits - 1) - 1
         return max(self._absmax, 1e-8) / qmax
+
+
+class HistogramObserver(AbsMaxObserver):
+    """reference observers/hist.py: accumulate an |x| histogram over
+    calibration batches; scale from the `percent` quantile of mass."""
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048,
+                 percent: float = 0.9999):
+        super().__init__(quant_bits)
+        self.bins_count = bins_count
+        self.percent = percent
+        self._hist = np.zeros(bins_count, np.float64)
+        self._range = 0.0
+
+    def observe(self, x):
+        arr = np.abs(np.asarray(unwrap(x), np.float32)).ravel()
+        cur_max = float(arr.max()) if arr.size else 0.0
+        if cur_max > self._range:
+            # re-bin the existing histogram into the wider range
+            if self._range > 0.0 and self._hist.sum() > 0:
+                old_edges = np.linspace(0, self._range, self.bins_count + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                new_hist, _ = np.histogram(
+                    centers, bins=self.bins_count, range=(0, cur_max),
+                    weights=self._hist)
+                self._hist = new_hist.astype(np.float64)
+            self._range = cur_max
+        if self._range > 0.0 and arr.size:
+            h, _ = np.histogram(arr, bins=self.bins_count,
+                                range=(0, self._range))
+            self._hist += h
+
+    __call__ = observe
+
+    def _threshold(self):
+        total = self._hist.sum()
+        if total == 0:
+            return 1e-8
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self.percent))
+        idx = min(idx, self.bins_count - 1)
+        return (idx + 1) * self._range / self.bins_count
+
+    def scale(self):
+        qmax = 2.0 ** (self.bits - 1) - 1
+        return max(self._threshold(), 1e-8) / qmax
+
+
+class KLObserver(HistogramObserver):
+    """reference observers/kl.py (TensorRT-style entropy calibration):
+    pick the clip threshold minimising KL(P_clipped || Q_quantized)."""
+
+    def __init__(self, quant_bits: int = 8, bins_count: int = 2048):
+        super().__init__(quant_bits, bins_count=bins_count)
+
+    def _threshold(self):
+        total = self._hist.sum()
+        if total == 0:
+            return 1e-8
+        levels = 2 ** (self.bits - 1)  # 128 for int8
+        hist = self._hist
+        best_kl, best_i = np.inf, self.bins_count
+        for i in range(levels, self.bins_count + 1, 16):
+            p = hist[:i].copy()
+            p[i - 1] += hist[i:].sum()  # clip mass into the last bin
+            p_sum = p.sum()
+            if p_sum == 0:
+                continue
+            # quantize the first i bins down to `levels` buckets, then
+            # expand back, preserving per-bucket mass over nonzero bins
+            chunks = np.array_split(hist[:i], levels)
+            q = np.zeros(i)
+            start = 0
+            for c in chunks:
+                n = len(c)
+                nz = c > 0
+                if nz.any():
+                    q[start:start + n][nz] = c[nz].sum() / nz.sum()
+                start += n
+            q_sum = q.sum()
+            if q_sum == 0:
+                continue
+            pn = p / p_sum
+            qn = q / q_sum
+            mask = pn > 0
+            kl = float(np.sum(pn[mask] * np.log(
+                pn[mask] / np.maximum(qn[mask], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_i = kl, i
+        return best_i * self._range / self.bins_count
 
 
 class MovingAverageAbsMaxObserver(AbsMaxObserver):

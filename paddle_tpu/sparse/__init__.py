@@ -1,0 +1,236 @@
+"""paddle.sparse parity: COO/CSR tensors + sparse ops.
+
+Reference: python/paddle/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor, binary/unary ops, nn.functional) over
+phi/kernels/sparse.  TPU-native: jax.experimental.sparse's BCOO/BCSR are
+the storage + kernel layer (XLA lowers scatter/gather/dot_general);
+wrappers keep the paddle call surface and interop with eager Tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.dispatch import unwrap, wrap_like
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_sparse_coo", "is_sparse_csr",
+           "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "abs", "neg", "cast", "transpose"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference SparseCooTensor); .indices() [ndim,nnz],
+    .values() [nnz], dense conversions."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._m = bcoo
+
+    # -- paddle Tensor surface ------------------------------------------
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def nnz(self):
+        return int(self._m.nse)
+
+    def indices(self):
+        return wrap_like(self._m.indices.T)  # [ndim, nnz] (paddle layout)
+
+    def values(self):
+        return wrap_like(self._m.data)
+
+    def to_dense(self):
+        return wrap_like(self._m.todense())
+
+    def to_sparse_csr(self):
+        m = self._m
+        if len(m.shape) != 2:
+            raise ValueError("to_sparse_csr expects a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(m))
+
+    def coalesce(self):
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference SparseCsrTensor)."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._m = bcsr
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def nnz(self):
+        return int(self._m.nse)
+
+    def crows(self):
+        return wrap_like(self._m.indptr)
+
+    def cols(self):
+        return wrap_like(self._m.indices)
+
+    def values(self):
+        return wrap_like(self._m.data)
+
+    def to_dense(self):
+        return wrap_like(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._m.to_bcoo())
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [sparse_dim, nnz] (paddle layout); values: [nnz, ...]."""
+    idx = np.asarray(unwrap(indices))
+    val = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from paddle_tpu.core.dtypes import to_jax
+        val = val.astype(to_jax(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    m = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(m)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    val = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from paddle_tpu.core.dtypes import to_jax
+        val = val.astype(to_jax(dtype))
+    m = jsparse.BCSR((val, jnp.asarray(unwrap(cols)),
+                      jnp.asarray(unwrap(crows))), shape=tuple(shape))
+    return SparseCsrTensor(m)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+def _coo(x) -> jsparse.BCOO:
+    if isinstance(x, SparseCooTensor):
+        return x._m
+    if isinstance(x, SparseCsrTensor):
+        return x._m.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+# -- ops ---------------------------------------------------------------
+
+def add(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        out = _coo(x) + _coo(y)
+        return SparseCooTensor(out.sum_duplicates())
+    return wrap_like(_coo(x).todense() + unwrap(y))
+
+
+def subtract(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        out = _coo(x) + (-1.0) * _coo(y)
+        return SparseCooTensor(out.sum_duplicates())
+    return wrap_like(_coo(x).todense() - unwrap(y))
+
+
+def multiply(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        # sparse*sparse stays O(nnz) via the BCOO sparse-sparse kernel
+        out = jsparse.bcoo_multiply_sparse(_coo(x), _coo(y))
+        return SparseCooTensor(out)
+    xm = _coo(x)
+    yd = jnp.asarray(unwrap(y))
+    if yd.ndim == 0:
+        return SparseCooTensor(jsparse.BCOO((xm.data * yd, xm.indices),
+                                            shape=xm.shape))
+    vals = xm.data * jnp.broadcast_to(yd, tuple(xm.shape))[
+        tuple(xm.indices.T)]
+    return SparseCooTensor(jsparse.BCOO((vals, xm.indices), shape=xm.shape))
+
+
+def matmul(x, y):
+    """sparse @ dense -> dense (reference sparse.matmul); XLA lowers the
+    BCOO dot_general to gather/segment-sum."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        out = _coo(x) @ jnp.asarray(unwrap(y))
+        return wrap_like(out)
+    return wrap_like(jnp.asarray(unwrap(x)) @ _coo(y).todense())
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) sampled at mask's sparsity (reference masked_matmul)."""
+    xm = jnp.asarray(unwrap(x))
+    ym = jnp.asarray(unwrap(y))
+    mm = _coo(mask)
+    rows = mm.indices[:, 0]
+    cols = mm.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xm[rows, :], ym[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, mm.indices), shape=mm.shape))
+
+
+def _unary(fn, x):
+    m = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(m.data), m.indices),
+                                        shape=m.shape))
+
+
+def relu(x):
+    return _unary(jax.nn.relu, x)
+
+
+def abs(x):
+    return _unary(jnp.abs, x)
+
+
+def neg(x):
+    return _unary(jnp.negative, x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    m = _coo(x)
+    data = m.data
+    idx = m.indices
+    from paddle_tpu.core.dtypes import to_jax
+    if value_dtype is not None:
+        data = data.astype(to_jax(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(to_jax(index_dtype))
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=m.shape))
+
+
+def transpose(x, perm):
+    m = _coo(x)
+    return SparseCooTensor(m.transpose(tuple(perm)))

@@ -2,6 +2,7 @@
 python/paddle/vision/)."""
 
 from paddle_tpu.vision import models  # noqa: F401
+from paddle_tpu.vision import ops  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
 
-__all__ = ["models", "transforms"]
+__all__ = ["models", "ops", "transforms"]

@@ -1,0 +1,160 @@
+"""Detection ops (parity: python/paddle/vision/ops.py — roi_align, nms,
+box_coder helpers over phi detection kernels).
+
+TPU-native: roi_align is expressed as vectorized bilinear gathers (XLA
+fuses the interpolation); nms is an O(n^2) mask + lax.fori_loop greedy
+sweep — static shapes, no dynamic work queues, compiler-schedulable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+__all__ = ["roi_align", "nms", "box_area", "box_iou", "distribute_fpn_proposals"]
+
+
+@eager_op
+def box_area(boxes):
+    """boxes [N,4] xyxy -> [N] areas."""
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def _iou_matrix(boxes1, boxes2):
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = (boxes1[:, 2] - boxes1[:, 0]) * (boxes1[:, 3] - boxes1[:, 1])
+    a2 = (boxes2[:, 2] - boxes2[:, 0]) * (boxes2[:, 3] - boxes2[:, 1])
+    return inter / jnp.maximum(a1[:, None] + a2[None, :] - inter, 1e-10)
+
+
+@eager_op
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N,M] of xyxy boxes."""
+    return _iou_matrix(boxes1, boxes2)
+
+
+@eager_op
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy non-maximum suppression (reference vision/ops.py nms).
+
+    Returns kept indices sorted by descending score.  When category_idxs
+    is given, suppression only applies within a category (batched NMS via
+    the coordinate-offset trick).  Static-shape implementation: an
+    O(n^2) IoU matrix and a fori_loop keep-mask sweep.
+    """
+    n = boxes.shape[0]
+    if scores is None:
+        scores = jnp.arange(n, 0, -1, dtype=jnp.float32)
+    work = boxes
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is always 0
+        # (broadcasting the shift onto all 4 coords preserves geometry);
+        # span covers the FULL coordinate extent so negative coords from
+        # unclipped proposals can never re-overlap
+        span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+        work = boxes + category_idxs.astype(boxes.dtype)[:, None] * span
+
+    order = jnp.argsort(-scores)
+    sorted_boxes = work[order]
+    iou = _iou_matrix(sorted_boxes, sorted_boxes)
+
+    def body(i, keep):
+        # drop i when any higher-scored kept box overlaps it
+        suppressed = jnp.sum(jnp.where(jnp.arange(n) < i,
+                                       (iou[:, i] > iou_threshold) & keep,
+                                       False)) > 0
+        return keep.at[i].set(~suppressed & keep[i])
+
+    keep = jax.lax.fori_loop(1, n, body, jnp.ones(n, bool))
+    kept_sorted = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    kept = jnp.where(kept_sorted >= 0, order[kept_sorted], -1)
+    count = int(jnp.sum(keep)) if not isinstance(keep, jax.core.Tracer) \
+        else None
+    if count is not None:
+        kept = kept[:count]
+        if top_k is not None:
+            kept = kept[:top_k]
+    return kept
+
+
+@eager_op
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoI Align (reference vision/ops.py roi_align / phi roi_align
+    kernel): bilinear-sample each RoI into output_size bins, averaged
+    over sampling points.
+
+    x: [N, C, H, W]; boxes: [R, 4] xyxy in input coords; boxes_num: [N]
+    rois per image (prefix assignment, reference semantics).
+    """
+    if isinstance(output_size, int):
+        out_h = out_w = output_size
+    else:
+        out_h, out_w = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    if sampling_ratio >= 1:
+        ratio = int(sampling_ratio)
+    else:
+        # reference semantics are adaptive ceil(roi_size/output) PER RoI,
+        # which needs dynamic shapes; the static stand-in samples at the
+        # densest rate any full-feature RoI would need (capped for cost)
+        ratio = int(min(8, max(2, -(-H // out_h))))
+
+    # image index of each roi from boxes_num prefix counts
+    prefix = jnp.cumsum(boxes_num)
+    img_idx = jnp.searchsorted(prefix, jnp.arange(R), side="right")
+
+    off = 0.5 if aligned else 0.0
+    x0 = boxes[:, 0] * spatial_scale - off
+    y0 = boxes[:, 1] * spatial_scale - off
+    x1 = boxes[:, 2] * spatial_scale - off
+    y1 = boxes[:, 3] * spatial_scale - off
+    roi_w = x1 - x0
+    roi_h = y1 - y0
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / out_w
+    bin_h = roi_h / out_h
+
+    # sampling grid: [R, out, ratio] per axis
+    iy = (jnp.arange(ratio) + 0.5) / ratio
+    ys = (y0[:, None, None] + (jnp.arange(out_h)[None, :, None]
+          + iy[None, None, :]) * bin_h[:, None, None])  # [R,out_h,ratio]
+    xs = (x0[:, None, None] + (jnp.arange(out_w)[None, :, None]
+          + iy[None, None, :]) * bin_w[:, None, None])  # [R,out_w,ratio]
+
+    def bilinear(feat, yy, xx):
+        """feat [C,H,W]; yy/xx [...]: bilinear values [C, ...]."""
+        yy = jnp.clip(yy, 0.0, H - 1.0)
+        xx = jnp.clip(xx, 0.0, W - 1.0)
+        y_lo = jnp.floor(yy).astype(jnp.int32)
+        x_lo = jnp.floor(xx).astype(jnp.int32)
+        y_hi = jnp.clip(y_lo + 1, 0, H - 1)
+        x_hi = jnp.clip(x_lo + 1, 0, W - 1)
+        ly = yy - y_lo
+        lx = xx - x_lo
+        v00 = feat[:, y_lo, x_lo]
+        v01 = feat[:, y_lo, x_hi]
+        v10 = feat[:, y_hi, x_lo]
+        v11 = feat[:, y_hi, x_hi]
+        return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+                + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+    def one_roi(r):
+        feat = x[img_idx[r]]                       # [C,H,W]
+        yy = ys[r][:, None, :, None]               # [out_h,1,ratio,1]
+        xx = xs[r][None, :, None, :]               # [1,out_w,1,ratio]
+        grid_y = jnp.broadcast_to(yy, (out_h, out_w, ratio, ratio))
+        grid_x = jnp.broadcast_to(xx, (out_h, out_w, ratio, ratio))
+        vals = bilinear(feat, grid_y, grid_x)      # [C,out_h,out_w,r,r]
+        return vals.mean(axis=(-1, -2))            # [C,out_h,out_w]
+
+    return jax.vmap(one_roi)(jnp.arange(R))
