@@ -39,11 +39,13 @@ import paddle_tpu.core.tensor_methods  # noqa: F401,E402
 
 # submodules
 from paddle_tpu import amp  # noqa: F401,E402
+from paddle_tpu import audio  # noqa: F401,E402
 from paddle_tpu import autograd  # noqa: F401,E402
 from paddle_tpu import device  # noqa: F401,E402
 from paddle_tpu import distributed  # noqa: F401,E402
 from paddle_tpu import distribution  # noqa: F401,E402
 from paddle_tpu import framework  # noqa: F401,E402
+from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import hapi  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402
 from paddle_tpu.hapi import Model  # noqa: F401,E402

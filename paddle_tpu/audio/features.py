@@ -1,0 +1,136 @@
+"""paddle.audio.features parity: Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers.
+
+Reference: python/paddle/audio/features/layers.py:24,106,206,309.
+TPU-native: framing is a strided reshape-gather, the FFT is jnp.fft.rfft
+(XLA's native FFT on TPU), everything below is matmuls against
+precomputed filterbank/DCT matrices — MXU food.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op, unwrap, wrap_like
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.audio import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center, pad_mode):
+    if center:
+        pad = frame_length // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    n = x.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]  # [..., n_frames, frame_length]
+
+
+@eager_op
+def _spectrogram_raw(x, window, n_fft, hop_length, power, center,
+                     pad_mode):
+    frames = _frame(x, n_fft, hop_length, center, pad_mode)
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    mag = jnp.abs(spec)
+    out = mag if power == 1.0 else mag ** power
+    return jnp.swapaxes(out, -1, -2)  # [..., freq, time]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude/power spectrogram (reference layers.py:24)."""
+
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        win_length = win_length or n_fft
+        w = unwrap(AF.get_window(window, win_length))
+        if win_length < n_fft:  # centre-pad the window to n_fft
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        self.register_buffer("window", wrap_like(w))
+
+    def forward(self, x):
+        return _spectrogram_raw(x, self.window, self.n_fft,
+                                self.hop_length, self.power, self.center,
+                                self.pad_mode)
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram -> mel filterbank (reference layers.py:106)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                     norm)
+        self.register_buffer("fbank_matrix", fb)
+
+    def forward(self, x):
+        spec = unwrap(self._spectrogram(x))
+        mel = jnp.einsum("mf,...ft->...mt", unwrap(self.fbank_matrix),
+                         spec)
+        return wrap_like(mel) if hasattr(x, "_data") else mel
+
+
+class LogMelSpectrogram(Layer):
+    """reference layers.py:206."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (reference layers.py:309)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length=None, win_length=None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max=None, htk: bool = False,
+                 norm: str = "slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db)
+        self.register_buffer("dct_matrix", AF.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        logmel = unwrap(self._log_melspectrogram(x))
+        out = jnp.einsum("mk,...mt->...kt", unwrap(self.dct_matrix),
+                         logmel)
+        return wrap_like(out) if hasattr(x, "_data") else out

@@ -1,0 +1,145 @@
+"""paddle.audio.functional parity: mel math, filterbanks, windows, dB.
+
+Reference: python/paddle/audio/functional/functional.py (hz_to_mel :22,
+mel_to_hz :78, mel_frequencies :123, fft_frequencies :163,
+compute_fbank_matrix :186, power_to_db :259, create_dct :303) and
+window.py get_window.  TPU-native: plain jnp math; spectrogram framing
+uses XLA's strided gather (conv-free), FFT via jnp.fft.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dispatch import eager_op, unwrap, wrap_like
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hertz -> mel (Slaney by default; htk=True for the HTK formula)."""
+    f = unwrap(freq)
+    scalar = not hasattr(f, "shape") or jnp.ndim(f) == 0
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar else wrap_like(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = unwrap(mel)
+    scalar = not hasattr(m, "shape") or jnp.ndim(m) == 0
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar else wrap_like(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return wrap_like(unwrap(mel_to_hz(wrap_like(mels), htk)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    return wrap_like(jnp.linspace(0, sr / 2, n_fft // 2 + 1,
+                                  dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney", dtype: str = "float32"):
+    """[n_mels, n_fft//2+1] triangular mel filterbank
+    (reference functional.py:186)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = unwrap(fft_frequencies(sr, n_fft))
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mel_pts = unwrap(mel_to_hz(wrap_like(
+        jnp.linspace(lo, hi, n_mels + 2)), htk))
+    fdiff = jnp.diff(mel_pts)
+    ramps = mel_pts[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_pts[2:n_mels + 2] - mel_pts[:n_mels])
+        weights = weights * enorm[:, None]
+    return wrap_like(weights.astype(jnp.float32))
+
+
+@eager_op
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10*log10(S/ref) with floor (reference functional.py:259)."""
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho",
+               dtype: str = "float32"):
+    """[n_mels, n_mfcc] DCT-II basis (reference functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis = basis * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                                  math.sqrt(2.0 / n_mels))
+    else:
+        basis = basis * 2.0
+    return wrap_like(basis.astype(jnp.float32))
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float32"):
+    """Window function by name (reference window.py get_window);
+    periodic (fftbins=True) or symmetric."""
+    M = win_length + 1 if fftbins else win_length
+    n = np.arange(M, dtype=np.float64)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / (M - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * np.pi * n / (M - 1)))
+    elif window in ("rect", "boxcar", "ones"):
+        w = np.ones(M)
+    elif window == "bartlett":
+        w = 1.0 - np.abs(2 * n / (M - 1) - 1.0)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    if fftbins:
+        w = w[:-1]
+    return wrap_like(jnp.asarray(w.astype(np.float32)))

@@ -1,0 +1,132 @@
+"""paddle.geometric parity: graph segment math + message passing.
+
+Reference: python/paddle/geometric/ (math.py segment_* over phi
+segment_pool kernels; message_passing/send_recv.py send_u_recv /
+send_ue_recv / send_uv over graph_send_recv kernels).  TPU-native: all
+of these are jax segment reductions / gathers — XLA lowers them to
+sorted-scatter, fully differentiable and fusible, no custom kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dispatch import eager_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv"]
+
+
+def _num_segments(segment_ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise ValueError(
+            "segment count is data-dependent; pass num_segments=/out_size= "
+            "when calling geometric ops inside jit (the reference's static "
+            "mode requires the same)")
+    # eager path: ids are concrete, match the reference (max id + 1)
+    return int(jnp.max(segment_ids)) + 1 if segment_ids.size else 0
+
+
+@eager_op
+def segment_sum(data, segment_ids, name=None, num_segments=None):
+    """Sum rows of `data` sharing a segment id (reference math.py:23);
+    result has max(id)+1 rows (pass num_segments= inside jit)."""
+    return jax.ops.segment_sum(
+        data, segment_ids,
+        num_segments=_num_segments(segment_ids, num_segments))
+
+
+@eager_op
+def segment_mean(data, segment_ids, name=None, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    total = jax.ops.segment_sum(data, segment_ids, num_segments=n)
+    count = jax.ops.segment_sum(jnp.ones_like(segment_ids,
+                                              dtype=data.dtype),
+                                segment_ids, num_segments=n)
+    shape = (n,) + (1,) * (data.ndim - 1)
+    return total / jnp.maximum(count.reshape(shape), 1)
+
+
+@eager_op
+def segment_min(data, segment_ids, name=None, num_segments=None):
+    return jax.ops.segment_min(
+        data, segment_ids,
+        num_segments=_num_segments(segment_ids, num_segments))
+
+
+@eager_op
+def segment_max(data, segment_ids, name=None, num_segments=None):
+    return jax.ops.segment_max(
+        data, segment_ids,
+        num_segments=_num_segments(segment_ids, num_segments))
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled explicitly
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _reduce(messages, dst_index, reduce_op, n):
+    if reduce_op == "mean":
+        total = jax.ops.segment_sum(messages, dst_index, num_segments=n)
+        count = jax.ops.segment_sum(
+            jnp.ones_like(dst_index, dtype=messages.dtype), dst_index,
+            num_segments=n)
+        shape = (n,) + (1,) * (messages.ndim - 1)
+        return total / jnp.maximum(count.reshape(shape), 1)
+    if reduce_op not in _REDUCERS or _REDUCERS[reduce_op] is None:
+        raise ValueError(f"unknown reduce_op {reduce_op}")
+    out = _REDUCERS[reduce_op](messages, dst_index, num_segments=n)
+    if reduce_op in ("min", "max"):
+        # untouched rows come back +-inf from jax; the reference zeros them
+        touched = jax.ops.segment_sum(jnp.ones_like(dst_index), dst_index,
+                                      num_segments=n) > 0
+        shape = (n,) + (1,) * (messages.ndim - 1)
+        out = jnp.where(touched.reshape(shape), out, 0)
+    return out
+
+
+@eager_op
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] along edges, reduce onto dst
+    (reference send_recv.py:36)."""
+    n = _num_segments(dst_index, out_size) if out_size is not None else \
+        x.shape[0]
+    return _reduce(x[src_index], dst_index, reduce_op, n)
+
+
+def _message(xe, ye, message_op):
+    if message_op in ("add",):
+        return xe + ye
+    if message_op == "sub":
+        return xe - ye
+    if message_op == "mul":
+        return xe * ye
+    if message_op == "div":
+        return xe / ye
+    raise ValueError(f"unknown message_op {message_op}")
+
+
+@eager_op
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y, reduce onto dst
+    (reference send_recv.py:179)."""
+    n = _num_segments(dst_index, out_size) if out_size is not None else \
+        x.shape[0]
+    return _reduce(_message(x[src_index], y, message_op), dst_index,
+                   reduce_op, n)
+
+
+@eager_op
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints: combine x[src] with y[dst]
+    (reference message_passing/send_recv.py send_uv)."""
+    return _message(x[src_index], y[dst_index], message_op)

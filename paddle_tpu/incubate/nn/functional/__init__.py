@@ -35,6 +35,10 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train"):
     """dropout(x) + y in one fused expression
     (reference incubate/nn/layer/fused_dropout_add.py)."""
     if not training or p == 0.0:
+        # downscale_in_infer scales at inference (reference F.dropout
+        # semantics); upscale_in_train is identity here
+        if mode == "downscale_in_infer" and p > 0.0:
+            return x * (1.0 - p) + y
         return x + y
     from paddle_tpu.core import state as _cs
     keep = jax.random.bernoulli(_cs.next_key(), 1.0 - p, jnp.shape(x))

@@ -45,8 +45,13 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     Returns kept indices sorted by descending score.  When category_idxs
     is given, suppression only applies within a category (batched NMS via
-    the coordinate-offset trick).  Static-shape implementation: an
-    O(n^2) IoU matrix and a fori_loop keep-mask sweep.
+    the coordinate-offset trick; ``categories`` is accepted for signature
+    parity and unused).  Static-shape implementation: an O(n^2) IoU
+    matrix and a fori_loop keep-mask sweep.
+
+    Under jit tracing the result is fixed-size: kept indices first, then
+    -1 padding (counts are data-dependent); mask with ``kept >= 0``
+    before gathering.  Eagerly the padding is stripped.
     """
     n = boxes.shape[0]
     if scores is None:
@@ -74,12 +79,11 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     keep = jax.lax.fori_loop(1, n, body, jnp.ones(n, bool))
     kept_sorted = jnp.nonzero(keep, size=n, fill_value=-1)[0]
     kept = jnp.where(kept_sorted >= 0, order[kept_sorted], -1)
-    count = int(jnp.sum(keep)) if not isinstance(keep, jax.core.Tracer) \
-        else None
-    if count is not None:
-        kept = kept[:count]
-        if top_k is not None:
-            kept = kept[:top_k]
+    if top_k is not None:
+        kept = kept[:top_k]  # static slice: valid eagerly and traced
+    if not isinstance(keep, jax.core.Tracer):
+        count = int(jnp.sum(keep))
+        kept = kept[:min(count, top_k) if top_k is not None else count]
     return kept
 
 

@@ -1,0 +1,176 @@
+"""paddle.geometric, paddle.audio, and compiled generation tests.
+
+Oracles: numpy segment reductions, scipy-free closed forms for mel math,
+and full-forward (cache-free) greedy decoding for generate().
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        ids = np.array([0, 0, 1, 1])
+        np.testing.assert_allclose(
+            np.asarray(pp.geometric.segment_sum(jnp.asarray(x),
+                                                jnp.asarray(ids))),
+            np.stack([x[:2].sum(0), x[2:].sum(0)]))
+        np.testing.assert_allclose(
+            np.asarray(pp.geometric.segment_mean(jnp.asarray(x),
+                                                 jnp.asarray(ids))),
+            np.stack([x[:2].mean(0), x[2:].mean(0)]))
+        np.testing.assert_allclose(
+            np.asarray(pp.geometric.segment_max(jnp.asarray(x),
+                                                jnp.asarray(ids))),
+            np.stack([x[:2].max(0), x[2:].max(0)]))
+        np.testing.assert_allclose(
+            np.asarray(pp.geometric.segment_min(jnp.asarray(x),
+                                                jnp.asarray(ids))),
+            np.stack([x[:2].min(0), x[2:].min(0)]))
+
+    def test_send_u_recv(self):
+        x = np.eye(3, dtype=np.float32)
+        src = np.array([0, 1, 2, 2])
+        dst = np.array([1, 0, 0, 1])
+        out = np.asarray(pp.geometric.send_u_recv(
+            jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst), "sum"))
+        want = np.zeros((3, 3), np.float32)
+        for s, d in zip(src, dst):
+            want[d] += x[s]
+        np.testing.assert_allclose(out, want)
+        # mean / max reduce
+        out_m = np.asarray(pp.geometric.send_u_recv(
+            jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst), "mean"))
+        np.testing.assert_allclose(out_m[0], want[0] / 2)
+        out_mx = np.asarray(pp.geometric.send_u_recv(
+            jnp.asarray(x), jnp.asarray(src), jnp.asarray(dst), "max"))
+        assert out_mx[2].sum() == 0  # untouched row zeroed, not -inf
+
+    def test_send_ue_recv_and_uv(self):
+        x = np.arange(6, dtype=np.float32).reshape(3, 2)
+        e = np.ones((4, 2), np.float32)
+        src = np.array([0, 1, 2, 0])
+        dst = np.array([1, 2, 0, 2])
+        out = np.asarray(pp.geometric.send_ue_recv(
+            jnp.asarray(x), jnp.asarray(e), jnp.asarray(src),
+            jnp.asarray(dst), "add", "sum"))
+        want = np.zeros((3, 2), np.float32)
+        for i, (s, d) in enumerate(zip(src, dst)):
+            want[d] += x[s] + e[i]
+        np.testing.assert_allclose(out, want)
+        uv = np.asarray(pp.geometric.send_uv(
+            jnp.asarray(x), jnp.asarray(x), jnp.asarray(src),
+            jnp.asarray(dst), "mul"))
+        np.testing.assert_allclose(uv, x[src] * x[dst])
+
+    def test_grads_flow(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(
+            size=(4, 3)).astype(np.float32))
+        ids = jnp.asarray(np.array([0, 1, 0, 1]))
+        g = jax.grad(lambda v: (pp.geometric.segment_sum(v, ids) ** 2)
+                     .sum())(x)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestAudio:
+    def test_mel_roundtrip_and_monotone(self):
+        AF = pp.audio.functional
+        for htk in (False, True):
+            for hz in (110.0, 440.0, 4000.0):
+                back = AF.mel_to_hz(AF.hz_to_mel(hz, htk), htk)
+                np.testing.assert_allclose(back, hz, rtol=1e-4)
+        freqs = np.asarray(AF.mel_frequencies(10, 0, 8000)._data)
+        assert (np.diff(freqs) > 0).all()
+
+    def test_fbank_properties(self):
+        fb = np.asarray(pp.audio.functional.compute_fbank_matrix(
+            16000, 512, n_mels=26)._data)
+        assert fb.shape == (26, 257)
+        assert (fb >= 0).all()
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_spectrogram_peak(self):
+        sr = 8000
+        t = np.arange(sr, dtype=np.float32) / sr
+        sig = np.sin(2 * np.pi * 1000 * t)[None]
+        spec = pp.audio.features.Spectrogram(n_fft=256, hop_length=128)(
+            pp.to_tensor(sig))
+        mag = np.asarray(spec._data)[0].mean(-1)
+        peak_hz = mag.argmax() * sr / 256
+        assert abs(peak_hz - 1000) < sr / 256  # within one bin
+
+    def test_mfcc_shapes_and_dct_orthonormal(self):
+        mf = pp.audio.features.MFCC(sr=8000, n_mfcc=13, n_fft=256,
+                                    n_mels=26)
+        sig = np.random.default_rng(0).normal(size=(2, 4000)) \
+            .astype(np.float32)
+        out = np.asarray(mf(pp.to_tensor(sig))._data)
+        assert out.shape[0] == 2 and out.shape[1] == 13
+        dct = np.asarray(pp.audio.functional.create_dct(13, 26)._data)
+        gram = dct.T @ dct
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+    def test_power_to_db(self):
+        x = np.array([1.0, 10.0, 100.0], np.float32)
+        db = np.asarray(pp.audio.functional.power_to_db(
+            jnp.asarray(x), top_db=None))
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+class TestGenerate:
+    def _model(self):
+        pp.seed(0)
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        return LlamaForCausalLM(LlamaConfig.tiny())
+
+    def test_greedy_matches_full_forward(self):
+        from paddle_tpu.generation import GenerationConfig
+        model = self._model()
+        prompt = np.array([[1, 5, 9, 3], [2, 7, 4, 8]], np.int32)
+        out = model.generate(prompt, GenerationConfig(max_new_tokens=5))
+        ids = prompt.copy()
+        for _ in range(5):
+            logits = model(pp.to_tensor(ids))
+            nxt = np.asarray(logits._data)[:, -1].argmax(-1) \
+                .astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, ids)
+
+    def test_eos_padding(self):
+        from paddle_tpu.generation import GenerationConfig
+        model = self._model()
+        prompt = np.array([[1, 5]], np.int32)
+        # first greedy token becomes the "eos" -> everything after is pad
+        first = model.generate(prompt,
+                               GenerationConfig(max_new_tokens=1))[0, -1]
+        out = model.generate(prompt, GenerationConfig(
+            max_new_tokens=4, eos_token_id=int(first), pad_token_id=0))
+        assert (out[0, 3:] == 0).all()
+
+    def test_sampling_reproducible_and_varied(self):
+        from paddle_tpu.generation import GenerationConfig
+        model = self._model()
+        prompt = np.array([[1, 5, 9]], np.int32)
+        cfg = GenerationConfig(max_new_tokens=6, do_sample=True,
+                               temperature=1.0, top_p=0.95, seed=7)
+        a = model.generate(prompt, cfg)
+        b = model.generate(prompt, cfg)
+        np.testing.assert_array_equal(a, b)  # same seed, same draw
+        cfg2 = GenerationConfig(max_new_tokens=6, do_sample=True, seed=8)
+        c = model.generate(prompt, cfg2)
+        assert a.shape == c.shape
+
+    def test_top_k_limits_support(self):
+        from paddle_tpu.generation import _sample, GenerationConfig
+        logits = jnp.asarray(
+            np.array([[0., 1., 2., 3., 4.]], np.float32))
+        cfg = GenerationConfig(do_sample=True, top_k=2, temperature=1.0)
+        draws = {int(_sample(logits, cfg, jax.random.PRNGKey(i))[0])
+                 for i in range(30)}
+        assert draws <= {3, 4}
