@@ -40,6 +40,16 @@ def _spectrogram_raw(x, window, n_fft, hop_length, power, center,
     return jnp.swapaxes(out, -1, -2)  # [..., freq, time]
 
 
+@eager_op
+def _apply_filterbank(spec, fbank):
+    return jnp.einsum("mf,...ft->...mt", fbank, spec)
+
+
+@eager_op
+def _apply_dct(logmel, dct):
+    return jnp.einsum("mk,...mt->...kt", dct, logmel)
+
+
 class Spectrogram(Layer):
     """STFT magnitude/power spectrogram (reference layers.py:24)."""
 
@@ -83,10 +93,8 @@ class MelSpectrogram(Layer):
         self.register_buffer("fbank_matrix", fb)
 
     def forward(self, x):
-        spec = unwrap(self._spectrogram(x))
-        mel = jnp.einsum("mf,...ft->...mt", unwrap(self.fbank_matrix),
-                         spec)
-        return wrap_like(mel) if hasattr(x, "_data") else mel
+        # stays on the dispatcher so the eager tape flows end to end
+        return _apply_filterbank(self._spectrogram(x), self.fbank_matrix)
 
 
 class LogMelSpectrogram(Layer):
@@ -130,7 +138,4 @@ class MFCC(Layer):
         self.register_buffer("dct_matrix", AF.create_dct(n_mfcc, n_mels))
 
     def forward(self, x):
-        logmel = unwrap(self._log_melspectrogram(x))
-        out = jnp.einsum("mk,...mt->...kt", unwrap(self.dct_matrix),
-                         logmel)
-        return wrap_like(out) if hasattr(x, "_data") else out
+        return _apply_dct(self._log_melspectrogram(x), self.dct_matrix)

@@ -86,7 +86,28 @@ def generate(model, input_ids, generation_config: Optional[
     max_len = L + cfg.max_new_tokens
     params = params_of(model)
     compute_dtype = next(iter(params.values())).dtype
+
+    # one compiled run per (model, batch/prompt shape, sampling config):
+    # repeated generate() calls at the same shapes reuse the executable
+    cfg_key = (cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
+               cfg.top_k, cfg.top_p, cfg.eos_token_id, cfg.pad_token_id)
+    cache_key = (id(model), B, L, str(compute_dtype), cfg_key)
+    run = _RUN_CACHE.get(cache_key)
+    if run is None:
+        run = _build_run(model, cfg, B, L)
+        _RUN_CACHE[cache_key] = run
+
     caches0 = _empty_caches(model, B, max_len, compute_dtype)
+    key = jax.random.PRNGKey(cfg.seed)
+    return np.asarray(run(params, ids, caches0, key))
+
+
+_RUN_CACHE: dict = {}
+
+
+def _build_run(model, cfg: GenerationConfig, B: int, L: int):
+    from paddle_tpu.core.dispatch import unwrap
+    from paddle_tpu.core.functional import functional_call
 
     def fwd(params, tok, caches, pos):
         out = functional_call(model, params, tok, None, caches, pos)
@@ -96,7 +117,7 @@ def generate(model, input_ids, generation_config: Optional[
             unwrap, new_caches, is_leaf=lambda t: hasattr(t, "_data"))
 
     @jax.jit
-    def run(params, ids, key):
+    def run(params, ids, caches0, key):
         # prefill the whole prompt in one pass
         logits, caches = fwd(params, ids, caches0, 0)
         key, sub = jax.random.split(key)
@@ -124,5 +145,4 @@ def generate(model, input_ids, generation_config: Optional[
             out = next_tok[:, None]
         return jnp.concatenate([ids, out], axis=1)
 
-    key = jax.random.PRNGKey(cfg.seed)
-    return np.asarray(run(params, ids, key))
+    return run

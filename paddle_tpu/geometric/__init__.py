@@ -97,8 +97,7 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
                 name=None):
     """Gather x[src] along edges, reduce onto dst
     (reference send_recv.py:36)."""
-    n = _num_segments(dst_index, out_size) if out_size is not None else \
-        x.shape[0]
+    n = int(out_size) if out_size is not None else x.shape[0]
     return _reduce(x[src_index], dst_index, reduce_op, n)
 
 
@@ -119,8 +118,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
                  reduce_op="sum", out_size=None, name=None):
     """Combine node features x[src] with edge features y, reduce onto dst
     (reference send_recv.py:179)."""
-    n = _num_segments(dst_index, out_size) if out_size is not None else \
-        x.shape[0]
+    n = int(out_size) if out_size is not None else x.shape[0]
     return _reduce(_message(x[src_index], y, message_op), dst_index,
                    reduce_op, n)
 

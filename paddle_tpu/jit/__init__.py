@@ -31,6 +31,11 @@ def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
     from paddle_tpu.nn.layer import Layer
 
     def wrap(target):
+        if not isinstance(target, Layer) and callable(target):
+            # AST capture of data-dependent if/while/for-range (reference
+            # dy2static transformer pipeline) before tracing
+            from paddle_tpu.jit.dy2static import convert_to_static
+            target = convert_to_static(target)
         if isinstance(target, Layer):
             jfn = jax.jit(lambda params, *a, **kw: _raw(
                 functional_call(target, params, *a, **kw)))
@@ -38,22 +43,28 @@ def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
             def call(*a, **kw):
                 a = tuple(_raw(x) for x in a)
                 kw = {k: _raw(v) for k, v in kw.items()}
-                from paddle_tpu.core.dispatch import wrap_like
-                return wrap_like(jfn(params_of(target), *a, **kw))
+                return _wrap_tree(jfn(params_of(target), *a, **kw))
             call.__wrapped__ = target
             return call
-        jfn = jax.jit(lambda *a, **kw: _raw(target(*a, **kw)))
+        jfn = jax.jit(lambda *a, **kw: _raw_tree(target(*a, **kw)))
 
         def call(*a, **kw):
-            from paddle_tpu.core.dispatch import wrap_like
             a = tuple(_raw(x) for x in a)
             kw = {k: _raw(v) for k, v in kw.items()}
-            return wrap_like(jfn(*a, **kw))
+            return _wrap_tree(jfn(*a, **kw))
         call.__wrapped__ = target
         return call
 
     def _raw(x):
         return x._data if hasattr(x, "_data") else x
+
+    def _raw_tree(tree):
+        return jax.tree.map(_raw, tree,
+                            is_leaf=lambda t: hasattr(t, "_data"))
+
+    def _wrap_tree(tree):
+        from paddle_tpu.core.dispatch import wrap_like
+        return jax.tree.map(wrap_like, tree)
 
     if obj is None:
         return wrap

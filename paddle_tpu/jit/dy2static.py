@@ -1,0 +1,377 @@
+"""dy2static: AST capture of data-dependent Python control flow.
+
+Reference parity: python/paddle/jit/dy2static (program_translator.py:305
++ the transformer pipeline: ifelse_transformer, loop_transformer, ...) —
+15k LoC rewriting dygraph Python into static-graph ops.  TPU-native: the
+target isn't a ProgramDesc but jaxpr — ``if``/``while``/``for-range``
+statements become calls to runtime helpers that pick plain Python when
+the condition is concrete (eager) and ``lax.cond`` / ``lax.while_loop``
+when it is traced (inside jit), so ONE source serves both modes.
+
+Supported: If / While / for-over-range with single-name assignments in
+the rewritten blocks.  Unsupported constructs (return/break/continue
+inside converted blocks) raise a clear error at conversion time, like
+the reference's transformer diagnostics.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+
+__all__ = ["convert_to_static", "cond_call", "while_call",
+           "UNDEF", "undef_lookup"]
+
+
+# ---------------------------------------------------------------- runtime
+
+def _is_traced(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _concrete_bool(x):
+    if hasattr(x, "_data"):
+        x = x._data
+    return bool(x)
+
+
+class _Undef:
+    """Sentinel for a name assigned in only one branch and unbound in
+    the other (reference dy2static's UndefinedVar)."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<undefined>"
+
+
+UNDEF = _Undef()
+
+
+def undef_lookup(thunk):
+    """Read a possibly-unbound outer name: its value, or UNDEF."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return UNDEF
+
+
+def cond_call(pred, true_fn, false_fn, operands, needed):
+    """if-statement runtime: python branch when concrete, lax.cond when
+    traced.  Branch fns take the tuple of current values of every name
+    the branches assign (UNDEF where unbound) and return the updated
+    tuple; ``needed[i]`` marks operands whose INCOMING value matters
+    (names not re-assigned by both branches)."""
+    raw = pred._data if hasattr(pred, "_data") else pred
+    if not _is_traced(raw):
+        return true_fn(operands) if _concrete_bool(raw) \
+            else false_fn(operands)
+    fixed = []
+    for v, need in zip(operands, needed):
+        if v is UNDEF:
+            if need:
+                raise TypeError(
+                    "dy2static: a variable assigned in only one branch of "
+                    "a TRACED `if` has no prior definition; initialise it "
+                    "before the if so both branches agree on its type")
+            # both branches overwrite it: a placeholder keeps lax.cond's
+            # operand pytree valid, the incoming value is never used
+            fixed.append(jax.numpy.zeros(()))
+        else:
+            fixed.append(v)
+    try:
+        return jax.lax.cond(raw, true_fn, false_fn, tuple(fixed))
+    except TypeError as e:
+        raise TypeError(
+            "dy2static: the branches of a TRACED `if` must bind the same "
+            "variables with matching shapes/dtypes") from e
+
+
+def while_call(cond_fn, body_fn, carry):
+    """while-statement runtime: carry is the tuple of loop variables."""
+    first = cond_fn(carry)
+    raw = first._data if hasattr(first, "_data") else first
+    if not _is_traced(raw) and not any(
+            _is_traced(v._data if hasattr(v, "_data") else v)
+            for v in jax.tree.leaves(carry)):
+        while _concrete_bool(cond_fn(carry)):
+            carry = body_fn(carry)
+        return carry
+
+    def cond_raw(c):
+        out = cond_fn(c)
+        return out._data if hasattr(out, "_data") else out
+
+    return jax.lax.while_loop(cond_raw, body_fn, carry)
+
+
+# ------------------------------------------------------------ the rewrite
+
+class _Unsupported(NotImplementedError):
+    pass
+
+
+def _assigned_names(nodes):
+    """Simple-Name store targets in a statement list (recursively)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Store) and node.id not in out:
+                out.append(node.id)
+
+        def visit_AugAssign(self, node):
+            if isinstance(node.target, ast.Name) and \
+                    node.target.id not in out:
+                out.append(node.target.id)
+            self.generic_visit(node)
+
+        # nested defs own their scope
+        def visit_FunctionDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _read_names(nodes):
+    out = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load):
+                out.add(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _check_no_flow_escape(nodes, what):
+    class V(ast.NodeVisitor):
+        def visit_Return(self, node):
+            raise _Unsupported(
+                f"dy2static: `return` inside a converted {what} is not "
+                "supported; assign to a variable and return after it")
+
+        def visit_Break(self, node):
+            raise _Unsupported(
+                f"dy2static: `break` inside a converted {what} is not "
+                "supported; fold the exit condition into the loop test")
+
+        def visit_Continue(self, node):
+            raise _Unsupported(
+                f"dy2static: `continue` inside a converted {what} is not "
+                "supported")
+
+        def visit_FunctionDef(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._uid = 0
+
+    def _fresh(self, base):
+        self._uid += 1
+        return f"__jst_{base}_{self._uid}"
+
+    # -- if -> cond_call -----------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        assigned = _assigned_names(node.body + node.orelse)
+        if not assigned:
+            return node  # side-effect-free on locals: keep as-is (eager
+            # semantics; traced conditions without assignment are rare)
+        _check_no_flow_escape(node.body + node.orelse, "if")
+        tname = self._fresh("true")
+        fname = self._fresh("false")
+        t_assigned = set(_assigned_names(node.body))
+        f_assigned = set(_assigned_names(node.orelse))
+        carry_name = self._fresh("ifcarry")
+
+        # branch fns receive the current values of every assigned name as
+        # a tuple (read-then-write names would otherwise hit python's
+        # local-shadowing UnboundLocalError inside the nested function)
+        unpack = ast.Assign(
+            targets=[_names_tuple(assigned, ast.Store)],
+            value=ast.Name(id=carry_name, ctx=ast.Load()))
+        ret = ast.Return(value=_names_tuple(assigned, ast.Load))
+        true_def = ast.FunctionDef(
+            name=tname, args=_onearg(carry_name),
+            body=[unpack] + node.body + [ret], decorator_list=[])
+        false_body = [unpack] + (node.orelse or [ast.Pass()]) + [ret]
+        false_def = ast.FunctionDef(
+            name=fname, args=_onearg(carry_name), body=false_body,
+            decorator_list=[])
+        # operand tuple: outer value of each name, or UNDEF when unbound
+        operands = ast.Tuple(
+            elts=[ast.Call(
+                func=ast.Name(id="__jst_undef_lookup", ctx=ast.Load()),
+                args=[ast.Lambda(args=_noargs(),
+                                 body=ast.Name(id=n, ctx=ast.Load()))],
+                keywords=[]) for n in assigned],
+            ctx=ast.Load())
+        needed = ast.Tuple(
+            elts=[ast.Constant(not (n in t_assigned and n in f_assigned))
+                  for n in assigned],
+            ctx=ast.Load())
+        call = ast.Assign(
+            targets=[_names_tuple(assigned, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__jst_cond_call", ctx=ast.Load()),
+                args=[node.test, ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()), operands,
+                      needed],
+                keywords=[]))
+        out = [true_def, false_def, call]
+        # restore python unbound semantics: a name that came back UNDEF
+        # (one-armed if on the untaken path, nothing outer) is deleted
+        for n in assigned:
+            if n not in t_assigned or n not in f_assigned:
+                out.append(ast.If(
+                    test=ast.Compare(
+                        left=ast.Name(id=n, ctx=ast.Load()),
+                        ops=[ast.Is()],
+                        comparators=[ast.Name(id="__jst_UNDEF",
+                                              ctx=ast.Load())]),
+                    body=[ast.Delete(targets=[
+                        ast.Name(id=n, ctx=ast.Del())])],
+                    orelse=[]))
+        return out
+
+    # -- while -> while_call -------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            raise _Unsupported("dy2static: while/else is not supported")
+        _check_no_flow_escape(node.body, "while")
+        # carry = every var the body assigns (the test reads them through
+        # the carry, not a stale closure)
+        carried = _assigned_names(node.body)
+        if not carried:
+            return node
+        carry_name = self._fresh("carry")
+        unpack = ast.Assign(
+            targets=[_names_tuple(carried, ast.Store)],
+            value=ast.Name(id=carry_name, ctx=ast.Load()))
+        cname = self._fresh("while_cond")
+        bname = self._fresh("while_body")
+        cond_def = ast.FunctionDef(
+            name=cname, args=_onearg(carry_name),
+            body=[unpack, ast.Return(value=node.test)],
+            decorator_list=[])
+        body_def = ast.FunctionDef(
+            name=bname, args=_onearg(carry_name),
+            body=[unpack] + node.body
+            + [ast.Return(value=_names_tuple(carried, ast.Load))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[_names_tuple(carried, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__jst_while_call", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      _names_tuple(carried, ast.Load)],
+                keywords=[]))
+        return [cond_def, body_def, call]
+
+    # -- for i in range(...) -> while ---------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and isinstance(node.target, ast.Name))
+        if not is_range or node.orelse:
+            return node  # non-range iteration stays Python (unrolled
+            # under trace — reference does the same for non-tensor iters)
+        _check_no_flow_escape(node.body, "for")
+        i = node.target.id
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        else:
+            start, stop, step = rargs
+        init = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                          value=start)
+        test = ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
+                           ops=[ast.Lt()], comparators=[stop])
+        incr = ast.AugAssign(target=ast.Name(id=i, ctx=ast.Store()),
+                             op=ast.Add(), value=step)
+        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        ast.copy_location(init, node)
+        ast.copy_location(loop, node)
+        rewritten = self.visit_While(loop)
+        out = [init]
+        out.extend(rewritten if isinstance(rewritten, list) else [rewritten])
+        return out
+
+
+def _noargs():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _onearg(name):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=name)],
+                         vararg=None, kwonlyargs=[], kw_defaults=[],
+                         kwarg=None, defaults=[])
+
+
+def convert_to_static(fn):
+    """AST-rewrite fn's data-dependent control flow (reference
+    StaticFunction's transformer pipeline).  Returns the rewritten
+    function, or fn unchanged when no source is available (lambdas,
+    builtins, C functions)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    # drop decorators (they already ran to produce this call)
+    fdef.decorator_list = []
+    new = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new)
+
+    glb = dict(fn.__globals__)
+    glb["__jst_cond_call"] = cond_call
+    glb["__jst_while_call"] = while_call
+    glb["__jst_undef_lookup"] = undef_lookup
+    glb["__jst_UNDEF"] = UNDEF
+    # snapshot closure cells (the recompiled fn has no closure)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            glb.setdefault(name, cell.cell_contents)
+    code = compile(new, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    ns = {}
+    exec(code, glb, ns)  # noqa: S102 — user's own source, rewritten
+    out = ns[fdef.name]
+    out.__wrapped_original__ = fn
+    return functools.wraps(fn)(out)

@@ -116,6 +116,18 @@ class TestAudio:
         gram = dct.T @ dct
         np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
 
+    def test_mel_pipeline_backprops(self):
+        # the audio front-end must stay on the tape end-to-end
+        mel = pp.audio.features.MelSpectrogram(sr=8000, n_fft=128,
+                                               n_mels=8)
+        sig = pp.to_tensor(np.random.default_rng(0).normal(
+            size=(1, 1000)).astype(np.float32), stop_gradient=False)
+        out = mel(sig)
+        assert not out.stop_gradient
+        out.sum().backward()
+        assert sig.grad is not None
+        assert np.isfinite(np.asarray(sig.grad._data)).all()
+
     def test_power_to_db(self):
         x = np.array([1.0, 10.0, 100.0], np.float32)
         db = np.asarray(pp.audio.functional.power_to_db(
