@@ -88,21 +88,31 @@ def generate(model, input_ids, generation_config: Optional[
     compute_dtype = next(iter(params.values())).dtype
 
     # one compiled run per (model, batch/prompt shape, sampling config):
-    # repeated generate() calls at the same shapes reuse the executable
+    # repeated generate() calls at the same shapes reuse the executable;
+    # keyed on the model WEAKLY so dropping the model frees its
+    # executables, and bounded per model so variable prompt lengths
+    # don't accumulate without limit
     cfg_key = (cfg.max_new_tokens, cfg.do_sample, cfg.temperature,
                cfg.top_k, cfg.top_p, cfg.eos_token_id, cfg.pad_token_id)
-    cache_key = (id(model), B, L, str(compute_dtype), cfg_key)
-    run = _RUN_CACHE.get(cache_key)
+    shape_key = (B, L, str(compute_dtype), cfg_key)
+    per_model = _RUN_CACHE.get(model)
+    if per_model is None:
+        per_model = _RUN_CACHE[model] = {}
+    run = per_model.get(shape_key)
     if run is None:
-        run = _build_run(model, cfg, B, L)
-        _RUN_CACHE[cache_key] = run
+        if len(per_model) >= _RUN_CACHE_MAX_PER_MODEL:
+            per_model.pop(next(iter(per_model)))  # drop oldest
+        run = per_model[shape_key] = _build_run(model, cfg, B, L)
 
     caches0 = _empty_caches(model, B, max_len, compute_dtype)
     key = jax.random.PRNGKey(cfg.seed)
     return np.asarray(run(params, ids, caches0, key))
 
 
-_RUN_CACHE: dict = {}
+import weakref
+
+_RUN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_RUN_CACHE_MAX_PER_MODEL = 16
 
 
 def _build_run(model, cfg: GenerationConfig, B: int, L: int):

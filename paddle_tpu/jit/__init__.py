@@ -37,7 +37,7 @@ def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
             from paddle_tpu.jit.dy2static import convert_to_static
             target = convert_to_static(target)
         if isinstance(target, Layer):
-            jfn = jax.jit(lambda params, *a, **kw: _raw(
+            jfn = jax.jit(lambda params, *a, **kw: _raw_tree(
                 functional_call(target, params, *a, **kw)))
 
             def call(*a, **kw):

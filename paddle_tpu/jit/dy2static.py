@@ -95,8 +95,18 @@ def cond_call(pred, true_fn, false_fn, operands, needed):
             "variables with matching shapes/dtypes") from e
 
 
+def range_cont(i, stop, step):
+    """Continuation test for a rewritten for-range: sign-aware."""
+    import jax.numpy as jnp
+    raw = step._data if hasattr(step, "_data") else step
+    if not _is_traced(raw):
+        return i < stop if _concrete_bool(raw > 0) else i > stop
+    return jnp.where(raw > 0, i < stop, i > stop)
+
+
 def while_call(cond_fn, body_fn, carry):
-    """while-statement runtime: carry is the tuple of loop variables."""
+    """while-statement runtime: carry is the tuple of loop variables
+    (UNDEF entries are body-local temps with no pre-loop value)."""
     first = cond_fn(carry)
     raw = first._data if hasattr(first, "_data") else first
     if not _is_traced(raw) and not any(
@@ -105,6 +115,12 @@ def while_call(cond_fn, body_fn, carry):
         while _concrete_bool(cond_fn(carry)):
             carry = body_fn(carry)
         return carry
+
+    if any(v is UNDEF for v in carry):
+        raise TypeError(
+            "dy2static: a TRACED `while` body introduces a variable with "
+            "no pre-loop value; initialise it before the loop so the "
+            "carry has a stable type")
 
     def cond_raw(c):
         out = cond_fn(c)
@@ -286,13 +302,20 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             body=[unpack] + node.body
             + [ast.Return(value=_names_tuple(carried, ast.Load))],
             decorator_list=[])
+        init_carry = ast.Tuple(
+            elts=[ast.Call(
+                func=ast.Name(id="__jst_undef_lookup", ctx=ast.Load()),
+                args=[ast.Lambda(args=_noargs(),
+                                 body=ast.Name(id=n, ctx=ast.Load()))],
+                keywords=[]) for n in carried],
+            ctx=ast.Load())
         call = ast.Assign(
             targets=[_names_tuple(carried, ast.Store)],
             value=ast.Call(
                 func=ast.Name(id="__jst_while_call", ctx=ast.Load()),
                 args=[ast.Name(id=cname, ctx=ast.Load()),
                       ast.Name(id=bname, ctx=ast.Load()),
-                      _names_tuple(carried, ast.Load)],
+                      init_carry],
                 keywords=[]))
         return [cond_def, body_def, call]
 
@@ -315,17 +338,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             start, stop, step = rargs[0], rargs[1], ast.Constant(1)
         else:
             start, stop, step = rargs
-        init = ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
-                          value=start)
-        test = ast.Compare(left=ast.Name(id=i, ctx=ast.Load()),
-                           ops=[ast.Lt()], comparators=[stop])
+        stop_name = self._fresh("stop")
+        step_name = self._fresh("step")
+        init = [
+            ast.Assign(targets=[ast.Name(id=i, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stop_name, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(targets=[ast.Name(id=step_name, ctx=ast.Store())],
+                       value=step),
+        ]
+        test = ast.Call(
+            func=ast.Name(id="__jst_range_cont", ctx=ast.Load()),
+            args=[ast.Name(id=i, ctx=ast.Load()),
+                  ast.Name(id=stop_name, ctx=ast.Load()),
+                  ast.Name(id=step_name, ctx=ast.Load())],
+            keywords=[])
         incr = ast.AugAssign(target=ast.Name(id=i, ctx=ast.Store()),
-                             op=ast.Add(), value=step)
+                             op=ast.Add(),
+                             value=ast.Name(id=step_name, ctx=ast.Load()))
         loop = ast.While(test=test, body=node.body + [incr], orelse=[])
-        ast.copy_location(init, node)
+        for n in init:
+            ast.copy_location(n, node)
         ast.copy_location(loop, node)
         rewritten = self.visit_While(loop)
-        out = [init]
+        out = list(init)
         out.extend(rewritten if isinstance(rewritten, list) else [rewritten])
         return out
 
@@ -365,10 +402,14 @@ def convert_to_static(fn):
     glb["__jst_while_call"] = while_call
     glb["__jst_undef_lookup"] = undef_lookup
     glb["__jst_UNDEF"] = UNDEF
+    glb["__jst_range_cont"] = range_cont
     # snapshot closure cells (the recompiled fn has no closure)
     if fn.__closure__:
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
-            glb.setdefault(name, cell.cell_contents)
+            try:
+                glb.setdefault(name, cell.cell_contents)
+            except ValueError:
+                pass  # not-yet-filled cell (e.g. the fn's own recursion)
     code = compile(new, filename=f"<dy2static {fn.__name__}>", mode="exec")
     ns = {}
     exec(code, glb, ns)  # noqa: S102 — user's own source, rewritten

@@ -409,3 +409,100 @@ __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")
                 or getattr(_v, "__module__", None) == __name__)]
+
+
+# ---- long-tail structural ops (paddle.tensor manipulation parity) ----------
+
+@eager_op
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+@eager_op
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+@eager_op
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+@eager_op
+def column_stack(x):
+    return jnp.column_stack(x)
+
+
+@eager_op
+def row_stack(x):
+    return jnp.vstack(x)
+
+
+@eager_op
+def hstack(x):
+    return jnp.hstack(x)
+
+
+@eager_op
+def vstack(x):
+    return jnp.vstack(x)
+
+
+@eager_op
+def dstack(x):
+    return jnp.dstack(x)
+
+
+@eager_op
+def hsplit(x, num_or_indices):
+    return tuple(jnp.hsplit(x, num_or_indices))
+
+
+@eager_op
+def vsplit(x, num_or_indices):
+    return tuple(jnp.vsplit(x, num_or_indices))
+
+
+@eager_op
+def dsplit(x, num_or_indices):
+    return tuple(jnp.dsplit(x, num_or_indices))
+
+
+@eager_op
+def tensor_split(x, num_or_indices, axis=0):
+    return tuple(jnp.array_split(x, num_or_indices, axis=axis))
+
+
+@eager_op
+def block_diag(inputs):
+    return jax.scipy.linalg.block_diag(*inputs)
+
+
+@eager_op
+def select_scatter(x, values, axis, index):
+    import builtins  # the module-level paddle `slice` op shadows the builtin
+    idx = [builtins.slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values)
+
+
+@eager_op
+def slice_scatter(x, value, axes, starts, ends, strides=None):
+    import builtins
+    strides = strides or [1] * len(axes)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(s, e, st)
+    return x.at[tuple(idx)].set(value)
+
+
+def rank(x):
+    """Number of dimensions (paddle.rank parity, 0-d int)."""
+    return unwrap(x).ndim
+
+
+# recompute the public surface to include the long-tail block above
+__all__ = [_n for _n, _v in list(globals().items())
+           if not _n.startswith("_") and callable(_v)
+           and (hasattr(_v, "__wrapped_pure__")
+                or getattr(_v, "__module__", None) == __name__)]

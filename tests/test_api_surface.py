@@ -297,3 +297,64 @@ class TestIncubateAutograd:
                                                np.array([1., 0.],
                                                         np.float32)))
         np.testing.assert_allclose(float(jv._data), 3.0, rtol=1e-5)
+
+
+class TestLongTailOps:
+    def test_structural_ops(self):
+        x = pp.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert [tuple(a.shape) for a in pp.hsplit(x, 3)] == [(2, 1)] * 3
+        assert [tuple(a.shape) for a in pp.vsplit(x, 2)] == [(1, 3)] * 2
+        assert tuple(pp.vstack([x, x]).shape) == (4, 3)
+        assert tuple(pp.hstack([x, x]).shape) == (2, 6)
+        assert tuple(pp.dstack([x, x]).shape) == (2, 3, 2)
+        assert tuple(pp.column_stack([x, x]).shape) == (2, 6)
+        parts = pp.tensor_split(x, 2, axis=1)
+        assert tuple(parts[0].shape) == (2, 2)
+        assert tuple(pp.atleast_2d(pp.to_tensor(
+            np.float32(3.0))).shape) == (1, 1)
+        bd = pp.block_diag([np.eye(1, dtype=np.float32),
+                            2 * np.eye(2, dtype=np.float32)])
+        np.testing.assert_allclose(
+            np.asarray(bd), np.diag([1.0, 2.0, 2.0]).astype(np.float32))
+
+    def test_diag_fill_take(self):
+        np.testing.assert_allclose(
+            pp.diag_embed(pp.to_tensor(
+                np.array([1.0, 2.0], np.float32))).numpy(),
+            np.diag([1.0, 2.0]))
+        x = pp.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        fd = pp.fill_diagonal(x, value=9.0).numpy()
+        assert fd[0, 0] == 9.0 and fd[1, 1] == 9.0 and fd[0, 1] == 1.0
+        np.testing.assert_allclose(
+            pp.take(x, pp.to_tensor(np.array([0, 5]))).numpy(), [0.0, 5.0])
+
+    def test_scatter_variants(self):
+        x = pp.to_tensor(np.zeros((4, 3), np.float32))
+        out = pp.select_scatter(x, pp.to_tensor(np.ones(3, np.float32)),
+                                axis=0, index=2)
+        np.testing.assert_allclose(out.numpy()[2], 1.0)
+        out2 = pp.slice_scatter(x, pp.to_tensor(np.full((2, 3), 5.0,
+                                                        np.float32)),
+                                axes=[0], starts=[1], ends=[3])
+        np.testing.assert_allclose(out2.numpy()[1:3], 5.0)
+
+    def test_cdist_matches_scipy_style(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(3, 4)).astype(np.float32)
+        b = rng.normal(size=(5, 4)).astype(np.float32)
+        got = np.asarray(pp.cdist(pp.to_tensor(a), pp.to_tensor(b))._data)
+        want = np.sqrt(((a[:, None] - b[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        got1 = np.asarray(pp.cdist(pp.to_tensor(a), pp.to_tensor(b),
+                                   p=1.0)._data)
+        np.testing.assert_allclose(
+            got1, np.abs(a[:, None] - b[None]).sum(-1), rtol=1e-5)
+
+    def test_vander_trapezoid_sinc(self):
+        v = pp.vander(pp.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)),
+                      n=3)
+        np.testing.assert_allclose(v.numpy(), np.vander([1, 2, 3], 3))
+        y = pp.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(float(pp.trapezoid(y)._data), 4.0)
+        np.testing.assert_allclose(
+            float(pp.sinc(pp.to_tensor(np.float32(0.0)))._data), 1.0)

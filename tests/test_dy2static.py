@@ -197,3 +197,53 @@ class TestNoSourceFallback:
             np.asarray(f(jnp.ones(2, jnp.float32))._data
                        if hasattr(f(jnp.ones(2, jnp.float32)), "_data")
                        else f(jnp.ones(2, jnp.float32))), 2.0)
+
+
+class TestReviewRegressions:
+    def test_negative_step_range(self):
+        @to_static
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n, 0, -1):
+                acc = acc + i
+            return acc
+
+        np.testing.assert_allclose(
+            np.asarray(f(jnp.zeros(1, jnp.float32), 3)), 6.0)
+
+    def test_while_body_local_temp_eager(self):
+        def f(x):
+            while x.sum() > 1.0:
+                t = x * 0.5
+                x = t
+            return x
+
+        g = convert_to_static(f)
+        out = g(pp.to_tensor(np.full(2, 4.0, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 0.5)
+
+    def test_while_body_local_temp_traced_clear_error(self):
+        @to_static
+        def f(x):
+            while x.sum() > 1.0:
+                t = x * 0.5
+                x = t
+            return x
+
+        with pytest.raises(TypeError, match="pre-loop"):
+            f(jnp.full(2, 4.0, jnp.float32))
+
+    def test_layer_tuple_output(self):
+        class TwoOut(pp.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = pp.nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.lin(x)
+                return h, (h * 2).sum()
+
+        m = to_static(TwoOut())
+        out, aux = m(pp.randn([2, 3]))
+        assert tuple(out.shape) == (2, 3)
+        assert np.isfinite(float(aux.numpy()))
