@@ -14,6 +14,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import NamedTuple, Optional
 
+import weakref
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -101,15 +103,16 @@ def generate(model, input_ids, generation_config: Optional[
     run = per_model.get(shape_key)
     if run is None:
         if len(per_model) >= _RUN_CACHE_MAX_PER_MODEL:
-            per_model.pop(next(iter(per_model)))  # drop oldest
-        run = per_model[shape_key] = _build_run(model, cfg, B, L)
+            per_model.pop(next(iter(per_model)))  # evict least recent
+        run = _build_run(model, cfg, B, L)
+    else:
+        per_model.pop(shape_key)  # re-insert so order tracks recency (LRU)
+    per_model[shape_key] = run
 
     caches0 = _empty_caches(model, B, max_len, compute_dtype)
     key = jax.random.PRNGKey(cfg.seed)
     return np.asarray(run(params, ids, caches0, key))
 
-
-import weakref
 
 _RUN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _RUN_CACHE_MAX_PER_MODEL = 16
@@ -119,8 +122,14 @@ def _build_run(model, cfg: GenerationConfig, B: int, L: int):
     from paddle_tpu.core.dispatch import unwrap
     from paddle_tpu.core.functional import functional_call
 
+    # weak reference: the cached closure must not keep the model alive
+    # (the cache is keyed weakly on the model for exactly that reason)
+    model_ref = weakref.ref(model)
+
     def fwd(params, tok, caches, pos):
-        out = functional_call(model, params, tok, None, caches, pos)
+        m = model_ref()
+        assert m is not None, "model was garbage-collected"
+        out = functional_call(m, params, tok, None, caches, pos)
         logits, new_caches = out
         raw = unwrap(logits)
         return raw[:, -1, :].astype(jnp.float32), jax.tree.map(

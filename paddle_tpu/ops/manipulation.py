@@ -403,12 +403,7 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     return jnp.where(in_shard, input - lo, ignore_value)
 
 
-# Public surface: only ops defined in this module (tape-aware wrappers carry
-# __wrapped_pure__; plain helpers must be defined here, not imported).
-__all__ = [_n for _n, _v in list(globals().items())
-           if not _n.startswith("_") and callable(_v)
-           and (hasattr(_v, "__wrapped_pure__")
-                or getattr(_v, "__module__", None) == __name__)]
+# (the public __all__ is computed once at the end of the module)
 
 
 # ---- long-tail structural ops (paddle.tensor manipulation parity) ----------

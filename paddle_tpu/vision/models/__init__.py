@@ -1,6 +1,12 @@
 from paddle_tpu.vision.models.resnet import (  # noqa: F401
     BasicBlock, BottleneckBlock, ResNet, resnet18, resnet34, resnet50,
     resnet101, resnet152)
+from paddle_tpu.vision.models.zoo import (  # noqa: F401
+    AlexNet, LeNet, MobileNetV2, SqueezeNet, VGG, mobilenet_v2,
+    squeezenet1_0, squeezenet1_1, vgg11, vgg13, vgg16, vgg19)
 
 __all__ = ["ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
-           "resnet34", "resnet50", "resnet101", "resnet152"]
+           "resnet34", "resnet50", "resnet101", "resnet152",
+           "AlexNet", "LeNet", "MobileNetV2", "SqueezeNet", "VGG",
+           "mobilenet_v2", "squeezenet1_0", "squeezenet1_1",
+           "vgg11", "vgg13", "vgg16", "vgg19"]

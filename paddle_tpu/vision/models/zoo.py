@@ -1,0 +1,247 @@
+"""Classic CNN zoo: LeNet, AlexNet, VGG, MobileNetV2, SqueezeNet.
+
+Reference parity: python/paddle/vision/models/{lenet,alexnet,vgg,
+mobilenetv2,squeezenet}.py (the architectures are standard; code is an
+independent implementation over paddle_tpu.nn).  All NCHW, bf16-ready;
+convolutions map straight onto the MXU via XLA's conv lowering.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.nn.common_layers import Dropout, Linear, ReLU, Sequential
+from paddle_tpu.nn.conv_layers import Conv2D
+from paddle_tpu.nn.norm_layers import BatchNorm2D
+from paddle_tpu.nn.pooling_layers import (AdaptiveAvgPool2D, AvgPool2D,
+                                          MaxPool2D)
+from paddle_tpu.nn.layer import Layer
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import manipulation as M
+
+__all__ = ["LeNet", "AlexNet", "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "MobileNetV2", "mobilenet_v2", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1"]
+
+
+class LeNet(Layer):
+    """reference vision/models/lenet.py (28x28 inputs)."""
+
+    def __init__(self, num_classes: int = 10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        self.fc = Sequential(
+            Linear(400, 120), Linear(120, 84), Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = M.flatten(x, 1)
+        return self.fc(x)
+
+
+class AlexNet(Layer):
+    """reference vision/models/alexnet.py."""
+
+    def __init__(self, num_classes: int = 1000, dropout: float = 0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(dropout), Linear(256 * 36, 4096), ReLU(),
+            Dropout(dropout), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(M.flatten(x, 1))
+
+
+_VGG_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    """reference vision/models/vgg.py."""
+
+    def __init__(self, features, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        self.classifier = Sequential(
+            Linear(512 * 49, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, 4096), ReLU(), Dropout(0.5),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        return self.classifier(M.flatten(x, 1))
+
+
+def _vgg_features(cfg, batch_norm=False):
+    layers = []
+    cin = 3
+    for v in _VGG_CFGS[cfg]:
+        if v == "M":
+            layers.append(MaxPool2D(2, 2))
+        else:
+            layers.append(Conv2D(cin, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            cin = v
+    return Sequential(*layers)
+
+
+def vgg11(batch_norm=False, **kw):
+    return VGG(_vgg_features("A", batch_norm), **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return VGG(_vgg_features("B", batch_norm), **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return VGG(_vgg_features("D", batch_norm), **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return VGG(_vgg_features("E", batch_norm), **kw)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers += [Conv2D(cin, hidden, 1, bias_attr=False),
+                       BatchNorm2D(hidden), ReLU()]
+        layers += [
+            Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                   groups=hidden, bias_attr=False),
+            BatchNorm2D(hidden), ReLU(),
+            Conv2D(hidden, cout, 1, bias_attr=False), BatchNorm2D(cout)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """reference vision/models/mobilenetv2.py (inverted residuals)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True):
+        super().__init__()
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        cin = max(8, int(32 * scale))
+        features = [Conv2D(3, cin, 3, stride=2, padding=1,
+                           bias_attr=False), BatchNorm2D(cin), ReLU()]
+        for t, c, n, s in cfg:
+            cout = max(8, int(c * scale))
+            for i in range(n):
+                features.append(_InvertedResidual(
+                    cin, cout, s if i == 0 else 1, t))
+                cin = cout
+        self.last_channel = max(1280, int(1280 * scale))
+        features += [Conv2D(cin, self.last_channel, 1, bias_attr=False),
+                     BatchNorm2D(self.last_channel), ReLU()]
+        self.features = Sequential(*features)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        self.classifier = Sequential(Dropout(0.2),
+                                     Linear(self.last_channel,
+                                            num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return self.classifier(M.flatten(x, 1))
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Conv2D(cin, squeeze, 1)
+        self.expand1 = Conv2D(squeeze, e1, 1)
+        self.expand3 = Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        s = F.relu(self.squeeze(x))
+        return M.concat([F.relu(self.expand1(s)),
+                         F.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(Layer):
+    """reference vision/models/squeezenet.py."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000):
+        super().__init__()
+        if version not in ("1.0", "1.1"):
+            raise ValueError(f"unsupported SqueezeNet version {version!r}; "
+                             "expected '1.0' or '1.1'")
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return M.flatten(x, 1)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet("1.1", **kw)

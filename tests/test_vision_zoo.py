@@ -1,0 +1,49 @@
+"""Vision model zoo forward + training smoke (reference:
+python/paddle/vision/models/ — LeNet/AlexNet/VGG/MobileNetV2/SqueezeNet
++ the ResNet family already covered in test_nn.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.vision import models
+
+
+@pytest.mark.parametrize("build,in_shape,classes", [
+    (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28), 10),
+    (lambda: models.mobilenet_v2(scale=0.35, num_classes=7),
+     (1, 3, 64, 64), 7),
+    (lambda: models.squeezenet1_1(num_classes=5), (1, 3, 96, 96), 5),
+    (lambda: models.vgg11(num_classes=4), (1, 3, 224, 224), 4),
+])
+def test_forward_shapes(build, in_shape, classes):
+    pp.seed(0)
+    model = build()
+    out = model(pp.randn(list(in_shape)))
+    assert tuple(out.shape) == (in_shape[0], classes)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_lenet_trains():
+    pp.seed(1)
+    model = models.LeNet(num_classes=4)
+    opt = pp.optimizer.Adam(learning_rate=1e-3,
+                            parameters=model.parameters())
+    x = pp.randn([8, 1, 28, 28])
+    y = pp.to_tensor(np.random.default_rng(0).integers(0, 4, 8))
+    losses = []
+    for _ in range(4):
+        logits = model(x)
+        loss = pp.nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_mobilenet_residual_structure():
+    m = models.MobileNetV2(scale=0.35, num_classes=2)
+    res_blocks = [l for l in m.features
+                  if getattr(l, "use_res", False)]
+    assert len(res_blocks) >= 5  # inverted residuals with identity paths
