@@ -49,6 +49,7 @@ from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import hapi  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402
 from paddle_tpu.hapi import Model  # noqa: F401,E402
+from paddle_tpu.hapi.summary import flops, summary  # noqa: F401,E402
 from paddle_tpu import io  # noqa: F401,E402
 from paddle_tpu import jit  # noqa: F401,E402
 from paddle_tpu import metric  # noqa: F401,E402

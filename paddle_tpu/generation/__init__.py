@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["StaticCache", "GenerationConfig", "generate"]
+__all__ = ["StaticCache", "GenerationConfig", "generate",
+           "static_cache_attention"]
 
 
 class StaticCache(NamedTuple):
@@ -39,6 +40,44 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
     pad_token_id: int = 0
     seed: int = 0
+
+
+def static_cache_attention(q, k, v, cache: StaticCache, position_offset,
+                           attn_mask=None):
+    """Shared static-buffer decode attention (used by Llama and GPT):
+    write k/v at position_offset via dynamic_update_slice, attend over the
+    valid causal prefix of the fixed buffer, honoring a caller mask.
+
+    q/k/v: [b, s, h, hd] current-step projections (paddle layout).
+    Returns (out [b, s, h, hd-flattened by caller], new_cache)."""
+    from paddle_tpu.core.dispatch import unwrap, wrap_like
+    from paddle_tpu.nn.functional.attention import \
+        scaled_dot_product_attention
+
+    s = q.shape[1]
+    kb = jax.lax.dynamic_update_slice(
+        unwrap(cache.k), unwrap(k).astype(cache.k.dtype),
+        (0, position_offset, 0, 0))
+    vb = jax.lax.dynamic_update_slice(
+        unwrap(cache.v), unwrap(v).astype(cache.v.dtype),
+        (0, position_offset, 0, 0))
+    max_len = kb.shape[1]
+    kpos = jnp.arange(max_len)[None, None, None, :]
+    qpos = position_offset + jnp.arange(s)[None, None, :, None]
+    mask = kpos <= qpos  # valid-prefix causal bound over the buffer
+    if attn_mask is not None:
+        if isinstance(attn_mask, int):
+            raise TypeError(
+                "attn_mask got an int — position_offset must be passed by "
+                "keyword (the forward signature gained attn_mask before it)")
+        am = unwrap(attn_mask)
+        if am.dtype == jnp.bool_:
+            mask = mask & am
+        else:  # additive mask: fold the causal bound in
+            mask = jnp.where(mask, am.astype(jnp.float32), -1e30)
+    out = scaled_dot_product_attention(q, wrap_like(kb), wrap_like(vb),
+                                       attn_mask=mask, is_causal=False)
+    return out, StaticCache(wrap_like(kb), wrap_like(vb))
 
 
 def _sample(logits, cfg: GenerationConfig, key):
@@ -111,7 +150,14 @@ def generate(model, input_ids, generation_config: Optional[
 
     caches0 = _empty_caches(model, B, max_len, compute_dtype)
     key = jax.random.PRNGKey(cfg.seed)
-    return np.asarray(run(params, ids, caches0, key))
+    was_training = getattr(model, "training", False)
+    if was_training:
+        model.eval()  # decode is inference: dropout must be off
+    try:
+        return np.asarray(run(params, ids, caches0, key))
+    finally:
+        if was_training:
+            model.train()
 
 
 _RUN_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()

@@ -41,6 +41,10 @@ class GPTConfig:
             self.intermediate_size = 4 * self.hidden_size
 
     @property
+    def num_key_value_heads(self):
+        return self.num_attention_heads  # MHA: kv heads == q heads
+
+    @property
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
 
@@ -71,14 +75,22 @@ class GPTAttention(Layer):
         self.out_proj = Linear(c.hidden_size, c.hidden_size)
         self.dropout_p = c.attention_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, position_offset=0, attn_mask=None):
         b, s = x.shape[0], x.shape[1]
         qkv = M.reshape(self.qkv_proj(x),
                         [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = (M.squeeze(t, axis=2)
                    for t in M.split(qkv, 3, axis=2))
+        if cache is not None:
+            # static-buffer decode path shared with LlamaAttention
+            from paddle_tpu.generation import static_cache_attention
+            out, new_cache = static_cache_attention(
+                q, k, v, cache, position_offset, attn_mask)
+            out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
+            return self.out_proj(out), new_cache
         out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout_p,
+            q, k, v, attn_mask=attn_mask,
+            is_causal=(attn_mask is None), dropout_p=self.dropout_p,
             training=self.training)
         out = M.reshape(out, [b, s, self.num_heads * self.head_dim])
         return self.out_proj(out)
@@ -105,8 +117,14 @@ class GPTDecoderLayer(Layer):
         self.mlp = GPTMLP(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
+    def forward(self, x, cache=None, position_offset=0, attn_mask=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), cache, position_offset,
+                                     attn_mask)
+            x = x + self.dropout(a)
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return x, new_cache
+        x = x + self.dropout(self.attn(self.ln_1(x), None, 0, attn_mask))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return x
 
@@ -129,15 +147,28 @@ class GPTModel(Layer):
         if config.dtype != "float32":
             self.astype(config.dtype)
 
-    def forward(self, input_ids, position_offset: int = 0):
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
         import jax.numpy as jnp
         s = input_ids.shape[1]
-        pos = jnp.arange(position_offset, position_offset + s)
+        pos = position_offset + jnp.arange(s)
         x = self.embed_tokens(input_ids) + self.embed_positions(pos)
         x = self.dropout(x)
-        for layer in self.layers:
-            x = layer(x)
-        return self.ln_f(x)
+        if isinstance(attn_mask, int):
+            raise TypeError(
+                "attn_mask got an int — pass position_offset by keyword "
+                "(the signature gained attn_mask before it)")
+        new_caches = [] if caches is not None else None
+        for i, layer in enumerate(self.layers):
+            if caches is not None:
+                x, c = layer(x, caches[i], position_offset, attn_mask)
+                new_caches.append(c)
+            else:
+                x = layer(x, None, 0, attn_mask)
+        x = self.ln_f(x)
+        if caches is not None:
+            return x, new_caches
+        return x
 
 
 class GPTForCausalLM(Layer):
@@ -151,13 +182,26 @@ class GPTForCausalLM(Layer):
             self.lm_head = Linear(config.hidden_size, config.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids, position_offset: int = 0):
-        h = self.model(input_ids, position_offset)
+    def forward(self, input_ids, attn_mask=None, caches=None,
+                position_offset=0):
+        h = self.model(input_ids, attn_mask, caches, position_offset)
+        new_caches = None
+        if caches is not None:
+            h, new_caches = h
         if self.lm_head is None:
             from paddle_tpu.ops import linalg as L
-            return L.matmul(h, self.model.embed_tokens.weight,
-                            transpose_y=True)
-        return self.lm_head(h)
+            logits = L.matmul(h, self.model.embed_tokens.weight,
+                              transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
+        return logits
+
+    def generate(self, input_ids, generation_config=None, **kwargs):
+        """Compiled KV-cache decoding (paddle_tpu.generation.generate)."""
+        from paddle_tpu.generation import generate as _gen
+        return _gen(self, input_ids, generation_config, **kwargs)
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)

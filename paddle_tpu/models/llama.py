@@ -97,40 +97,17 @@ class LlamaAttention(Layer):
         k = F.apply_rotary_emb(k, rope_cos, rope_sin, position_offset)
         new_cache = None
         if cache is not None:
-            from paddle_tpu.generation import StaticCache
+            from paddle_tpu.generation import (StaticCache,
+                                               static_cache_attention)
             if isinstance(cache, StaticCache):
                 # TPU decode path: fixed-size buffers + dynamic_update_slice
                 # — one compiled step serves every position (the concat path
                 # below grows shapes and recompiles per token)
-                import jax
-                import jax.numpy as jnp
-                from paddle_tpu.core.dispatch import unwrap, wrap_like
-                kb = jax.lax.dynamic_update_slice(
-                    unwrap(cache.k), unwrap(k).astype(cache.k.dtype),
-                    (0, position_offset, 0, 0))
-                vb = jax.lax.dynamic_update_slice(
-                    unwrap(cache.v), unwrap(v).astype(cache.v.dtype),
-                    (0, position_offset, 0, 0))
-                new_cache = StaticCache(wrap_like(kb), wrap_like(vb))
-                # valid-prefix + causal mask over the full buffer
-                max_len = kb.shape[1]
-                kpos = jnp.arange(max_len)[None, None, None, :]
-                qpos = position_offset + jnp.arange(s)[None, None, :, None]
-                mask = kpos <= qpos  # [1,1,s,max_len]
-                if attn_mask is not None:
-                    am = unwrap(attn_mask)
-                    if am.dtype == jnp.bool_:
-                        mask = mask & am
-                    else:  # additive mask: fold the causal bound in
-                        mask = jnp.where(mask, am.astype(jnp.float32),
-                                         -1e30)
-                out = F.scaled_dot_product_attention(
-                    q, wrap_like(kb), wrap_like(vb), attn_mask=mask,
-                    is_causal=False)
+                out, new_cache = static_cache_attention(
+                    q, k, v, cache, position_offset, attn_mask)
                 out = M.reshape(out,
                                 [b, s, self.num_heads * self.head_dim])
-                out = self.o_proj(out)
-                return out, new_cache
+                return self.o_proj(out), new_cache
             pk, pv = cache
             k = M.concat([pk, k], axis=1)
             v = M.concat([pv, v], axis=1)

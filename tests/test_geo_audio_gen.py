@@ -186,3 +186,37 @@ class TestGenerate:
         draws = {int(_sample(logits, cfg, jax.random.PRNGKey(i))[0])
                  for i in range(30)}
         assert draws <= {3, 4}
+
+
+class TestGPTGenerate:
+    def test_gpt_greedy_matches_full_forward(self):
+        pp.seed(0)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        from paddle_tpu.generation import GenerationConfig
+        m = GPTForCausalLM(GPTConfig.tiny())
+        m.eval()  # dropout off: decode must be deterministic
+        prompt = np.array([[1, 5, 9], [2, 4, 6]], np.int32)
+        out = m.generate(prompt, GenerationConfig(max_new_tokens=4))
+        ids = prompt.copy()
+        for _ in range(4):
+            logits = m(pp.to_tensor(ids))
+            nxt = np.asarray(logits._data)[:, -1].argmax(-1) \
+                .astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, ids)
+
+
+class TestSummaryFlops:
+    def test_summary_counts(self):
+        net = pp.nn.Sequential(pp.nn.Linear(16, 32), pp.nn.ReLU(),
+                               pp.nn.Linear(32, 4))
+        info = pp.summary(net)
+        assert info["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+        assert info["trainable_params"] == info["total_params"]
+
+    def test_flops_from_xla_cost(self):
+        net = pp.nn.Sequential(pp.nn.Linear(16, 32), pp.nn.ReLU(),
+                               pp.nn.Linear(32, 4))
+        n = pp.flops(net, [1, 16])
+        # 2*(16*32 + 32*4) matmul flops plus bias/relu epsilon
+        assert 1000 < n < 2500
