@@ -50,7 +50,8 @@ def main():
             num_hidden_layers=8, num_attention_heads=16,
             num_key_value_heads=8, max_position_embeddings=4096,
             rope_theta=500000.0, dtype="bfloat16")
-        # measured on v5e (this model): b4/s2048/no-remat = 0.51 MFU —
+        # measured on v5e (this model): b4/s2048/no-remat + fused
+        # chunked lm-head CE = 0.52 MFU —
         # the shipped default (longest pretraining context that fits with
         # full AdamW state).  Sweep: full remat 0.39 (recompute tax);
         # b5 0.49 (non-pow2 tiling); b2/s4096 0.42; b8/s1024 0.58 (short

@@ -63,10 +63,13 @@ def next_functional_key(stream: str = "dropout"):
 
 
 def functional_call(layer, params_and_buffers: Dict[str, Any], *args,
-                    rngs: Optional[Dict[str, Any]] = None, **kwargs):
+                    rngs: Optional[Dict[str, Any]] = None,
+                    method: Optional[str] = None, **kwargs):
     """Call `layer` with its parameters/buffers replaced by the values in
     `params_and_buffers` (a dict keyed like state_dict(), values raw jax
     arrays or Tensors).  Safe to use inside jax.jit/grad/vmap.
+    ``method`` selects a bound method other than forward/__call__
+    (e.g. a model's ``loss``).
     """
     from paddle_tpu.core.tensor import Tensor
 
@@ -78,8 +81,9 @@ def functional_call(layer, params_and_buffers: Dict[str, Any], *args,
                            f"{type(layer).__name__}")
         v = value._data if isinstance(value, Tensor) else value
         mapping[id(state[name])] = v
+    fn = layer if method is None else getattr(layer, method)
     with substitute(mapping, rngs):
-        return layer(*args, **kwargs)
+        return fn(*args, **kwargs)
 
 
 def params_of(layer, dtype=None):

@@ -25,10 +25,34 @@ from paddle_tpu.core.functional import functional_call, params_of, \
 __all__ = ["TrainStep"]
 
 
+def _has_lm_loss(model) -> bool:
+    """True when model.loss has the LM contract loss(input_ids, labels)
+    — duck-typing on a bare attribute would misroute models whose loss
+    takes a different signature (e.g. DiT's (x, t, y, noise))."""
+    fn = getattr(model, "loss", None)
+    if fn is None or not callable(fn):
+        return False
+    import inspect
+    try:
+        params = [p for p in inspect.signature(fn).parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY,
+                                p.POSITIONAL_OR_KEYWORD)]
+    except (TypeError, ValueError):
+        return False
+    required = [p for p in params if p.default is p.empty]
+    return len(required) == 2
+
+
 def _loss_of(model, loss_fn, params, batch, rngs):
     """batch: dict with 'input_ids'/'labels' (LM) or (x, y) tuple routed to
-    loss_fn(model_out, y)."""
+    loss_fn(model_out, y).  A model exposing .loss(input_ids, labels)
+    owns its objective (e.g. Llama's fused chunked lm-head+CE)."""
     if loss_fn is None:
+        if _has_lm_loss(model):
+            loss = functional_call(
+                model, params, batch["input_ids"], batch["labels"],
+                rngs=rngs, method="loss")
+            return loss._data if hasattr(loss, "_data") else loss
         from paddle_tpu.nn.functional import cross_entropy
         out = functional_call(model, params, batch["input_ids"], rngs=rngs)
         logits = out._data if hasattr(out, "_data") else out

@@ -239,11 +239,16 @@ class LlamaForCausalLM(Layer):
         return _gen(self, input_ids, generation_config, **kwargs)
 
     def loss(self, input_ids, labels):
-        """Next-token cross-entropy (fp32 logits path inside)."""
-        logits = self(input_ids)
-        v = logits.shape[-1]
-        return F.cross_entropy(M.reshape(logits, [-1, v]),
-                               M.reshape(labels, [-1]))
+        """Next-token cross-entropy via the fused chunked lm-head+CE —
+        the [T, V] fp32 logits are never materialized, which is what
+        bounds single-chip batch size (reference role: fused
+        c_softmax_with_cross_entropy)."""
+        h = self.model(input_ids)
+        d = h.shape[-1]
+        w = self.model.embed_tokens.weight.t() if self.lm_head is None \
+            else self.lm_head.weight
+        return F.fused_linear_cross_entropy(
+            M.reshape(h, [-1, d]), w, M.reshape(labels, [-1]))
 
     # -- GSPMD sharding rules -------------------------------------------------
     @staticmethod

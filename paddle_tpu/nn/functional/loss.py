@@ -285,6 +285,131 @@ def square_error_cost(input, label):
     return jnp.square(input - label)
 
 
+# (the public __all__ is computed once at the end of the module)
+
+
+@eager_op
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192,
+                               reduction="mean", ignore_index=-100):
+    """Fused lm-head + softmax cross-entropy over vocab chunks.
+
+    Reference role: the fused softmax-with-cross-entropy kernels
+    (phi/kernels/fusion, fused c_softmax_with_cross_entropy) — the lm-head
+    logits [T, V] are never materialized in fp32: the forward scans vocab
+    chunks with an online logsumexp, the backward recomputes each chunk's
+    probabilities and accumulates dh / dW on the fly (the chunked-CE
+    memory trick; trades one extra lm-head matmul for O(T*V) activation
+    memory, which is what bounds single-chip batch size).
+
+    hidden: [T, d] (flatten batch x seq first); weight: [d, V];
+    labels: [T] int (ignore_index entries contribute no loss/grad).
+    Differentiable wrt hidden and weight.
+    """
+    lbl = jnp.asarray(labels).astype(jnp.int32)
+    mask = lbl != ignore_index
+    safe = jnp.where(mask, lbl, 0)
+    per_tok = _fused_ce(hidden, weight, safe, chunk_size)
+    # zeroing outside the custom_vjp also zeroes the pad cotangents, so
+    # ignored tokens contribute neither loss nor dh/dW
+    per_tok = jnp.where(mask, per_tok, 0.0)
+    if reduction == "mean":
+        return per_tok.sum() / jnp.maximum(mask.sum(), 1)
+    return _reduce(per_tok, reduction)
+
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _fused_ce(h, w, lbl, chunk_size):
+    lse, gold = _fused_ce_scan(h, w, lbl, chunk_size)
+    return lse - gold
+
+
+def _padded_weight(w, chunk_size):
+    """Pad the vocab axis up to a chunk multiple (no relayout — steps
+    dynamic_slice their chunk out; padding columns are masked)."""
+    v = w.shape[1]
+    n = -(-v // chunk_size)
+    pad = n * chunk_size - v
+    wp = w if pad == 0 else jnp.pad(w, ((0, 0), (0, pad)),
+                                    constant_values=0.0)
+    return wp, n
+
+
+def _take_chunk(wp, ci, chunk_size):
+    return jax.lax.dynamic_slice(wp, (0, ci * chunk_size),
+                                 (wp.shape[0], chunk_size))
+
+
+def _fused_ce_scan(h, w, lbl, chunk_size):
+    """Online logsumexp over vocab chunks; also gathers the gold logit."""
+    hf = h.astype(jnp.float32)
+    wp, n = _padded_weight(w, chunk_size)
+    v = w.shape[1]
+
+    def step(carry, ci):
+        m, s, gold = carry
+        wchunk = _take_chunk(wp, ci, chunk_size)
+        logits = hf @ wchunk.astype(jnp.float32)       # [T, c]
+        base = ci * chunk_size
+        col = jnp.arange(chunk_size)[None, :] + base
+        valid = col < v
+        logits = jnp.where(valid, logits, -jnp.inf)
+        cm = jnp.maximum(m, logits.max(axis=1))
+        s = s * jnp.exp(m - cm) + jnp.exp(logits - cm[:, None]).sum(axis=1)
+        local = lbl[:, None] - base
+        hit = (local == jnp.arange(chunk_size)[None, :]) & valid
+        gold = gold + jnp.where(hit, logits, 0.0).sum(axis=1)
+        return (cm, s, gold), None
+
+    t = hf.shape[0]
+    init = (jnp.full((t,), -jnp.inf, jnp.float32),
+            jnp.zeros((t,), jnp.float32), jnp.zeros((t,), jnp.float32))
+    (m, s, gold), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return m + jnp.log(s), gold
+
+
+def _fused_ce_fwd(h, w, lbl, chunk_size):
+    lse, gold = _fused_ce_scan(h, w, lbl, chunk_size)
+    return lse - gold, (h, w, lbl, lse)
+
+
+def _fused_ce_bwd(chunk_size, res, g):
+    h, w, lbl, lse = res
+    hf = h.astype(jnp.float32)
+    wp, n = _padded_weight(w, chunk_size)
+    v = w.shape[1]
+    gf = g.astype(jnp.float32)
+
+    def step(carry, ci):
+        dh, dw = carry
+        wchunk = _take_chunk(wp, ci, chunk_size).astype(jnp.float32)
+        logits = hf @ wchunk                           # [T, c]
+        base = ci * chunk_size
+        col = jnp.arange(chunk_size)[None, :] + base
+        valid = col < v
+        p = jnp.where(valid, jnp.exp(logits - lse[:, None]), 0.0)
+        local = lbl[:, None] - base
+        onehot = ((local == jnp.arange(chunk_size)[None, :]) & valid) \
+            .astype(jnp.float32)
+        delta = (p - onehot) * gf[:, None]             # [T, c]
+        dh = dh + delta @ wchunk.T
+        dw_chunk = hf.T @ delta                        # [d, c]
+        dw = jax.lax.dynamic_update_slice(
+            dw, dw_chunk, (0, ci * chunk_size))
+        return (dh, dw), None
+
+    dh0 = jnp.zeros_like(hf)
+    dw0 = jnp.zeros(wp.shape, jnp.float32)
+    (dh, dw), _ = jax.lax.scan(step, (dh0, dw0), jnp.arange(n))
+    return dh.astype(h.dtype), dw[:, :v].astype(w.dtype), None
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+# recompute the public surface to include the fused loss above
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")

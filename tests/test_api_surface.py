@@ -358,3 +358,88 @@ class TestLongTailOps:
         np.testing.assert_allclose(float(pp.trapezoid(y)._data), 4.0)
         np.testing.assert_allclose(
             float(pp.sinc(pp.to_tensor(np.float32(0.0)))._data), 1.0)
+
+
+class TestFusedLinearCrossEntropy:
+    def test_matches_reference_ce(self):
+        from paddle_tpu.nn.functional.loss import (cross_entropy,
+                                                   fused_linear_cross_entropy)
+        rng = np.random.default_rng(0)
+        T, d, V = 12, 16, 1000
+        h = rng.normal(size=(T, d)).astype(np.float32)
+        w = (rng.normal(size=(d, V)) * 0.1).astype(np.float32)
+        lbl = rng.integers(0, V, T)
+        ref = cross_entropy(jnp.asarray(h) @ jnp.asarray(w),
+                            jnp.asarray(lbl))
+        got = fused_linear_cross_entropy(jnp.asarray(h), jnp.asarray(w),
+                                         lbl, chunk_size=128)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_grads_match_reference(self):
+        from paddle_tpu.nn.functional.loss import (cross_entropy,
+                                                   fused_linear_cross_entropy)
+        rng = np.random.default_rng(1)
+        T, d, V = 8, 12, 300
+        h = jnp.asarray(rng.normal(size=(T, d)).astype(np.float32))
+        w = jnp.asarray((rng.normal(size=(d, V)) * 0.1).astype(np.float32))
+        lbl = rng.integers(0, V, T)
+        gh_r, gw_r = jax.grad(
+            lambda a, b: cross_entropy(a @ b, jnp.asarray(lbl))._data
+            if hasattr(cross_entropy(a @ b, jnp.asarray(lbl)), "_data")
+            else cross_entropy(a @ b, jnp.asarray(lbl)),
+            argnums=(0, 1))(h, w)
+        gh_f, gw_f = jax.grad(
+            lambda a, b: fused_linear_cross_entropy(a, b, lbl,
+                                                    chunk_size=64),
+            argnums=(0, 1))(h, w)
+        np.testing.assert_allclose(np.asarray(gh_f), np.asarray(gh_r),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gw_f), np.asarray(gw_r),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_eager_tape_flows(self):
+        from paddle_tpu.nn.functional.loss import fused_linear_cross_entropy
+        rng = np.random.default_rng(2)
+        h = pp.to_tensor(rng.normal(size=(4, 8)).astype(np.float32),
+                         stop_gradient=False)
+        w = pp.to_tensor((rng.normal(size=(8, 50)) * 0.1)
+                         .astype(np.float32), stop_gradient=False)
+        loss = fused_linear_cross_entropy(h, w, rng.integers(0, 50, 4),
+                                          chunk_size=16)
+        assert not loss.stop_gradient
+        loss.backward()
+        assert h.grad is not None and w.grad is not None
+
+    def test_unreduced_and_sum(self):
+        from paddle_tpu.nn.functional.loss import fused_linear_cross_entropy
+        rng = np.random.default_rng(3)
+        h = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(8, 40)).astype(np.float32))
+        lbl = rng.integers(0, 40, 5)
+        none_r = fused_linear_cross_entropy(h, w, lbl, chunk_size=16,
+                                            reduction="none")
+        assert none_r.shape == (5,)
+        s = fused_linear_cross_entropy(h, w, lbl, chunk_size=16,
+                                       reduction="sum")
+        np.testing.assert_allclose(float(s), float(none_r.sum()),
+                                   rtol=1e-6)
+
+    def test_ignore_index_masks_loss_and_grads(self):
+        from paddle_tpu.nn.functional.loss import (cross_entropy,
+                                                   fused_linear_cross_entropy)
+        rng = np.random.default_rng(4)
+        T, d, V = 6, 8, 60
+        h = jnp.asarray(rng.normal(size=(T, d)).astype(np.float32))
+        w = jnp.asarray((rng.normal(size=(d, V)) * 0.1).astype(np.float32))
+        lbl = rng.integers(0, V, T)
+        lbl[2] = -100
+        lbl[5] = -100
+        ref = cross_entropy(h @ w, jnp.asarray(lbl), ignore_index=-100)
+        got = fused_linear_cross_entropy(h, w, lbl, chunk_size=16)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+        # pad tokens produce zero hidden-state gradient rows
+        gh = jax.grad(lambda a: fused_linear_cross_entropy(
+            a, w, lbl, chunk_size=16))(h)
+        assert float(jnp.abs(gh[2]).sum()) == 0.0
+        assert float(jnp.abs(gh[5]).sum()) == 0.0
+        assert float(jnp.abs(gh[0]).sum()) > 0.0
