@@ -1,0 +1,90 @@
+"""Force the virtual CPU platform as jax's default backend.
+
+Single home of the platform-forcing recipe, shared by tests/conftest.py and
+__graft_entry__.py (the two entry points the driver/test-runner actually
+invokes).  The environment's sitecustomize (PYTHONPATH /root/.axon_site)
+force-sets ``jax.config.update("jax_platforms", "axon,cpu")`` in every
+python process, which the ``JAX_PLATFORMS`` env var alone does NOT override;
+any eager op would then dispatch to the tunneled remote TPU.  "cpu,axon"
+keeps the tunnel visible (real-hardware smoke tests, single-chip bench) but
+makes the virtual CPU mesh the default backend.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def set_env(min_devices: int = 8) -> None:
+    """Set JAX_PLATFORMS / XLA_FLAGS env vars (effective only before the
+    first backend initialization in this process)."""
+    os.environ["JAX_PLATFORMS"] = "cpu,axon"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={min_devices}"
+        ).strip()
+    elif int(m.group(1)) < min_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={min_devices}")
+
+
+def force_cpu_default(min_devices: int = 1) -> None:
+    """Make the virtual CPU platform the default backend, loudly.
+
+    Handles three progressively worse situations:
+    1. fresh process — env vars + config.update suffice;
+    2. sitecustomize already ran config.update — our later update wins as
+       long as backends are not yet initialized;
+    3. backends already initialized on the TPU platform — tear them down
+       (jax.extend.backend.clear_backends) and re-select.
+
+    Raises RuntimeError if the default platform still isn't CPU, or if fewer
+    than ``min_devices`` CPU devices exist (XLA parses
+    --xla_force_host_platform_device_count only at first CPU-client
+    creation, so an in-process fix is impossible at that point — the flag
+    must be exported before the process starts).
+    """
+    set_env(max(min_devices, 8))
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu,axon")
+        jax.devices()  # force platform init; raises if axon is unavailable
+    except Exception:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+            jax.devices()
+        except Exception:
+            pass  # backends already initialized; recovered below
+    if jax.devices()[0].platform != "cpu":
+        # Backends were initialized on the TPU platform before we ran.
+        # Tear them down and re-select; cheap in a fresh driver process
+        # (no compile cache lost) and the only possible recovery.
+        try:
+            import jax.extend
+            jax.extend.backend.clear_backends()
+            jax.config.update("jax_platforms", "cpu,axon")
+            jax.devices()
+        except Exception:
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                jax.devices()
+            except Exception:
+                pass
+    if jax.devices()[0].platform != "cpu":
+        raise RuntimeError(
+            "default jax platform is %r, not 'cpu' — a sitecustomize or "
+            "driver override selected the TPU platform and backends could "
+            "not be re-initialized; set JAX_PLATFORMS=cpu,axon before "
+            "starting python" % jax.devices()[0].platform)
+    n_cpu = len(jax.devices("cpu"))
+    if n_cpu < min_devices:
+        raise RuntimeError(
+            f"only {n_cpu} CPU device(s) but {min_devices} are required; "
+            f"XLA parses --xla_force_host_platform_device_count once, at "
+            f"first CPU-client creation, so it must be in XLA_FLAGS before "
+            f"this process starts (export XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={min_devices})")

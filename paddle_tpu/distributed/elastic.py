@@ -146,10 +146,13 @@ class ElasticManager:
         generation has gone stale (cleanly-exited ranks naturally stop
         beating; a never-started worker is covered by process polling)."""
         for rank in range(self.nproc):
+            key = f"hb/{self.generation}/{rank}"
+            has_beat = self._store.check(key)
+            if has_beat:
+                self._gen_hb_seen = True  # even for already-exited ranks
             if procs[rank].poll() is not None:
                 continue  # exited; exit-code handling belongs to _watch
-            key = f"hb/{self.generation}/{rank}"
-            if not self._store.check(key):
+            if not has_beat:
                 continue
             last = float(self._store.get(key, wait=False).decode())
             if now - last > self.heartbeat_timeout:
@@ -185,8 +188,18 @@ class ElasticManager:
                 p.wait()
 
     def run(self) -> int:
-        """Blocks until the job succeeds (0) or restarts are exhausted (1)."""
+        """Blocks until the job succeeds (0) or restarts are exhausted (1).
+
+        A generation that dies fast without EVER heartbeating is treated as
+        an infrastructure failure (typically the free_port() TOCTOU: the
+        rendezvous port probed free gets re-allocated before the worker
+        binds) and is relaunched on a fresh port WITHOUT consuming a
+        restart — bounded by its own small cap so a genuinely
+        insta-crashing workload still terminates."""
+        infra_retries = 0
         while True:
+            self._gen_hb_seen = False
+            started = time.time()
             procs = []
             try:
                 procs = self._spawn()
@@ -197,6 +210,18 @@ class ElasticManager:
                     f.close()
             if ok:
                 return 0
+            # final sweep: the generation may have died between heartbeat
+            # polls — an hb key in the store means workers DID come up
+            self._gen_hb_seen = self._gen_hb_seen or any(
+                self._store.check(f"hb/{self.generation}/{r}")
+                for r in range(self.nproc))
+            fast_infra_fail = (not self._gen_hb_seen
+                               and time.time() - started
+                               < min(self.heartbeat_timeout, 10.0))
+            if fast_infra_fail and infra_retries < 3:
+                infra_retries += 1  # global cap: never re-arms
+                self.generation += 1
+                continue
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 return 1

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from paddle_tpu.jit.train_step import CompiledStepBase as _TrainStepBase
 from paddle_tpu.nn.layer import Layer
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
@@ -367,7 +368,8 @@ def _pvary_axes(x, axes):
 def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                   stage_params: Any, mb_inputs, mb_labels, *,
                   num_microbatches: int, axis_name: str = "pp",
-                  remat: bool = True):
+                  remat: bool = True, first_params: Any = None,
+                  last_params: Any = None, stage_grad_reduce=None):
     """Fused forward+backward 1F1B pipeline step INSIDE a shard_map.
 
     The reference hand-schedules 1F1B across NCCL ranks
@@ -383,25 +385,42 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
     Args:
       stage_fn:  (params, x[mb, ...]) -> y[mb, ...] — the stage's block
         stack; boundary shape-preserving.
-      first_fn:  (params, raw_mb) -> x — input embedding, applied only on
-        stage 0 (raw microbatch may be int ids; its params live in stage
-        0's param slice).
-      last_fn:   (params, y, labels_mb) -> scalar loss — head + loss,
-        applied only on the last stage.
+      first_fn:  (first_params-or-stage_params, raw_mb) -> x — input
+        embedding, applied only on stage 0 (raw microbatch may be int ids).
+      last_fn:   (last_params-or-stage_params, y, labels_mb) -> scalar
+        loss — head + loss, applied only on the last stage.
       stage_params: this device's stage param slice (shard_map already
-        split the stacked [S, ...] axis).  To keep SPMD homogeneous, every
-        stage's slice has the same structure — embed/head slots exist on
-        every stage and are zeros except where used.
+        split the stacked [S, ...] axis).
+      first_params / last_params: OPTIONAL separate param trees for the
+        embedding / head.  When given, first_fn/last_fn receive them
+        instead of stage_params, so stage slices stay structurally
+        homogeneous WITHOUT zero-replicated embed/head slots — the
+        embed/head arrays live once (replicated or fsdp/tp-sharded by the
+        caller), not stacked S-fold.  Their grads come back as separate
+        trees, psum'd over the pp axis (stage 0 / stage S-1 own the only
+        nonzero contributions).  When None, the old contract holds:
+        first_fn/last_fn read from stage_params and their grads fold into
+        the stage grads.  (Reference analog: pp_layers.py:92 segmentation
+        where stage 0's partition simply owns the embedding layer.)
       mb_inputs: [M, mb, ...] raw microbatch inputs (replicated on pp).
       mb_labels: [M, mb, ...] labels (replicated on pp).
 
-    Returns (mean_loss, stage_param_grads) — loss is valid on the last
-    stage (psum'd over pp so every stage sees it), grads are per-stage.
+    Returns ``(mean_loss, stage_param_grads)`` without param groups, or
+    ``(mean_loss, (stage_grads, first_grads, last_grads))`` when
+    first_params/last_params are given (None entries where not given) —
+    loss is valid on the last stage (psum'd over pp so every stage sees
+    it), stage grads are per-stage.
     """
     S = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = num_microbatches
     from paddle_tpu.distributed.communication import pvary
+
+    has_first = first_params is not None
+    has_last = last_params is not None
+    has_groups = has_first or has_last
+    fparams = first_params if has_first else stage_params
+    lparams = last_params if has_last else stage_params
 
     op_np, mb_np = build_1f1b_schedule(S, M)
     op_table = jnp.asarray(op_np)    # [T, S]
@@ -410,21 +429,43 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    # probe boundary shape
+    # probe boundary shape; the embed→block seam may change dtype (e.g.
+    # fp32 embedding into a bf16 block stack) — the block output fixes the
+    # wire type and the seam casts into it
     x0 = jax.eval_shape(
-        first_fn, stage_params,
+        first_fn, fparams,
         jax.ShapeDtypeStruct(mb_inputs.shape[1:], mb_inputs.dtype))
     y0 = jax.eval_shape(fn, stage_params, x0)
-    if (y0.shape, y0.dtype) != (x0.shape, x0.dtype):
-        raise ValueError(f"stage must preserve boundary: {x0} -> {y0}")
+    if y0.shape != x0.shape:
+        raise ValueError(f"stage must preserve boundary shape: {x0} -> {y0}")
     bshape, bdtype = y0.shape, y0.dtype
+    if y0.dtype != x0.dtype:
+        y1 = jax.eval_shape(fn, stage_params,
+                            jax.ShapeDtypeStruct(bshape, bdtype))
+        if (y1.shape, y1.dtype) != (bshape, bdtype):
+            raise ValueError(
+                f"stage must be closed over the wire type {bdtype}: "
+                f"{bshape}/{bdtype} -> {y1.shape}/{y1.dtype}")
 
     zeros_b = lambda: jnp.zeros(bshape, bdtype)
-    grad_zero = jax.tree.map(
+    promote = lambda tree: jax.tree.map(
         lambda a: jnp.zeros(a.shape, jnp.promote_types(a.dtype, jnp.float32)
                             if jnp.issubdtype(a.dtype, jnp.floating)
                             else a.dtype),
-        stage_params)
+        tree)
+    # stage_grad_reduce: optional per-tick reduction of the stage-grad
+    # contribution (e.g. reduce-scatter over a ZeRO axis).  Applying it
+    # INSIDE the tick keeps the grad accumulator at the reduced (sharded)
+    # size instead of the full gathered size — at 70B scale the fp32 grad
+    # carry would otherwise dominate HBM.  It must be linear (it is summed
+    # across ticks) and uniform within every group of devices that share a
+    # pp index (it runs inside the op-switch, whose branch choice varies
+    # only over pp).
+    grad_zero = promote(stage_params)
+    if stage_grad_reduce is not None:
+        grad_zero = stage_grad_reduce(grad_zero)
+    fgrad_zero = promote(fparams) if has_first else None
+    lgrad_zero = promote(lparams) if has_last else None
 
     inv_m = 1.0 / M
 
@@ -452,8 +493,11 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
         return lax.dynamic_update_index_in_dim(
             buf, jnp.where(valid, payload, cur), slot, 0)
 
+    zero_tree = lambda z: jax.tree.map(lambda g: jnp.zeros_like(g), z)
+
     def tick(carry, t):
-        fwd_wire, bwd_wire, in_buf, cot_buf, grads, loss_acc = carry
+        (fwd_wire, bwd_wire, in_buf, cot_buf, grads, fgrads, lgrads,
+         loss_acc) = carry
         op = op_table[t, idx]
         m = mb_table[t, idx]
 
@@ -469,61 +513,85 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
         x_saved = lax.dynamic_index_in_dim(in_buf, m % S, 0, keepdims=False)
         g_recv = lax.dynamic_index_in_dim(cot_buf, m % S, 0, keepdims=False)
 
-        def thread_first(p, x):
+        def thread_first(p, pf, x):
             # embed path on stage 0 only; `where` keeps the jaxpr uniform
             # across stages, grads flow to embed params only where idx==0
-            x_in = jnp.where(idx == 0, first_fn(p, raw), x)
+            x_in = jnp.where(idx == 0, first_fn(pf, raw).astype(bdtype), x)
             return fn(p, x_in)
 
         # 2) compute — switch so idle ticks cost nothing and fwd ticks
         #    don't pay the vjp.  Every branch output is pvary'd so the
         #    branches agree on varying-manual-axes types.
-        def pv(y, dx, gtree, l):
+        def pv(y, dx, gtree, fgtree, lgtree, l):
+            pvt = lambda tr: jax.tree.map(lambda a: _pvary_axes(a, vaxes),
+                                          tr)
             return (_pvary_axes(y, act_axes), _pvary_axes(dx, act_axes),
-                    jax.tree.map(lambda a: _pvary_axes(a, vaxes), gtree),
+                    pvt(gtree), pvt(fgtree), pvt(lgtree),
                     _pvary_axes(l, vaxes))
 
         def do_idle(_):
-            return pv(zeros_b(), zeros_b(), jax.tree.map(
-                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+            return pv(zeros_b(), zeros_b(), zero_tree(grad_zero),
+                      zero_tree(fgrad_zero), zero_tree(lgrad_zero),
+                      jnp.zeros(()))
 
         def do_fwd(_):
-            y = thread_first(stage_params, x_saved)
-            return pv(y, zeros_b(), jax.tree.map(
-                lambda g: jnp.zeros_like(g), grad_zero), jnp.zeros(()))
+            y = thread_first(stage_params, fparams, x_saved)
+            return pv(y, zeros_b(), zero_tree(grad_zero),
+                      zero_tree(fgrad_zero), zero_tree(lgrad_zero),
+                      jnp.zeros(()))
 
         def do_bwd(_):
             def run(loss_like):
-                from paddle_tpu.distributed.communication import pvary
-                val, pull = jax.vjp(loss_like, stage_params, x_saved)
+                val, pull = jax.vjp(loss_like, stage_params, fparams,
+                                    lparams, x_saved)
                 # the seed's varying-axes set must match val's (under a
                 # multi-axis mesh the loss also varies over dp/tp axes)
                 vma = getattr(jax.typeof(val), "vma", None)
                 seed = _pvary_axes(jnp.ones((), val.dtype),
                                    vma or (axis_name,))
-                dp, dx = pull(seed)
-                return val, dp, dx
+                dp, dfp, dlp, dx = pull(seed)
+                return val, dp, dfp, dlp, dx
 
             def last_branch(_):
-                return run(lambda p, x: last_fn(p, thread_first(p, x), lab)
-                           * inv_m)
+                return run(lambda p, pf, pl, x: last_fn(
+                    pl, thread_first(p, pf, x), lab) * inv_m)
 
             def mid_branch(_):
-                return run(lambda p, x: jnp.sum(
-                    thread_first(p, x).astype(jnp.float32)
+                # lparams is untouched here; jax.vjp returns zero
+                # cotangents for unused arguments, keeping the branch
+                # pytrees structurally identical
+                return run(lambda p, pf, pl, x: jnp.sum(
+                    thread_first(p, pf, x).astype(jnp.float32)
                     * g_recv.astype(jnp.float32)))
 
-            val, dp, dx = lax.cond(idx == S - 1, last_branch, mid_branch,
-                                   None)
+            val, dp, dfp, dlp, dx = lax.cond(idx == S - 1, last_branch,
+                                             mid_branch, None)
             loss_c = jnp.where(idx == S - 1, val, 0.0)
-            dpf = jax.tree.map(lambda d, z: d.astype(z.dtype), dp, grad_zero)
-            return pv(zeros_b(), dx.astype(bdtype), dpf,
+            # fold group grads back into the stage tree when aliased
+            if not has_first:
+                dp = jax.tree.map(lambda a, b: a + b, dp, dfp)
+            if not has_last:
+                dp = jax.tree.map(lambda a, b: a + b, dp, dlp)
+            cast = lambda dtree, ztree: jax.tree.map(
+                lambda d, z: d.astype(z.dtype), dtree, ztree)
+            if stage_grad_reduce is not None:
+                dp = stage_grad_reduce(jax.tree.map(
+                    lambda d: d.astype(jnp.float32)
+                    if jnp.issubdtype(d.dtype, jnp.floating) else d, dp))
+            return pv(zeros_b(), dx.astype(bdtype), cast(dp, grad_zero),
+                      cast(dfp, fgrad_zero) if has_first
+                      else zero_tree(fgrad_zero),
+                      cast(dlp, lgrad_zero) if has_last
+                      else zero_tree(lgrad_zero),
                       loss_c.astype(jnp.float32).reshape(()))
 
-        send_y, send_dx, dp, loss_c = lax.switch(
+        send_y, send_dx, dp, dfp, dlp, loss_c = lax.switch(
             jnp.clip(op, 0, 2), [do_idle, do_fwd, do_bwd], None)
 
-        grads = jax.tree.map(lambda g, d: g + d, grads, dp)
+        add = lambda a, d: jax.tree.map(lambda g, x: g + x, a, d)
+        grads = add(grads, dp)
+        fgrads = add(fgrads, dfp)
+        lgrads = add(lgrads, dlp)
         loss_acc = loss_acc + loss_c
 
         # 3) rotate: activations forward, cotangents backward (ring; the
@@ -532,24 +600,49 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                                [(i, (i + 1) % S) for i in range(S)])
         new_bwd = lax.ppermute(send_dx, axis_name,
                                [(i, (i - 1) % S) for i in range(S)])
-        return (new_fwd, new_bwd, in_buf, cot_buf, grads, loss_acc), None
+        return (new_fwd, new_bwd, in_buf, cot_buf, grads, fgrads, lgrads,
+                loss_acc), None
 
     # activations only vary over the pipeline axis and whatever the batch is
     # sharded on (e.g. dp) — marking them varying over tp too would insert a
     # spurious psum in the transpose, double-counting every gradient
     act_axes = _varying_axes(axis_name, mb_inputs, mb_labels)
-    vaxes = _varying_axes(axis_name, stage_params, mb_inputs, mb_labels)
+    vaxes = _varying_axes(axis_name, stage_params, fparams, lparams,
+                          mb_inputs, mb_labels)
+    # group params arrive pp-replicated (invariant); left that way, the
+    # per-tick vjp would AUTO-insert their grad psum over pp INSIDE the
+    # lax.cond branch only some pp groups take — a cross-stage collective
+    # half the devices never reach (deadlock).  pvary them over the
+    # ACTIVATION axes (pp + data axes) so grads come back as per-device
+    # partial sums and those reductions happen explicitly, outside
+    # divergent control flow.  tp is deliberately left invariant: the wire
+    # activations must stay off tp, and any auto tp-reduction is uniform
+    # within a tp group (all its members share a pp index and branch).
+    if has_first:
+        fparams = jax.tree.map(lambda a: _pvary_axes(a, act_axes), fparams)
+    if has_last:
+        lparams = jax.tree.map(lambda a: _pvary_axes(a, act_axes), lparams)
+    pvz = lambda tr: jax.tree.map(lambda z: _pvary_axes(z, vaxes), tr)
     init = (_pvary_axes(zeros_b(), act_axes),
             _pvary_axes(zeros_b(), act_axes),
             _pvary_axes(jnp.zeros((S,) + bshape, bdtype), act_axes),
             _pvary_axes(jnp.zeros((S,) + bshape, bdtype), act_axes),
-            jax.tree.map(lambda z: _pvary_axes(z, vaxes), grad_zero),
+            pvz(grad_zero), pvz(fgrad_zero), pvz(lgrad_zero),
             _pvary_axes(jnp.zeros((), jnp.float32), vaxes))
-    (_, _, _, _, grads, loss_acc), _ = lax.scan(tick, init, jnp.arange(T))
+    (_, _, _, _, grads, fgrads, lgrads, loss_acc), _ = lax.scan(
+        tick, init, jnp.arange(T))
 
     # every stage reports the (last-stage-only) loss
     loss = lax.psum(loss_acc, axis_name)
-    return loss, grads
+    if not has_groups:
+        return loss, grads
+    # group grads: only stage 0 (first) / stage S-1 (last) hold nonzero
+    # contributions; psum over pp makes the true grad visible everywhere
+    # (matching the groups' pp-replicated storage)
+    psum_tree = lambda tr: jax.tree.map(
+        lambda g: lax.psum(g, axis_name), tr) if tr is not None else None
+    return loss, (grads, psum_tree(fgrads) if has_first else None,
+                  psum_tree(lgrads) if has_last else None)
 
 
 # -- interleaved virtual stages ----------------------------------------------
@@ -837,105 +930,248 @@ def pipeline_interleaved(stage_fn: Callable, first_fn: Callable,
     return loss, grads
 
 
-# -- PP composed with dp/tp: the 3-D training step ---------------------------
+# -- PP composed with dp/fsdp/tp: the 4-D training step ----------------------
 
-class PipelineTrainStep:
+def _spec_axis_pos(spec, axis):
+    """Index of the array dim `axis` shards in a PartitionSpec, or None."""
+    for i, e in enumerate(spec):
+        if e == axis or (isinstance(e, tuple) and axis in e):
+            return i
+    return None
+
+
+def _spec_axes(spec):
+    out = set()
+    for e in spec:
+        if isinstance(e, tuple):
+            out.update(a for a in e if a is not None)
+        elif e is not None:
+            out.add(e)
+    return out
+
+
+class PipelineTrainStep(_TrainStepBase):
     """Compiled hybrid-parallel training step: 1F1B pipeline over ``pp``,
-    data parallelism over ``dp``, tensor parallelism over ``tp`` — one mesh,
-    one jitted program.
+    data parallelism over ``dp``, ZeRO-sharded data parallelism over
+    ``fsdp``, tensor parallelism over ``tp`` — one mesh, ONE jitted
+    program, matching the reference's 4-D hybrid topology
+    ``["data", "pipe", "sharding", "model"]`` (fleet/base/topology.py:54).
 
     Reference role: PipelineParallel inside HybridParallelClipGrad/fleet
-    (meta_parallel/pipeline_parallel.py + hybrid_parallel_optimizer.py) where
-    pp/dp/mp process groups compose.  Here the composition is a single
-    fully-manual shard_map: the 1F1B tick scan runs over the pp axis;
-    each microbatch's SAMPLE axis is split over dp — batch shape
-    [M, mb, ...] with mb divisible by the dp size, every dp shard running
-    all M microbatches on its slice, grads normalized back to the
-    global-batch mean — and ``stage_fn`` is
-    written Megatron-style against LOCAL tp shards (explicit lax.psum over
-    the tp axis where its math requires it — same contract as mpu layers).
+    (meta_parallel/pipeline_parallel.py + hybrid_parallel_optimizer.py +
+    sharding/group_sharded) where pp/dp/sharding/mp process groups compose.
+    Here the composition is a single fully-manual shard_map:
+
+    * pp — the 1F1B tick scan runs over the pp axis.
+    * dp + fsdp — each microbatch's SAMPLE axis is split over dp×fsdp;
+      every data shard runs all M microbatches on its slice and grads are
+      normalized back to the global-batch mean.
+    * fsdp (ZeRO): param leaves whose spec names the fsdp axis are STORED
+      sharded (so are their optimizer-state leaves — ZeRO-1 memory comes
+      free from GSPMD on the update), all_gather'd over fsdp once at step
+      entry (ZeRO-3 compute), and their grads reduce-scattered back.
+    * tp — ``stage_fn`` is written Megatron-style against LOCAL tp shards
+      (explicit lax.psum over the tp axis where its math requires it —
+      same contract as mpu layers).
 
     Args:
-      stage_fn/first_fn/last_fn: as :func:`pipeline_1f1b`, but operating on
-        local tp param shards.
+      stage_fn/first_fn/last_fn: as :func:`pipeline_1f1b`, operating on
+        local tp shards.
       stacked_params: dict name -> global [S, ...] stacked arrays.
       param_specs: dict name -> PartitionSpec with the leading pp axis and
-        any tp placements, e.g. P('pp', None, 'tp').
-      optimizer: a paddle_tpu optimizer (init_state_pytree/apply_gradients).
+        any fsdp/tp placements, e.g. P('pp', 'fsdp', 'tp').
+      first_params/last_params (+ their specs): optional separate
+        embed/head param dicts — NOT stacked, NOT pp-sharded (specs name
+        only fsdp/tp axes), owned logically by stage 0 / stage S-1 (see
+        :func:`pipeline_1f1b`).
+      optimizer: a paddle_tpu optimizer (init_state_pytree/apply_gradients
+        — grad clip and fp32 master weights ride along exactly as in
+        ``jit.TrainStep``; pass ``compute_dtype='bfloat16'`` for AMP-O2).
       batch: step() takes {'inputs': [M, mb, ...], 'labels': [M, mb, ...]};
-        the microbatch axis is split over dp.
+        the microbatch axis is split over dp×fsdp.
     """
 
     def __init__(self, stage_fn, first_fn, last_fn, stacked_params,
                  optimizer, mesh, num_microbatches, param_specs, *,
                  pp_axis: str = "pp", dp_axis: Optional[str] = "dp",
-                 remat: bool = True):
+                 fsdp_axis: Optional[str] = "fsdp", remat: bool = True,
+                 first_params=None, first_specs=None,
+                 last_params=None, last_specs=None, compute_dtype=None,
+                 scatter_grads_per_tick: bool = False):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.mesh = mesh
-        self.optimizer = optimizer
         self.num_microbatches = num_microbatches
         self._pp = pp_axis
         self._dp = dp_axis if dp_axis in mesh.axis_names else None
-        self._specs = dict(param_specs)
+        self._fsdp = fsdp_axis if (fsdp_axis and
+                                   fsdp_axis in mesh.axis_names) else None
+        data_axes = tuple(a for a in (self._dp, self._fsdp) if a)
+        has_first = first_params is not None
+        has_last = last_params is not None
 
-        self._param_sh = {n: NamedSharding(mesh, self._specs[n])
-                          for n in stacked_params}
-        self.params = {n: jax.device_put(jnp.asarray(a), self._param_sh[n])
-                       for n, a in stacked_params.items()}
-        self.opt_state = optimizer.init_state_pytree(self.params)
-        self.opt_state = {
-            n: jax.tree.map(
-                lambda a: jax.device_put(a, self._param_sh[n])
-                if hasattr(a, "shape") and a.shape == self.params[n].shape
-                else a, st)
-            for n, st in self.opt_state.items()}
-        self.step_count = jnp.zeros((), jnp.int32)
+        # one flat dict drives placement, donation, clip (global norm spans
+        # stage+embed+head), optimizer update, and checkpointing
+        flat, specs = {}, {}
+        for n, a in stacked_params.items():
+            flat[n] = a
+            specs[n] = param_specs[n]
+        for prefix, tree, tree_specs in (("first/", first_params,
+                                          first_specs),
+                                         ("last/", last_params,
+                                          last_specs)):
+            if tree is not None:
+                for n, a in tree.items():
+                    spec = (tree_specs or {}).get(n, P())
+                    if pp_axis in _spec_axes(spec):
+                        raise ValueError(
+                            f"{prefix}{n}: embed/head params must not be "
+                            f"pp-sharded (they are owned by one stage and "
+                            f"replicated over pp); got {spec}")
+                    flat[prefix + n] = a
+                    specs[prefix + n] = spec
+        if compute_dtype is not None:
+            flat = {n: jnp.asarray(a).astype(compute_dtype)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                    else a for n, a in flat.items()}
+        self._specs = specs
+        param_sh = {n: NamedSharding(mesh, specs[n]) for n in flat}
+        self._init_step_state(optimizer, flat, param_sh)
 
         manual = set(mesh.axis_names)
+        fsdp = self._fsdp
+
+        def split(params):
+            stage, first, last = {}, {}, {}
+            for n, v in params.items():
+                if n.startswith("first/"):
+                    first[n[6:]] = v
+                elif n.startswith("last/"):
+                    last[n[5:]] = v
+                else:
+                    stage[n] = v
+            return (stage, first if has_first else None,
+                    last if has_last else None)
+
+        def gather_tree(tree, prefix=""):
+            # ZeRO-3: materialize full (per-stage) values of fsdp-sharded
+            # leaves; the matching reduce-scatter runs on the grads below
+            if tree is None or fsdp is None:
+                return tree
+            out = {}
+            for n, v in tree.items():
+                pos = _spec_axis_pos(specs[prefix + n], fsdp)
+                out[n] = v if pos is None else lax.all_gather(
+                    v, fsdp, axis=pos, tiled=True)
+            return out
+
+        def scatter_tree(tree, prefix=""):
+            if tree is None or fsdp is None:
+                return tree
+            out = {}
+            for n, g in tree.items():
+                pos = _spec_axis_pos(specs[prefix + n], fsdp)
+                out[n] = g if pos is None else lax.psum_scatter(
+                    g, fsdp, scatter_dimension=pos, tiled=True)
+            return out
+
+        def reduce_leaf(g, spec, exclude=()):
+            # vma cleanup: pmean over any axis the grad still varies on
+            # but its out_spec omits (values already equal across them)
+            present = _spec_axes(spec)
+            vma = getattr(jax.typeof(g), "vma", None) or ()
+            for ax in manual - present - set(exclude):
+                if ax in vma:
+                    g = lax.pmean(g, ax)
+            return g
+
+        per_tick = scatter_grads_per_tick and fsdp is not None
+
+        def tick_reduce(tree):
+            # keep the scan's grad accumulator ZeRO-sharded: reduce-scatter
+            # each tick's contribution instead of accumulating full-size
+            return scatter_tree(tree)
 
         def body(params, mb_inputs, mb_labels):
-            loss, grads = pipeline_1f1b(
-                stage_fn, first_fn, last_fn, params, mb_inputs, mb_labels,
+            stage_p, first_p, last_p = split(params)
+            out = pipeline_1f1b(
+                stage_fn, first_fn, last_fn, gather_tree(stage_p),
+                mb_inputs, mb_labels,
                 num_microbatches=num_microbatches, axis_name=pp_axis,
-                remat=remat)
-            # dp semantics: each dp shard computes the mean loss of ITS
-            # microbatch slice; the vjp transpose has already psum'd the
-            # per-shard grads over dp, so divide by dp size to get the
-            # global-batch mean.  Then pmean over any axis a leaf's grad
-            # still varies on but its out_spec omits (vma cleanup; values
-            # are already equal across those shards).
-            if self._dp:
-                dp_size = lax.axis_size(self._dp)
-                grads = {n: g / dp_size for n, g in grads.items()}
-                loss = lax.pmean(loss, self._dp)
+                remat=remat,
+                first_params=gather_tree(first_p, "first/"),
+                last_params=gather_tree(last_p, "last/"),
+                stage_grad_reduce=tick_reduce if per_tick else None)
+            if has_first or has_last:
+                loss, (g_stage, g_first, g_last) = out
+            else:
+                loss, g_stage = out
+                g_first = g_last = None
 
-            def reduce_leaf(g, spec):
-                present = set()
-                for e in spec:
-                    if isinstance(e, tuple):
-                        present.update(e)
-                    elif e is not None:
-                        present.add(e)
-                vma = getattr(jax.typeof(g), "vma", None) or ()
-                for ax in manual - present - {pp_axis}:
-                    if ax in vma:
-                        g = lax.pmean(g, ax)
-                return g
-            grads = {n: reduce_leaf(g, self._specs[n])
-                     for n, g in grads.items()}
+            # data semantics: each of the D = dp*fsdp data shards computed
+            # the mean loss of ITS microbatch slice; the vjp transpose
+            # already psum'd grads over axes the params are INVARIANT on
+            # (dp always; fsdp for non-fsdp-sharded leaves), and the
+            # reduce-scatter below sums the fsdp-sharded ones — so a
+            # uniform 1/D turns every leaf into the global-batch mean.
+            d_total = 1
+            for ax in data_axes:
+                d_total *= lax.axis_size(ax)
+            scale = 1.0 / d_total
+            norm = lambda tr: None if tr is None else jax.tree.map(
+                lambda g: g * scale, tr)
+            g_stage, g_first, g_last = norm(g_stage), norm(g_first), \
+                norm(g_last)
+            for ax in data_axes:
+                loss = lax.pmean(loss, ax)
             vma_l = getattr(jax.typeof(loss), "vma", None) or ()
-            for ax in manual - {pp_axis}:
-                if ax in vma_l:
+            for ax in manual - set(data_axes):
+                if ax in vma_l:  # e.g. tp: equal across shards, clean vma
                     loss = lax.pmean(loss, ax)
-            return loss, grads
 
-        batch_spec = P(None, self._dp) if self._dp else P()
+            if not per_tick:  # already reduce-scattered inside the ticks
+                g_stage = scatter_tree(g_stage)
+
+            def group_reduce(tr, prefix):
+                # group grads come back as per-device partial sums over
+                # the data axes (their params were pvary'd — see
+                # pipeline_1f1b); reduce them explicitly here, OUTSIDE any
+                # divergent control flow: sum over dp, sum(+shard) over
+                # fsdp.  tp shards hold equal values — reduce_leaf's
+                # pmean cleans that vma up below.
+                if tr is None:
+                    return None
+                out = {}
+                for n, g in tr.items():
+                    if self._dp:
+                        g = lax.psum(g, self._dp)
+                    if fsdp:
+                        pos = _spec_axis_pos(specs[prefix + n], fsdp)
+                        g = lax.psum(g, fsdp) if pos is None else \
+                            lax.psum_scatter(g, fsdp,
+                                             scatter_dimension=pos,
+                                             tiled=True)
+                    out[n] = g
+                return out
+
+            g_first = group_reduce(g_first, "first/")
+            g_last = group_reduce(g_last, "last/")
+
+            merged = {n: reduce_leaf(g, specs[n], exclude=(pp_axis,))
+                      for n, g in g_stage.items()}
+            for prefix, tr in (("first/", g_first), ("last/", g_last)):
+                if tr is not None:
+                    for n, g in tr.items():
+                        merged[prefix + n] = reduce_leaf(
+                            g, specs[prefix + n])
+            return loss, merged
+
+        batch_spec = P(None, data_axes) if data_axes else P()
         self._shmap = jax.shard_map(
             body, mesh=mesh,
-            in_specs=({n: self._specs[n] for n in self.params},
+            in_specs=({n: specs[n] for n in self.params},
                       batch_spec, batch_spec),
-            out_specs=(P(), {n: self._specs[n] for n in self.params}))
+            out_specs=(P(), {n: specs[n] for n in self.params}))
 
         def step_impl(params, opt_state, step_count, mb_inputs, mb_labels,
                       lr):
@@ -950,10 +1186,4 @@ class PipelineTrainStep:
     def __call__(self, batch):
         mb_inputs = jnp.asarray(batch["inputs"])
         mb_labels = jnp.asarray(batch["labels"])
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.params, self.opt_state, self.step_count = self._jitted(
-            self.params, self.opt_state, self.step_count, mb_inputs,
-            mb_labels, lr)
-        if self.optimizer._lr_scheduler is not None:
-            self.optimizer._lr_scheduler.step()
-        return loss
+        return self._run_jitted(mb_inputs, mb_labels)

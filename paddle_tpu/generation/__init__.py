@@ -21,7 +21,21 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["StaticCache", "GenerationConfig", "generate",
-           "static_cache_attention"]
+           "static_cache_attention", "reject_scalar_mask"]
+
+
+def reject_scalar_mask(attn_mask):
+    """Guard shared by the cached-decode forward signatures: a scalar
+    attn_mask means the caller positionally passed position_offset where
+    attn_mask now sits.  Returns the unwrapped mask (or None)."""
+    from paddle_tpu.core.dispatch import unwrap
+    raw = None if attn_mask is None else unwrap(attn_mask)
+    if isinstance(attn_mask, (int, float)) or (
+            raw is not None and getattr(raw, "ndim", 1) == 0):
+        raise TypeError(
+            "attn_mask got a scalar — position_offset must be passed by "
+            "keyword (the forward signature gained attn_mask before it)")
+    return raw
 
 
 class StaticCache(NamedTuple):
@@ -66,11 +80,7 @@ def static_cache_attention(q, k, v, cache: StaticCache, position_offset,
     qpos = position_offset + jnp.arange(s)[None, None, :, None]
     mask = kpos <= qpos  # valid-prefix causal bound over the buffer
     if attn_mask is not None:
-        if isinstance(attn_mask, int):
-            raise TypeError(
-                "attn_mask got an int — position_offset must be passed by "
-                "keyword (the forward signature gained attn_mask before it)")
-        am = unwrap(attn_mask)
+        am = reject_scalar_mask(attn_mask)
         if am.dtype == jnp.bool_:
             mask = mask & am
         else:  # additive mask: fold the causal bound in
@@ -86,7 +96,8 @@ def _sample(logits, cfg: GenerationConfig, key):
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(cfg.temperature, 1e-6)
     if cfg.top_k and cfg.top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -cfg.top_k][:, None]
+        k = min(cfg.top_k, logits.shape[-1])  # clamp: top_k may exceed vocab
+        kth = jnp.sort(logits, axis=-1)[:, -k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
     if cfg.top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
@@ -113,8 +124,10 @@ def generate(model, input_ids, generation_config: Optional[
     """Autoregressive decoding with a compiled per-token step.
 
     input_ids: [batch, prompt_len] (numpy / Tensor / jax).  Returns
-    [batch, prompt_len + max_new_tokens] int32 (post-EOS positions filled
-    with pad_token_id).
+    [batch, prompt_len + max_new_tokens] int32.  EOS handling matches the
+    usual transformers convention: the EOS token itself is emitted verbatim
+    (including when it is the very first sampled token), and every position
+    AFTER a sequence's EOS is filled with pad_token_id.
     """
     from paddle_tpu.core.dispatch import unwrap
     from paddle_tpu.core.functional import functional_call, params_of

@@ -157,9 +157,101 @@ def _assigned_names(nodes):
         def visit_Lambda(self, node):
             pass
 
+        # py3 comprehension targets are scoped to the comprehension — they
+        # are NOT branch-local assignments (walrus escapes are not handled)
+        def _skip(self, node):
+            pass
+
+        visit_ListComp = visit_SetComp = _skip
+        visit_GeneratorExp = visit_DictComp = _skip
+
     for n in nodes:
         V().visit(n)
     return out
+
+
+def _read_before_store(nodes):
+    """Names Loaded before their first Store, in (approximate) execution
+    order — an UNDEF placeholder for such a name could actually be read,
+    so it must be treated as `needed` (loud error instead of a silent 0)."""
+    stored = set()
+    reads = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load) and node.id not in stored:
+                reads.add(node.id)
+            elif isinstance(node.ctx, ast.Store):
+                stored.add(node.id)
+
+        def visit_Assign(self, node):  # value is evaluated before targets
+            self.visit(node.value)
+            for t in node.targets:
+                self.visit(t)
+
+        def visit_AugAssign(self, node):  # target is read, then written
+            self.visit(node.value)
+            if isinstance(node.target, ast.Name):
+                if node.target.id not in stored:
+                    reads.add(node.target.id)
+                stored.add(node.target.id)
+            else:
+                self.visit(node.target)
+
+        def visit_Call(self, node):
+            # __jst_undef_lookup(lambda: name) is the transformer's OWN
+            # safe read (returns UNDEF instead of raising) — not a user
+            # read; skip it so already-rewritten inner ifs don't mark
+            # every assigned name as read-before-store
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "__jst_undef_lookup":
+                return
+            self.generic_visit(node)
+
+        def _visit_comp(self, node):
+            # a comprehension's generators run before its elt, and its
+            # targets are scoped to it — visit in execution order with the
+            # targets counting as stores (conservatively left in `stored`)
+            for gen in node.generators:
+                self.visit(gen.iter)
+                self.visit(gen.target)
+                for cond in gen.ifs:
+                    self.visit(cond)
+            if hasattr(node, "elt"):
+                self.visit(node.elt)
+            else:  # DictComp
+                self.visit(node.key)
+                self.visit(node.value)
+
+        visit_ListComp = visit_SetComp = visit_GeneratorExp = _visit_comp
+        visit_DictComp = _visit_comp
+
+        def _visit_closure(self, node):
+            # free variables of a nested def/lambda may be read when it is
+            # called — count its Loads (minus its own args) as reads.
+            # Functions the transformer itself generated (rewritten inner
+            # ifs/whiles) are exempt: their reads go through the carry
+            # tuple / undef_lookup machinery, not bare unbound names.
+            if getattr(node, "name", "").startswith("__jst_"):
+                return
+            args = {a.arg for a in node.args.args + node.args.posonlyargs
+                    + node.args.kwonlyargs}
+            for a in (node.args.vararg, node.args.kwarg):
+                if a is not None:
+                    args.add(a.arg)
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id not in args and sub.id not in stored:
+                    reads.add(sub.id)
+
+        visit_FunctionDef = visit_AsyncFunctionDef = _visit_closure
+        visit_Lambda = _visit_closure
+
+    v = V()
+    for n in nodes:
+        v.visit(n)
+    return reads
 
 
 def _read_names(nodes):
@@ -248,8 +340,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                  body=ast.Name(id=n, ctx=ast.Load()))],
                 keywords=[]) for n in assigned],
             ctx=ast.Load())
+        # a name's incoming value matters when some branch might not write
+        # it, OR when a branch reads it before its first store in that
+        # branch (an UNDEF placeholder could then be silently computed on)
+        rbs = _read_before_store(node.body) | _read_before_store(node.orelse)
         needed = ast.Tuple(
-            elts=[ast.Constant(not (n in t_assigned and n in f_assigned))
+            elts=[ast.Constant(not (n in t_assigned and n in f_assigned)
+                               or n in rbs)
                   for n in assigned],
             ctx=ast.Load())
         call = ast.Assign(

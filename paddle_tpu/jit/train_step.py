@@ -22,7 +22,83 @@ import jax.numpy as jnp
 from paddle_tpu.core.functional import functional_call, params_of, \
     trainable_mask
 
-__all__ = ["TrainStep"]
+__all__ = ["TrainStep", "CompiledStepBase"]
+
+
+class CompiledStepBase:
+    """Shared plumbing for compiled training steps (``TrainStep`` and
+    ``distributed.PipelineTrainStep``): sharded placement of params and
+    optimizer state, the donated-jit call protocol, lr/scheduler wiring,
+    and the checkpoint state_dict round-trip.  Subclasses build
+    ``self._jitted`` with signature
+    ``(params, opt_state, step_count, *step_args, lr) ->
+    (loss, params, opt_state, step_count)``."""
+
+    def _init_step_state(self, optimizer, params, param_sh=None):
+        """Place params on their shardings and derive optimizer state
+        (each state leaf shaped like its param inherits the sharding)."""
+        self.optimizer = optimizer
+        self._param_sh = param_sh
+        # copy defensively: the step donates its buffers to XLA, and
+        # device_put may ALIAS the caller's array when the sharding already
+        # matches — donation would silently delete the caller's copy
+        if param_sh is not None:
+            params = {n: jax.device_put(jnp.copy(jnp.asarray(a)),
+                                        param_sh[n])
+                      for n, a in params.items()}
+        else:
+            params = {n: jnp.copy(jnp.asarray(a))
+                      for n, a in params.items()}
+        self.params = params
+        self.opt_state = optimizer.init_state_pytree(params)
+        if param_sh is not None:
+            self.opt_state = {
+                n: jax.tree.map(
+                    lambda a, _sh=param_sh[n], _p=params[n]: jax.device_put(
+                        a, _sh)
+                    if hasattr(a, "shape") and a.shape == _p.shape else a,
+                    st)
+                for n, st in self.opt_state.items()}
+        self.step_count = jnp.zeros((), jnp.int32)
+
+    def _run_jitted(self, *step_args):
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.params, self.opt_state, self.step_count = self._jitted(
+            self.params, self.opt_state, self.step_count, *step_args, lr)
+        if self.optimizer._lr_scheduler is not None:
+            self.optimizer._lr_scheduler.step()
+        return loss
+
+    # checkpointing ----------------------------------------------------------
+    def state_dict(self):
+        import numpy as np
+        out = {"params": jax.tree.map(np.asarray, self.params),
+               "opt_state": jax.tree.map(np.asarray, self.opt_state),
+               "step": int(self.step_count)}
+        if self.optimizer._lr_scheduler is not None:
+            out["lr_scheduler"] = self.optimizer._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        import numpy as np
+        if self._param_sh:
+            put = lambda n, a: jax.device_put(jnp.asarray(a),
+                                              self._param_sh[n])
+            # opt-state leaves shaped like their param share its sharding
+            put_st = lambda n, st: jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), self._param_sh[n])
+                if np.shape(a) == tuple(self.params[n].shape)
+                else jnp.asarray(a), st)
+        else:
+            put = lambda n, a: jnp.asarray(a)
+            put_st = lambda n, st: jax.tree.map(jnp.asarray, st)
+        self.params = {n: put(n, a) for n, a in state["params"].items()}
+        self.opt_state = {n: put_st(n, st)
+                          for n, st in state["opt_state"].items()}
+        self.step_count = jnp.asarray(state["step"], jnp.int32)
+        if "lr_scheduler" in state and \
+                self.optimizer._lr_scheduler is not None:
+            self.optimizer._lr_scheduler.set_state_dict(state["lr_scheduler"])
 
 
 def _has_lm_loss(model) -> bool:
@@ -66,7 +142,7 @@ def _loss_of(model, loss_fn, params, batch, rngs):
     return loss._data if hasattr(loss, "_data") else loss
 
 
-class TrainStep:
+class TrainStep(CompiledStepBase):
     """Compile model+optimizer into one donated, jitted update.
 
     step = TrainStep(model, opt)          # or loss_fn=, mesh=, param_specs=
@@ -79,15 +155,11 @@ class TrainStep:
                  batch_spec=None, compute_dtype=None, seed: int = 0,
                  remat: bool = False, remat_policy: Optional[str] = None):
         self.model = model
-        self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
-        # copy: the step donates its buffers to XLA, and the Layer's own
-        # Parameter arrays must survive donation
-        self.params = {n: a.copy()
-                       for n, a in params_of(model, dtype=compute_dtype).items()}
-        self.opt_state = optimizer.init_state_pytree(self.params)
-        self.step_count = jnp.zeros((), jnp.int32)
+        # (no copy here: _init_step_state copies every leaf before the
+        # donated jit, which is what protects the Layer's own Parameters)
+        params = params_of(model, dtype=compute_dtype)
         self._mask = trainable_mask(model)
         self._key = jax.random.PRNGKey(seed)
         self._remat = remat
@@ -129,22 +201,13 @@ class TrainStep:
                 return P(*(keep(e) for e in spec))
 
             to_sh = lambda spec: NamedSharding(mesh, sanitize(spec))
-            self._param_sh = {n: to_sh(param_specs.get(n, P()))
-                              for n in self.params}
-            self.params = {n: jax.device_put(a, self._param_sh[n])
-                           for n, a in self.params.items()}
-            # optimizer state inherits its parameter's sharding
-            self.opt_state = {
-                n: jax.tree.map(
-                    lambda a: jax.device_put(a, self._param_sh[n])
-                    if hasattr(a, "shape") and a.shape == self.params[n].shape
-                    else a, st)
-                for n, st in self.opt_state.items()}
+            param_sh = {n: to_sh(param_specs.get(n, P())) for n in params}
             self._batch_sh = to_sh(batch_spec) if batch_spec is not None \
                 else None
         else:
-            self._param_sh = self._batch_sh = None
+            param_sh = self._batch_sh = None
 
+        self._init_step_state(optimizer, params, param_sh)
         self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
     def _step_impl(self, params, opt_state, step_count, batch, key, lr):
@@ -180,45 +243,9 @@ class TrainStep:
         else:
             batch = jax.tree.map(jnp.asarray, batch)
         self._key, sub = jax.random.split(self._key)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.params, self.opt_state, self.step_count = self._jitted(
-            self.params, self.opt_state, self.step_count, batch, sub, lr)
-        if self.optimizer._lr_scheduler is not None:
-            self.optimizer._lr_scheduler.step()
-        return loss
+        return self._run_jitted(batch, sub)
 
     def sync_to_model(self):
         state = self.model.state_dict(keep_vars=True)
         for n, arr in self.params.items():
             state[n]._set_data(arr.astype(state[n]._data.dtype))
-
-    # checkpointing ----------------------------------------------------------
-    def state_dict(self):
-        import numpy as np
-        out = {"params": jax.tree.map(np.asarray, self.params),
-               "opt_state": jax.tree.map(np.asarray, self.opt_state),
-               "step": int(self.step_count)}
-        if self.optimizer._lr_scheduler is not None:
-            out["lr_scheduler"] = self.optimizer._lr_scheduler.state_dict()
-        return out
-
-    def set_state_dict(self, state):
-        import numpy as np
-        if self._param_sh:
-            put = lambda n, a: jax.device_put(jnp.asarray(a),
-                                              self._param_sh[n])
-            # opt-state leaves shaped like their param share its sharding
-            put_st = lambda n, st: jax.tree.map(
-                lambda a: jax.device_put(jnp.asarray(a), self._param_sh[n])
-                if np.shape(a) == tuple(self.params[n].shape)
-                else jnp.asarray(a), st)
-        else:
-            put = lambda n, a: jnp.asarray(a)
-            put_st = lambda n, st: jax.tree.map(jnp.asarray, st)
-        self.params = {n: put(n, a) for n, a in state["params"].items()}
-        self.opt_state = {n: put_st(n, st)
-                          for n, st in state["opt_state"].items()}
-        self.step_count = jnp.asarray(state["step"], jnp.int32)
-        if "lr_scheduler" in state and \
-                self.optimizer._lr_scheduler is not None:
-            self.optimizer._lr_scheduler.set_state_dict(state["lr_scheduler"])

@@ -154,10 +154,8 @@ class GPTModel(Layer):
         pos = position_offset + jnp.arange(s)
         x = self.embed_tokens(input_ids) + self.embed_positions(pos)
         x = self.dropout(x)
-        if isinstance(attn_mask, int):
-            raise TypeError(
-                "attn_mask got an int — pass position_offset by keyword "
-                "(the signature gained attn_mask before it)")
+        from paddle_tpu.generation import reject_scalar_mask
+        reject_scalar_mask(attn_mask)
         new_caches = [] if caches is not None else None
         for i, layer in enumerate(self.layers):
             if caches is not None:

@@ -4,27 +4,18 @@ the pattern prescribed by the task environment and mirroring the reference's
 "N processes on one host" distributed test strategy (SURVEY.md §4)."""
 
 import os
+import sys
 
 # "cpu,axon": default backend is the 8-device virtual CPU mesh, but a
 # tunneled TPU (axon plugin) stays visible so the real-hardware smoke tests
 # (test_flash_attention_tpu.py) can compile for the chip instead of
-# silently skipping.  Falls back to cpu-only when no tunnel is attached.
-os.environ["JAX_PLATFORMS"] = "cpu,axon"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# silently skipping.  The recipe (including undoing the sitecustomize's
+# jax.config platform forcing) lives in repo-root _jax_platform.py, shared
+# with __graft_entry__.py.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from _jax_platform import force_cpu_default  # noqa: E402
 
-import jax  # noqa: E402
-
-# The environment's sitecustomize may have force-selected a remote TPU
-# platform via jax.config.update("jax_platforms", ...) at interpreter start,
-# which overrides the env var; undo it so tests run on the virtual CPU mesh.
-try:
-    jax.config.update("jax_platforms", "cpu,axon")
-    jax.devices()  # force platform init; raises if axon is unavailable
-except Exception:
-    jax.config.update("jax_platforms", "cpu")
+force_cpu_default(min_devices=8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -87,6 +87,45 @@ class TestIfConversion:
         with pytest.raises((NameError, UnboundLocalError)):
             f(jnp.ones((1, 3), jnp.float32))
 
+    def test_read_before_store_unbound_raises_not_zero(self):
+        # both branches ASSIGN y, but one READS it first — with no outer
+        # binding the traced path must raise, not compute with a silent 0
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = y + x.sum()  # noqa: F821 — deliberate unbound read
+            else:
+                y = x.sum()
+            return y
+
+        with pytest.raises(TypeError, match="no prior definition"):
+            f(jnp.ones(3, jnp.float32))
+
+    def test_comprehension_in_branch_not_hoisted(self):
+        # a comprehension's target is comprehension-scoped (py3) — it must
+        # not be treated as a branch-local needing a pre-if definition
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                ys = sum([i * 1.0 for i in range(3)])
+            else:
+                ys = 0.0
+            return x.sum() + ys
+
+        np.testing.assert_allclose(float(f(jnp.ones(3, jnp.float32))), 6.0)
+
+    def test_read_before_store_with_outer_binding_ok(self):
+        @to_static
+        def f(x):
+            y = x.sum()
+            if x.shape[0] > 1:
+                y = y + 1.0
+            else:
+                y = y - 1.0
+            return y
+
+        np.testing.assert_allclose(float(f(jnp.ones(3, jnp.float32))), 4.0)
+
     def test_eager_tensor_condition(self):
         # same source runs eagerly on Tensors (python branch taken)
         def f(x):

@@ -187,6 +187,13 @@ class TestGenerate:
                  for i in range(30)}
         assert draws <= {3, 4}
 
+    def test_top_k_exceeding_vocab_is_clamped(self):
+        from paddle_tpu.generation import _sample, GenerationConfig
+        logits = jnp.asarray(np.array([[0., 1., 2.]], np.float32))
+        cfg = GenerationConfig(do_sample=True, top_k=50, temperature=1.0)
+        tok = _sample(logits, cfg, jax.random.PRNGKey(0))  # must not raise
+        assert 0 <= int(tok[0]) < 3
+
 
 class TestGPTGenerate:
     def test_gpt_greedy_matches_full_forward(self):

@@ -310,6 +310,201 @@ def _tp_last_fn(p, y, lab):
     return _ce(jnp.einsum("btd,dv->btv", y, p["head"][0]), lab)
 
 
+# -- 4-D composition: pp x dp x fsdp x tp, heterogeneous embed/head ----------
+
+def _het_block_params(key, S, d, H, hd, f):
+    """Stage params WITHOUT embed/head slots (heterogeneous stages)."""
+    ks = jax.random.split(key, 6)
+    s_attn, s_ffn = 1 / np.sqrt(d), 1 / np.sqrt(f)
+    return {
+        "wq": jax.random.normal(ks[0], (S, d, H, hd)) * s_attn,
+        "wk": jax.random.normal(ks[1], (S, d, H, hd)) * s_attn,
+        "wv": jax.random.normal(ks[2], (S, d, H, hd)) * s_attn,
+        "wo": jax.random.normal(ks[3], (S, H, hd, d)) * s_attn,
+        "win": jax.random.normal(ks[4], (S, d, f)) * s_attn,
+        "wout": jax.random.normal(ks[5], (S, f, d)) * s_ffn,
+    }
+
+
+def _g_first_fn(p, raw):
+    return p["embed"][raw]
+
+
+def _g_last_fn(p, y, lab):
+    return _ce(jnp.einsum("btd,dv->btv", y, p["head"]), lab)
+
+
+def _serial_het(ps, embed, head, mb_in, mb_lab, S, M):
+    def one(m):
+        x = embed[mb_in[m]]
+        for s in range(S):
+            x = _serial_stage_fn(jax.tree.map(lambda a: a[s], ps), x)
+        return _ce(jnp.einsum("btd,dv->btv", x, head), mb_lab[m])
+    return sum(one(m) for m in range(M)) / M
+
+
+def _4d_fixture(seed=0):
+    S, DP, F, TP, M = 2, 2, 2, 1, 4
+    d, H, hd, f, vocab = 8, 2, 4, 16, 32
+    mbs, T = 4, 6
+    devs = np.array(jax.devices("cpu")[:S * DP * F * TP]).reshape(
+        S, DP, F, TP)
+    mesh = Mesh(devs, ("pp", "dp", "fsdp", "tp"))
+    params = _het_block_params(jax.random.PRNGKey(seed), S, d, H, hd, f)
+    ks = jax.random.split(jax.random.PRNGKey(seed + 100), 2)
+    first = {"embed": jax.random.normal(ks[0], (vocab, d)) * 0.5}
+    last = {"head": jax.random.normal(ks[1], (d, vocab)) * 0.5}
+    specs = {
+        "wq": P("pp", "fsdp", "tp", None), "wk": P("pp", "fsdp", "tp", None),
+        "wv": P("pp", "fsdp", "tp", None), "wo": P("pp", "tp", None, "fsdp"),
+        "win": P("pp", "fsdp", "tp"), "wout": P("pp", "tp", "fsdp"),
+    }
+    first_specs = {"embed": P("fsdp", None)}
+    last_specs = {"head": P(None, "fsdp")}
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (M, mbs, T + 1))
+    mb_in = jnp.asarray(ids[..., :-1], jnp.int32)
+    mb_lab = jnp.asarray(ids[..., 1:], jnp.int32)
+    return (S, M, mesh, params, first, last, specs, first_specs, last_specs,
+            mb_in, mb_lab)
+
+
+@pytest.mark.parametrize("per_tick", [False, True])
+def test_4d_pp_dp_fsdp_parity_with_clip(per_tick):
+    """VERDICT r2 items 3+4 'done' criteria: one jitted program composes
+    pp x dp x fsdp(ZeRO) x tp with heterogeneous embed/head stages (no
+    zero-replicated slots), loss/param parity vs serial, grad clip ON.
+    per_tick=True additionally reduce-scatters grads inside the tick scan
+    (the 70B-scale memory mode) — identical numerics required."""
+    import paddle_tpu as pp_mod
+    (S, M, mesh, params, first, last, specs, first_specs, last_specs,
+     mb_in, mb_lab) = _4d_fixture()
+
+    clip = pp_mod.nn.ClipGradByGlobalNorm(0.5)
+    opt = pp_mod.optimizer.SGD(learning_rate=0.1, grad_clip=clip)
+    step = PipelineTrainStep(
+        _tp_stage_fn, _g_first_fn, _g_last_fn, params, opt, mesh, M, specs,
+        first_params=first, first_specs=first_specs,
+        last_params=last, last_specs=last_specs, remat=True,
+        scatter_grads_per_tick=per_tick)
+
+    # heterogeneous storage: embed/head live once, NOT stacked S-fold
+    assert step.params["first/embed"].shape == first["embed"].shape
+    assert step.params["last/head"].shape == last["head"].shape
+    assert not any(n for n in step.params
+                   if n not in ("first/embed", "last/head")
+                   and first["embed"].shape[0] in step.params[n].shape)
+    # fsdp leaves are STORED sharded (ZeRO): check the placement spec
+    assert "fsdp" in str(step.params["win"].sharding.spec)
+    assert "fsdp" in str(step.params["first/embed"].sharding.spec)
+
+    def serial(ps, emb, hd_, i, l):
+        return _serial_het(ps, emb, hd_, i, l, S, M)
+
+    want0 = float(serial(params, first["embed"], last["head"],
+                         mb_in, mb_lab))
+    loss0 = float(step({"inputs": mb_in, "labels": mb_lab}))
+    np.testing.assert_allclose(loss0, want0, rtol=1e-4)
+
+    # parity of the updated params vs one serial clipped-SGD step
+    g = jax.grad(serial, argnums=(0, 1, 2))(
+        params, first["embed"], last["head"], mb_in, mb_lab)
+    leaves = jax.tree.leaves(g)
+    gnorm = float(np.sqrt(sum(np.sum(np.square(np.asarray(x)))
+                              for x in leaves)))
+    assert gnorm > 0.5, "fixture must actually trigger the clip"
+    scale = 0.5 / gnorm
+    upd = lambda p_, g_: p_ - 0.1 * scale * g_
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(step.params["wq"])),
+        np.asarray(upd(params["wq"], g[0]["wq"])), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(step.params["first/embed"])),
+        np.asarray(upd(first["embed"], g[1])), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(step.params["last/head"])),
+        np.asarray(upd(last["head"], g[2])), rtol=5e-3, atol=5e-4)
+
+    losses = [loss0]
+    for _ in range(4):
+        losses.append(float(step({"inputs": mb_in, "labels": mb_lab})))
+    assert losses[-1] < losses[0], losses
+
+
+def test_3d_pp_dp_tp2_with_group_params_parity():
+    """Group (embed/head) params under tp>1: they stay tp-invariant while
+    stage params are tp-sharded — exercises the uniform-within-tp-group
+    reduction argument in pipeline.py with an actual tp=2 mesh."""
+    import paddle_tpu as pp_mod
+    S, DP, TP, M = 2, 2, 2, 4
+    d, H, hd, f, vocab = 8, 2, 4, 16, 32
+    mbs, T = 4, 6
+    devs = np.array(jax.devices("cpu")[:S * DP * TP]).reshape(S, DP, TP)
+    mesh = Mesh(devs, ("pp", "dp", "tp"))
+    params = _het_block_params(jax.random.PRNGKey(3), S, d, H, hd, f)
+    ks = jax.random.split(jax.random.PRNGKey(103), 2)
+    first = {"embed": jax.random.normal(ks[0], (vocab, d)) * 0.5}
+    last = {"head": jax.random.normal(ks[1], (d, vocab)) * 0.5}
+    specs = {
+        "wq": P("pp", None, "tp", None), "wk": P("pp", None, "tp", None),
+        "wv": P("pp", None, "tp", None), "wo": P("pp", "tp", None, None),
+        "win": P("pp", None, "tp"), "wout": P("pp", "tp", None),
+    }
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, vocab, (M, mbs, T + 1))
+    mb_in = jnp.asarray(ids[..., :-1], jnp.int32)
+    mb_lab = jnp.asarray(ids[..., 1:], jnp.int32)
+
+    opt = pp_mod.optimizer.SGD(learning_rate=0.1)
+    step = PipelineTrainStep(
+        _tp_stage_fn, _g_first_fn, _g_last_fn, params, opt, mesh, M, specs,
+        first_params=first, first_specs={"embed": P()},
+        last_params=last, last_specs={"head": P()}, remat=True)
+
+    def serial(ps, emb, hd_, i, l):
+        return _serial_het(ps, emb, hd_, i, l, S, M)
+
+    want0 = float(serial(params, first["embed"], last["head"],
+                         mb_in, mb_lab))
+    loss0 = float(step({"inputs": mb_in, "labels": mb_lab}))
+    np.testing.assert_allclose(loss0, want0, rtol=1e-4)
+
+    g = jax.grad(serial, argnums=(1, 2))(params, first["embed"],
+                                         last["head"], mb_in, mb_lab)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(step.params["first/embed"])),
+        np.asarray(first["embed"] - 0.1 * g[0]), rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(
+        np.asarray(jax.device_get(step.params["last/head"])),
+        np.asarray(last["head"] - 0.1 * g[1]), rtol=5e-3, atol=5e-4)
+
+
+def test_4d_amp_bf16_master_weights():
+    """AMP-O2 on the pipeline step: bf16 compute params, fp32 master
+    weights in the (fsdp-sharded) optimizer state, loss finite+improving."""
+    import paddle_tpu as pp_mod
+    (S, M, mesh, params, first, last, specs, first_specs, last_specs,
+     mb_in, mb_lab) = _4d_fixture(seed=1)
+
+    opt = pp_mod.optimizer.AdamW(
+        learning_rate=3e-3, multi_precision=True,
+        grad_clip=pp_mod.nn.ClipGradByGlobalNorm(1.0))
+    step = PipelineTrainStep(
+        _tp_stage_fn, _g_first_fn, _g_last_fn, params, opt, mesh, M, specs,
+        first_params=first, first_specs=first_specs,
+        last_params=last, last_specs=last_specs, remat=True,
+        compute_dtype="bfloat16")
+
+    assert step.params["wq"].dtype == jnp.bfloat16
+    st = step.opt_state["wq"]
+    assert st["_master"].dtype == jnp.float32
+
+    losses = [float(step({"inputs": mb_in, "labels": mb_lab}))
+              for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
 def test_3d_pp_dp_tp_llama_block_parity():
     """VERDICT item 4 'done' criterion: 2-stage x 2-dp x 2-tp decoder
     trains via PipelineTrainStep with loss parity vs the serial model."""
