@@ -52,34 +52,32 @@ def top_k_gating(gate_logits, k: int, capacity: int,
         gate_logits = gate_logits * noise
     probs = jax.nn.softmax(gate_logits, axis=-1)          # [T, E]
 
-    combine = jnp.zeros((tokens, E, capacity), probs.dtype)
-    dispatch = jnp.zeros((tokens, E, capacity), bool)
-    # running per-expert fill count, updated between the k passes
-    fill = jnp.zeros((E,), jnp.int32)
-    masked_probs = probs
-    aux_mask = jnp.zeros((tokens, E), probs.dtype)
-
-    for _ in range(k):
-        choice = jnp.argmax(masked_probs, axis=-1)        # [T]
-        onehot = jax.nn.one_hot(choice, E, dtype=probs.dtype)
-        aux_mask = aux_mask + onehot
-        # position of each token within its chosen expert's queue
-        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
-        pos = pos + fill[None, :] * onehot
-        in_cap = (pos < capacity) & (onehot > 0)
-        gate_val = (probs * onehot).sum(-1)               # [T]
-        pos_idx = pos.sum(-1).astype(jnp.int32)           # [T]
-        cap_onehot = jax.nn.one_hot(pos_idx, capacity,
-                                    dtype=probs.dtype)    # [T, C]
-        sel = in_cap.any(-1)
-        combine = combine + (gate_val[:, None, None]
-                             * onehot[:, :, None]
-                             * cap_onehot[:, None, :]
-                             * sel[:, None, None])
-        dispatch = dispatch | ((onehot[:, :, None] * cap_onehot[:, None, :])
-                               > 0) & sel[:, None, None]
-        fill = fill + (onehot * in_cap).sum(0).astype(jnp.int32)
-        masked_probs = masked_probs * (1.0 - onehot)      # exclude chosen
+    # fully vectorized (no Python loop over k): lax.top_k selects the same
+    # experts k sequential argmax passes would; queue positions come from
+    # one cumsum over the k-major flattening (all 1st choices in token
+    # order, then all 2nd choices, ...).  Standard GShard bookkeeping: an
+    # over-capacity assignment still occupies its position number, so
+    # under overflow a later-rank choice may be pushed past capacity where
+    # the earlier k-pass implementation (which recycled dropped slots
+    # between passes) would have admitted it — slightly more conservative,
+    # identical whenever capacity is not exceeded (and always under
+    # dropless).
+    k = min(k, E)  # degenerate configs (fewer experts than choices)
+    topv, topi = jax.lax.top_k(probs, k)                  # [T, k]
+    onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)   # [T, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(k * tokens, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat
+    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)  # [T, k, E]
+    in_cap = (pos < capacity) & (onehot > 0)              # [T, k, E]
+    slot = (pos * onehot).sum(-1).astype(jnp.int32)       # [T, k]
+    cap_onehot = jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
+                                dtype=probs.dtype)        # [T, k, C]
+    sel = in_cap.any(-1).astype(probs.dtype)              # [T, k]
+    combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
+                         topv * sel)
+    dispatch = jnp.einsum("tke,tkc->tec",
+                          onehot * in_cap.astype(probs.dtype),
+                          cap_onehot) > 0
 
     # normalise combine weights over the k experts per token
     denom = combine.sum(axis=(1, 2), keepdims=True)
@@ -88,7 +86,7 @@ def top_k_gating(gate_logits, k: int, capacity: int,
 
     # GShard load-balance loss: E * mean_e(frac_tokens_e * mean_prob_e)
     me = probs.mean(axis=0)                               # [E]
-    ce = (aux_mask > 0).astype(probs.dtype).mean(axis=0) / k
+    ce = (onehot.sum(1) > 0).astype(probs.dtype).mean(axis=0) / k
     aux_loss = (me * ce).sum() * E
     return combine, dispatch, aux_loss
 
@@ -179,11 +177,21 @@ def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
       w1/b1/w2/b2: LOCAL expert slices [E_loc, ...] (ep-sharded).
       capacity: per (source shard, expert) buffer slots.
     Returns:
-      out: [T_loc, d]; aux: global mean load-balance loss.
+      out: [T_loc, d]; aux: global mean load-balance loss;
+      dropped_frac: fraction of (token, choice) assignments dropped by
+      the capacity bound, pmean'd over ep (0.0 when capacity covers every
+      local token, i.e. dropless).
     """
     act = activation or jax.nn.gelu
     logits = x2d @ gate_w                                     # [T_loc, E]
     combine, dispatch, aux = top_k_gating(logits, k=top_k, capacity=capacity)
+    # honesty accounting: fraction of (token, choice) assignments dropped
+    # by the capacity bound, pmean'd over ep (0.0 when dropless=True —
+    # capacity == tokens-per-shard can never overflow since one token
+    # dispatches to k DISTINCT experts)
+    total = x2d.shape[0] * top_k
+    dropped_frac = jax.lax.pmean(
+        1.0 - dispatch.sum().astype(jnp.float32) / total, ep_axis)
 
     buf = jnp.einsum("tec,td->ecd", dispatch.astype(x2d.dtype), x2d)
     # [E, C, d] -> split experts to their shards, gather source chunks:
@@ -195,18 +203,22 @@ def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
     back = jax.lax.all_to_all(out_loc, ep_axis, split_axis=1, concat_axis=0,
                               tiled=True)
     out = jnp.einsum("tec,ecd->td", combine.astype(x2d.dtype), back)
-    return out, jax.lax.pmean(aux, ep_axis)
+    return out, jax.lax.pmean(aux, ep_axis), dropped_frac
 
 
 def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
                     capacity_factor: float = 1.25, dropless: bool = False,
-                    activation=None, ep_axis: str = "ep"):
+                    activation=None, ep_axis: str = "ep",
+                    with_stats: bool = False):
     """Jit-callable wrapper: shard_maps :func:`moe_shard_a2a` over the ep
     axis of ``mesh``.
 
     x: [B, S, d] — flattened to [B*S, d] and sharded on the token axis
     (constraint: B*S divisible by the ep mesh size); expert weights
-    [E, ...] sharded on ep (E divisible by ep size); gate replicated."""
+    [E, ...] sharded on ep (E divisible by ep size); gate replicated.
+    ``with_stats=True`` additionally returns the dropped-assignment
+    fraction (always 0.0 under dropless) so capacity pressure is never
+    silent."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -235,8 +247,10 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
         fn, mesh=mesh,
         in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis), P(ep_axis),
                   P(ep_axis)),
-        out_specs=(P(ep_axis), P()))
-    out, aux = mapped(x2d, gate_w, w1, b1, w2, b2)
+        out_specs=(P(ep_axis), P(), P()))
+    out, aux, dropped = mapped(x2d, gate_w, w1, b1, w2, b2)
+    if with_stats:
+        return out.reshape(shape), aux, dropped
     return out.reshape(shape), aux
 
 
@@ -281,6 +295,7 @@ class MoELayer(Layer):
         self.experts = experts or ExpertFFN(
             num_experts, d_model, d_hidden or 4 * d_model, ep_axis=ep_axis)
         self.aux_loss = None
+        self.router_stats = None  # {"dropped_frac": ...} after forward
 
     def forward(self, x):
         """NOTE: the gating/dispatch math runs on raw traced values — the
@@ -297,15 +312,17 @@ class MoELayer(Layer):
             if not isinstance(self.experts, ExpertFFN):
                 raise ValueError("all_to_all dispatch requires the stacked "
                                  "ExpertFFN experts")
-            out, aux = moe_forward_a2a(
+            out, aux, dropped = moe_forward_a2a(
                 data, unwrap(self.gate.gate),
                 unwrap(self.experts.w1), unwrap(self.experts.b1),
                 unwrap(self.experts.w2), unwrap(self.experts.b2),
                 mesh=self.mesh, top_k=self.gate.top_k,
                 capacity_factor=self.capacity_factor,
                 dropless=self.dropless, ep_axis=self.ep_axis,
-                activation=lambda v: unwrap(self.experts.activation(v)))
+                activation=lambda v: unwrap(self.experts.activation(v)),
+                with_stats=True)
             self.aux_loss = aux
+            self.router_stats = {"dropped_frac": dropped}
             if hasattr(x, "_data"):
                 from paddle_tpu.core.tensor import Tensor
                 t = Tensor(out)
@@ -330,6 +347,8 @@ class MoELayer(Layer):
         combine, dispatch, aux = top_k_gating(
             logits, k=self.gate.top_k, capacity=capacity)
         self.aux_loss = aux
+        self.router_stats = {"dropped_frac": 1.0 - dispatch.sum().astype(
+            jnp.float32) / (T * self.gate.top_k)}
 
         # dispatch: [T,E,C] x [T,d] -> [E,C,d]; GSPMD lowers the contraction
         # to the expert all_to_all when E is sharded on ep

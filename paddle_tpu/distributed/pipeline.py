@@ -988,7 +988,12 @@ class PipelineTrainStep(_TrainStepBase):
         — grad clip and fp32 master weights ride along exactly as in
         ``jit.TrainStep``; pass ``compute_dtype='bfloat16'`` for AMP-O2).
       batch: step() takes {'inputs': [M, mb, ...], 'labels': [M, mb, ...]};
-        the microbatch axis is split over dp×fsdp.
+        the microbatch axis is split over dp×fsdp (× any extra_data_axes).
+      extra_data_axes: additional mesh axes the batch is split over — pass
+        ``('ep',)`` when the stage runs an all_to_all MoE, so the
+        expert-parallel group doubles as a data-parallel group (the
+        reference's dp×ep overlap); loss averaging and grad normalization
+        account for them automatically.
     """
 
     def __init__(self, stage_fn, first_fn, last_fn, stacked_params,
@@ -997,7 +1002,8 @@ class PipelineTrainStep(_TrainStepBase):
                  fsdp_axis: Optional[str] = "fsdp", remat: bool = True,
                  first_params=None, first_specs=None,
                  last_params=None, last_specs=None, compute_dtype=None,
-                 scatter_grads_per_tick: bool = False):
+                 scatter_grads_per_tick: bool = False,
+                 extra_data_axes=()):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         self.mesh = mesh
@@ -1038,152 +1044,190 @@ class PipelineTrainStep(_TrainStepBase):
         param_sh = {n: NamedSharding(mesh, specs[n]) for n in flat}
         self._init_step_state(optimizer, flat, param_sh)
 
-        manual = set(mesh.axis_names)
-        fsdp = self._fsdp
-
-        def split(params):
-            stage, first, last = {}, {}, {}
-            for n, v in params.items():
-                if n.startswith("first/"):
-                    first[n[6:]] = v
-                elif n.startswith("last/"):
-                    last[n[5:]] = v
-                else:
-                    stage[n] = v
-            return (stage, first if has_first else None,
-                    last if has_last else None)
-
-        def gather_tree(tree, prefix=""):
-            # ZeRO-3: materialize full (per-stage) values of fsdp-sharded
-            # leaves; the matching reduce-scatter runs on the grads below
-            if tree is None or fsdp is None:
-                return tree
-            out = {}
-            for n, v in tree.items():
-                pos = _spec_axis_pos(specs[prefix + n], fsdp)
-                out[n] = v if pos is None else lax.all_gather(
-                    v, fsdp, axis=pos, tiled=True)
-            return out
-
-        def scatter_tree(tree, prefix=""):
-            if tree is None or fsdp is None:
-                return tree
-            out = {}
-            for n, g in tree.items():
-                pos = _spec_axis_pos(specs[prefix + n], fsdp)
-                out[n] = g if pos is None else lax.psum_scatter(
-                    g, fsdp, scatter_dimension=pos, tiled=True)
-            return out
-
-        def reduce_leaf(g, spec, exclude=()):
-            # vma cleanup: pmean over any axis the grad still varies on
-            # but its out_spec omits (values already equal across them)
-            present = _spec_axes(spec)
-            vma = getattr(jax.typeof(g), "vma", None) or ()
-            for ax in manual - present - set(exclude):
-                if ax in vma:
-                    g = lax.pmean(g, ax)
-            return g
-
-        per_tick = scatter_grads_per_tick and fsdp is not None
-
-        def tick_reduce(tree):
-            # keep the scan's grad accumulator ZeRO-sharded: reduce-scatter
-            # each tick's contribution instead of accumulating full-size
-            return scatter_tree(tree)
-
-        def body(params, mb_inputs, mb_labels):
-            stage_p, first_p, last_p = split(params)
-            out = pipeline_1f1b(
-                stage_fn, first_fn, last_fn, gather_tree(stage_p),
-                mb_inputs, mb_labels,
-                num_microbatches=num_microbatches, axis_name=pp_axis,
-                remat=remat,
-                first_params=gather_tree(first_p, "first/"),
-                last_params=gather_tree(last_p, "last/"),
-                stage_grad_reduce=tick_reduce if per_tick else None)
-            if has_first or has_last:
-                loss, (g_stage, g_first, g_last) = out
-            else:
-                loss, g_stage = out
-                g_first = g_last = None
-
-            # data semantics: each of the D = dp*fsdp data shards computed
-            # the mean loss of ITS microbatch slice; the vjp transpose
-            # already psum'd grads over axes the params are INVARIANT on
-            # (dp always; fsdp for non-fsdp-sharded leaves), and the
-            # reduce-scatter below sums the fsdp-sharded ones — so a
-            # uniform 1/D turns every leaf into the global-batch mean.
-            d_total = 1
-            for ax in data_axes:
-                d_total *= lax.axis_size(ax)
-            scale = 1.0 / d_total
-            norm = lambda tr: None if tr is None else jax.tree.map(
-                lambda g: g * scale, tr)
-            g_stage, g_first, g_last = norm(g_stage), norm(g_first), \
-                norm(g_last)
-            for ax in data_axes:
-                loss = lax.pmean(loss, ax)
-            vma_l = getattr(jax.typeof(loss), "vma", None) or ()
-            for ax in manual - set(data_axes):
-                if ax in vma_l:  # e.g. tp: equal across shards, clean vma
-                    loss = lax.pmean(loss, ax)
-
-            if not per_tick:  # already reduce-scattered inside the ticks
-                g_stage = scatter_tree(g_stage)
-
-            def group_reduce(tr, prefix):
-                # group grads come back as per-device partial sums over
-                # the data axes (their params were pvary'd — see
-                # pipeline_1f1b); reduce them explicitly here, OUTSIDE any
-                # divergent control flow: sum over dp, sum(+shard) over
-                # fsdp.  tp shards hold equal values — reduce_leaf's
-                # pmean cleans that vma up below.
-                if tr is None:
-                    return None
-                out = {}
-                for n, g in tr.items():
-                    if self._dp:
-                        g = lax.psum(g, self._dp)
-                    if fsdp:
-                        pos = _spec_axis_pos(specs[prefix + n], fsdp)
-                        g = lax.psum(g, fsdp) if pos is None else \
-                            lax.psum_scatter(g, fsdp,
-                                             scatter_dimension=pos,
-                                             tiled=True)
-                    out[n] = g
-                return out
-
-            g_first = group_reduce(g_first, "first/")
-            g_last = group_reduce(g_last, "last/")
-
-            merged = {n: reduce_leaf(g, specs[n], exclude=(pp_axis,))
-                      for n, g in g_stage.items()}
-            for prefix, tr in (("first/", g_first), ("last/", g_last)):
-                if tr is not None:
-                    for n, g in tr.items():
-                        merged[prefix + n] = reduce_leaf(
-                            g, specs[prefix + n])
-            return loss, merged
-
-        batch_spec = P(None, data_axes) if data_axes else P()
-        self._shmap = jax.shard_map(
-            body, mesh=mesh,
-            in_specs=({n: specs[n] for n in self.params},
-                      batch_spec, batch_spec),
-            out_specs=(P(), {n: specs[n] for n in self.params}))
-
-        def step_impl(params, opt_state, step_count, mb_inputs, mb_labels,
-                      lr):
-            loss, grads = self._shmap(params, mb_inputs, mb_labels)
-            step_count = step_count + 1
-            new_params, new_state = optimizer.apply_gradients(
-                params, grads, opt_state, step_count, lr=lr)
-            return loss, new_params, new_state, step_count
-
-        self._jitted = jax.jit(step_impl, donate_argnums=(0, 1, 2))
+        self._jitted = jax.jit(
+            build_pipeline_step_fn(
+                stage_fn, first_fn, last_fn, optimizer, mesh,
+                num_microbatches, specs, pp_axis=pp_axis, dp_axis=self._dp,
+                fsdp_axis=self._fsdp, remat=remat, has_first=has_first,
+                has_last=has_last,
+                scatter_grads_per_tick=scatter_grads_per_tick,
+                extra_data_axes=extra_data_axes),
+            donate_argnums=(0, 1, 2))
 
     def __call__(self, batch):
         mb_inputs = jnp.asarray(batch["inputs"])
         mb_labels = jnp.asarray(batch["labels"])
         return self._run_jitted(mb_inputs, mb_labels)
+
+
+def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
+                           num_microbatches, specs, *, pp_axis="pp",
+                           dp_axis=None, fsdp_axis=None, remat=True,
+                           has_first=False, has_last=False,
+                           scatter_grads_per_tick=False,
+                           extra_data_axes=()):
+    """The pure 4-D training-step function behind ``PipelineTrainStep``:
+    ``step(params, opt_state, step_count, mb_inputs, mb_labels, lr) ->
+    (loss, params, opt_state, step_count)``.
+
+    Factored out so callers that never materialize arrays (the capacity
+    planner's abstract AOT lowering) compile the exact same program the
+    real training step runs.  ``specs`` is the flat dict (stage names
+    plus "first/"/"last/" prefixed group names) of PartitionSpecs; dp/fsdp
+    axis names must already be filtered against the mesh (None = absent).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    manual = set(mesh.axis_names)
+    fsdp = fsdp_axis
+    # extra_data_axes: additional mesh axes the batch is split over (e.g.
+    # 'ep' when the stage runs an all_to_all MoE — the expert-parallel
+    # group doubles as a data-parallel group for the non-expert params,
+    # exactly the reference's dp×ep overlap).  Treated like dp for loss
+    # averaging and grad normalization; ep-SHARDED expert leaves come back
+    # complete from the a2a transpose and need no extra reduction.
+    data_axes = tuple(a for a in (dp_axis, fsdp_axis) if a) + \
+        tuple(a for a in extra_data_axes if a in manual)
+
+    def split(params):
+        stage, first, last = {}, {}, {}
+        for n, v in params.items():
+            if n.startswith("first/"):
+                first[n[6:]] = v
+            elif n.startswith("last/"):
+                last[n[5:]] = v
+            else:
+                stage[n] = v
+        return (stage, first if has_first else None,
+                last if has_last else None)
+
+    def gather_tree(tree, prefix=""):
+        # ZeRO-3: materialize full (per-stage) values of fsdp-sharded
+        # leaves; the matching reduce-scatter runs on the grads below
+        if tree is None or fsdp is None:
+            return tree
+        out = {}
+        for n, v in tree.items():
+            pos = _spec_axis_pos(specs[prefix + n], fsdp)
+            out[n] = v if pos is None else lax.all_gather(
+                v, fsdp, axis=pos, tiled=True)
+        return out
+
+    def scatter_tree(tree, prefix=""):
+        if tree is None or fsdp is None:
+            return tree
+        out = {}
+        for n, g in tree.items():
+            pos = _spec_axis_pos(specs[prefix + n], fsdp)
+            out[n] = g if pos is None else lax.psum_scatter(
+                g, fsdp, scatter_dimension=pos, tiled=True)
+        return out
+
+    def reduce_leaf(g, spec, exclude=()):
+        # vma cleanup: pmean over any axis the grad still varies on
+        # but its out_spec omits (values already equal across them)
+        present = _spec_axes(spec)
+        vma = getattr(jax.typeof(g), "vma", None) or ()
+        for ax in manual - present - set(exclude):
+            if ax in vma:
+                g = lax.pmean(g, ax)
+        return g
+
+    per_tick = scatter_grads_per_tick and fsdp is not None
+
+    def tick_reduce(tree):
+        # keep the scan's grad accumulator ZeRO-sharded: reduce-scatter
+        # each tick's contribution instead of accumulating full-size
+        return scatter_tree(tree)
+
+    def body(params, mb_inputs, mb_labels):
+        stage_p, first_p, last_p = split(params)
+        out = pipeline_1f1b(
+            stage_fn, first_fn, last_fn, gather_tree(stage_p),
+            mb_inputs, mb_labels,
+            num_microbatches=num_microbatches, axis_name=pp_axis,
+            remat=remat,
+            first_params=gather_tree(first_p, "first/"),
+            last_params=gather_tree(last_p, "last/"),
+            stage_grad_reduce=tick_reduce if per_tick else None)
+        if has_first or has_last:
+            loss, (g_stage, g_first, g_last) = out
+        else:
+            loss, g_stage = out
+            g_first = g_last = None
+
+        # data semantics: each of the D = dp*fsdp data shards computed
+        # the mean loss of ITS microbatch slice; the vjp transpose
+        # already psum'd grads over axes the params are INVARIANT on
+        # (dp always; fsdp for non-fsdp-sharded leaves), and the
+        # reduce-scatter below sums the fsdp-sharded ones — so a
+        # uniform 1/D turns every leaf into the global-batch mean.
+        d_total = 1
+        for ax in data_axes:
+            d_total *= lax.axis_size(ax)
+        scale = 1.0 / d_total
+        norm = lambda tr: None if tr is None else jax.tree.map(
+            lambda g: g * scale, tr)
+        g_stage, g_first, g_last = norm(g_stage), norm(g_first), \
+            norm(g_last)
+        for ax in data_axes:
+            loss = lax.pmean(loss, ax)
+        vma_l = getattr(jax.typeof(loss), "vma", None) or ()
+        for ax in manual - set(data_axes):
+            if ax in vma_l:  # e.g. tp: equal across shards, clean vma
+                loss = lax.pmean(loss, ax)
+
+        if not per_tick:  # already reduce-scattered inside the ticks
+            g_stage = scatter_tree(g_stage)
+
+        def group_reduce(tr, prefix):
+            # group grads come back as per-device partial sums over
+            # the data axes (their params were pvary'd — see
+            # pipeline_1f1b); reduce them explicitly here, OUTSIDE any
+            # divergent control flow: sum over dp, sum(+shard) over
+            # fsdp.  tp shards hold equal values — reduce_leaf's
+            # pmean cleans that vma up below.
+            if tr is None:
+                return None
+            out = {}
+            for n, g in tr.items():
+                for ax in data_axes:
+                    if ax != fsdp and ax in (
+                            getattr(jax.typeof(g), "vma", None) or ()):
+                        g = lax.psum(g, ax)
+                if fsdp:
+                    pos = _spec_axis_pos(specs[prefix + n], fsdp)
+                    g = lax.psum(g, fsdp) if pos is None else \
+                        lax.psum_scatter(g, fsdp,
+                                         scatter_dimension=pos,
+                                         tiled=True)
+                out[n] = g
+            return out
+
+        g_first = group_reduce(g_first, "first/")
+        g_last = group_reduce(g_last, "last/")
+
+        merged = {n: reduce_leaf(g, specs[n], exclude=(pp_axis,))
+                  for n, g in g_stage.items()}
+        for prefix, tr in (("first/", g_first), ("last/", g_last)):
+            if tr is not None:
+                for n, g in tr.items():
+                    merged[prefix + n] = reduce_leaf(
+                        g, specs[prefix + n])
+        return loss, merged
+
+    batch_spec = P(None, data_axes) if data_axes else P()
+    shmap = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(dict(specs), batch_spec, batch_spec),
+        out_specs=(P(), dict(specs)))
+
+    def step_impl(params, opt_state, step_count, mb_inputs, mb_labels,
+                  lr):
+        loss, grads = shmap(params, mb_inputs, mb_labels)
+        step_count = step_count + 1
+        new_params, new_state = optimizer.apply_gradients(
+            params, grads, opt_state, step_count, lr=lr)
+        return loss, new_params, new_state, step_count
+
+    return step_impl

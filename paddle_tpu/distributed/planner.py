@@ -1,0 +1,672 @@
+"""Flagship-config capacity planner: AOT-compile the REAL training configs
+on a virtual device mesh and report per-device memory from XLA's buffer
+assignment — proof that the 4-D recipe actually fits the target hardware,
+not just that a small proxy trains.
+
+Reference role: the fleet 4-D hybrid recipe
+(fleet/base/topology.py:54 ``["data", "pipe", "sharding", "model"]`` +
+fleet/meta_parallel/) plus the capacity arithmetic PaddleNLP users do by
+hand.  TPU-native: the whole train step (1F1B pipeline + ZeRO gather/
+scatter + tp-Megatron blocks + AdamW-with-master update) is ONE jitted
+program, so ``jax.jit(step).lower(avals).compile().memory_analysis()``
+yields the compiler's own per-device peak-memory figure for ANY mesh
+shape — no hardware needed.  Params are never materialized: lowering runs
+on ``jax.ShapeDtypeStruct`` avals with ``NamedSharding`` attached, and
+the step function is the very same ``build_pipeline_step_fn`` product the
+real ``PipelineTrainStep`` jits.
+
+Caveat: the figure comes from this host's backend buffer assignment of
+the SPMD-partitioned module (CPU when run on the virtual mesh).  Same
+HLO, different scheduler than the TPU compiler — treat it as a capacity
+estimate for "does 70B fit a v5p-64?" questions, not kB-accurate
+accounting.
+
+Usage::
+
+    from paddle_tpu.distributed.planner import plan_llama, LLAMA3_8B
+    report = plan_llama(LLAMA3_8B, pp=4, dp=2, fsdp=8, tp=1, seq=8192)
+    assert report.fits(hbm_gb=95)   # v5p HBM
+
+CLI (needs the virtual devices BEFORE jax init)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=64 \
+        python -m paddle_tpu.distributed.planner \
+        --config llama3-8b --pp 4 --dp 2 --fsdp 8 --tp 1
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["plan_llama", "plan_moe", "PlanReport",
+           "LLAMA3_8B", "LLAMA3_70B", "DEEPSEEK_MOE_16B", "CONFIGS"]
+
+
+# -- configs (public architecture numbers) -----------------------------------
+
+@dataclass(frozen=True)
+class DenseConfig:
+    name: str
+    vocab: int
+    d: int
+    ffn: int
+    layers: int
+    heads: int
+    kv_heads: int
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    name: str
+    vocab: int
+    d: int
+    layers: int
+    heads: int
+    n_experts: int          # routed (fine-grained) experts
+    n_shared: int           # always-on shared experts
+    top_k: int
+    expert_ffn: int         # per-expert hidden size
+
+
+LLAMA3_8B = DenseConfig("llama3-8b", vocab=128256, d=4096, ffn=14336,
+                        layers=32, heads=32, kv_heads=8)
+LLAMA3_70B = DenseConfig("llama3-70b", vocab=128256, d=8192, ffn=28672,
+                         layers=80, heads=64, kv_heads=8)
+DEEPSEEK_MOE_16B = MoEConfig("deepseek-moe-16b", vocab=102400, d=2048,
+                             layers=28, heads=16, n_experts=64, n_shared=2,
+                             top_k=6, expert_ffn=1408)
+CONFIGS = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, DEEPSEEK_MOE_16B)}
+
+
+@dataclass
+class PlanReport:
+    """Per-device memory estimate for one (config, mesh) point.
+
+    ``resident_bytes`` is exact-by-construction: XLA's buffer assignment
+    for the arguments (sharded bf16 params + fp32 master/m/v optimizer
+    state + batch) of the compiled SPMD program.  ``transient_bytes`` is
+    an ANALYTIC estimate of the in-step working set (ZeRO weight gathers,
+    pipeline boundary banks, grad accumulators, remat recompute buffers)
+    — the host backend's own temp figure is also recorded but its
+    scheduler differs too much from the TPU compiler's to assert against
+    (it does not reuse scan-body buffers in the assignment accounting).
+    """
+    config: str
+    mesh: dict
+    n_devices: int
+    params_total: int               # parameter count (global)
+    resident_bytes: int             # XLA argument assignment, per device
+    transient_bytes: int            # analytic working-set estimate
+    host_temp_bytes: int            # host backend temp (diagnostic only)
+    seq: int
+    microbatch: int
+    num_microbatches: int
+
+    @property
+    def peak_bytes_per_device(self) -> int:
+        return self.resident_bytes + self.transient_bytes
+
+    def fits(self, hbm_gb: float) -> bool:
+        return self.peak_bytes_per_device < hbm_gb * (1 << 30)
+
+    def summary(self) -> str:
+        gb = 1 << 30
+        return (f"{self.config} on {self.mesh} ({self.n_devices} devices): "
+                f"{self.params_total / 1e9:.2f}B params, per-device "
+                f"{self.peak_bytes_per_device / gb:.2f} GiB "
+                f"(resident {self.resident_bytes / gb:.2f} + transient "
+                f"{self.transient_bytes / gb:.2f})")
+
+
+# -- functional Llama pipeline spec ------------------------------------------
+# Written directly against stacked per-stage param arrays (the layout
+# PipelineTrainStep consumes), Megatron-style on local tp shards.
+
+def _rmsnorm(x, w, eps=1e-5):
+    import jax.numpy as jnp
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1,
+                   keepdims=True)
+    inv = jnp.reciprocal(jnp.sqrt(var + eps)).astype(x.dtype)
+    return x * inv * w
+
+
+def _rope(x, theta=500000.0):
+    """x [mb, s, h, hd] -> rotary-embedded, positions 0..s-1."""
+    import jax.numpy as jnp
+    s, hd = x.shape[1], x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-np.arange(0, half) / half)
+    ang = jnp.arange(s)[:, None] * freqs[None, :]          # [s, half]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def _causal_attention_chunked(q, k, v, q_block=512):
+    """Memory-bounded causal attention via a scan over q blocks (the TPU
+    path uses the Pallas flash kernel; this blockwise form keeps the
+    PLANNER's lowering honest about activation memory instead of
+    materializing [mb, h, s, s]).  q,k,v: [mb, s, h, hd] with h already
+    GQA-expanded local heads."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mb, s, h, hd = q.shape
+    scale = 1.0 / math.sqrt(hd)
+    qb = min(q_block, s)
+    nblk = (s + qb - 1) // qb
+    pad = nblk * qb - s
+    if pad:
+        q = jnp.pad(q, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    qr = jnp.moveaxis(q.reshape(mb, nblk, qb, h, hd), 1, 0)
+    kT = k.swapaxes(1, 2)          # [mb, h, s, hd]
+    vT = v.swapaxes(1, 2)
+
+    def one_block(i, qi):
+        qi = qi.swapaxes(1, 2)                     # [mb, h, qb, hd]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qi, kT) * scale
+        qpos = i * qb + jnp.arange(qb)[None, None, :, None]
+        kpos = jnp.arange(s)[None, None, None, :]
+        scores = jnp.where(kpos <= qpos, scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), vT)
+        return i + 1, out
+
+    _, outs = lax.scan(one_block, 0, qr)           # [nblk, mb, h, qb, hd]
+    out = jnp.moveaxis(outs, 0, 3)                 # [mb, h, qb, nblk, hd]
+    out = out.swapaxes(2, 3).reshape(mb, h, nblk * qb, hd)
+    return out[:, :, :s].swapaxes(1, 2)            # [mb, s, h, hd]
+
+
+def _llama_block(cfg: DenseConfig, x, lp):
+    """One decoder block on LOCAL tp shards; psum over 'tp' on the two
+    row-parallel projections (mpu contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    groups = cfg.heads // cfg.kv_heads
+    h = _rmsnorm(x, lp["ln1"])
+    q = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wq"]))
+    k = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wk"]))
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    k = jnp.repeat(k, groups, axis=2)
+    v = jnp.repeat(v, groups, axis=2)
+    attn = _causal_attention_chunked(q, k, v)
+    x = x + jax.lax.psum(jnp.einsum("bshk,hkd->bsd", attn, lp["wo"]), "tp")
+    h2 = _rmsnorm(x, lp["ln2"])
+    gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h2, lp["w1"]))
+    up = jnp.einsum("bsd,df->bsf", h2, lp["w3"])
+    x = x + jax.lax.psum(jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"]),
+                         "tp")
+    return x
+
+
+def _llama_stage_fn(cfg: DenseConfig):
+    def stage_fn(p, x):
+        import jax
+        from jax import lax
+
+        from paddle_tpu.distributed.pipeline import _pvary_axes
+
+        layers = jax.tree.map(lambda a: a[0], p)   # drop pp remnant axis
+        # align the scan carry's varying-axes with the layer params' (the
+        # block output inherits the params' pp/fsdp variance) — but NOT
+        # tp: the Megatron contract keeps activations tp-invariant (every
+        # tp-varying product is closed by an explicit psum in the block)
+        axes = set()
+        for v in jax.tree.leaves(layers):
+            axes |= set(getattr(jax.typeof(v), "vma", None) or ())
+        axes -= {"tp"}
+        x = _pvary_axes(x, axes - set(getattr(jax.typeof(x), "vma",
+                                              None) or ()))
+
+        def blk(xc, lp):
+            return _llama_block(cfg, xc, lp), None
+
+        x, _ = lax.scan(blk, x, layers)            # scan over Lps layers
+        return x
+    return stage_fn
+
+
+def _llama_first_fn(p, raw):
+    return p["embed"][raw]
+
+
+def vocab_parallel_ce(logits_local, labels, axis="tp"):
+    """Cross-entropy over vocab-sharded logits (mpu ParallelCrossEntropy
+    pattern, reused by the planner's tp-sharded head)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    vt = logits_local.shape[-1]
+    lf = logits_local.astype(jnp.float32)
+    # no size-1 shortcut: the psums below are no-ops then, and they also
+    # clean the vma (a skipped collective would leave the loss marked
+    # varying over a tp axis the cond's other branch never touches)
+    off = lax.axis_index(axis) * vt
+    # max is for numerical stability only — stop the gradient on the
+    # INPUT (pmax has no differentiation rule, so it must see no tracer)
+    mx = lax.pmax(jnp.max(lax.stop_gradient(lf), axis=-1), axis)
+    se = lax.psum(jnp.sum(jnp.exp(lf - mx[..., None]), axis=-1), axis)
+    lse = jnp.log(se) + mx
+    local = (labels >= off) & (labels < off + vt)
+    idx = jnp.clip(labels - off, 0, vt - 1)
+    gold_l = jnp.where(local,
+                       jnp.take_along_axis(lf, idx[..., None],
+                                           axis=-1).squeeze(-1), 0.0)
+    gold = lax.psum(gold_l, axis)
+    return jnp.mean(lse - gold)
+
+
+def _llama_last_fn(p, y, lab):
+    import jax.numpy as jnp
+    h = _rmsnorm(y, p["ln_f"])
+    logits = jnp.einsum("bsd,dv->bsv", h, p["head"])
+    return vocab_parallel_ce(logits, lab)
+
+
+def llama_pipeline_avals(cfg: DenseConfig, S: int, dtype="bfloat16"):
+    """(stage_avals, first_avals, last_avals, specs, first_specs,
+    last_specs, n_params) — the stacked [S, Lps, ...] layout + 4-D specs
+    the pipeline step consumes, as avals (nothing materialized)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    if cfg.layers % S:
+        raise ValueError(f"{cfg.layers} layers not divisible by pp={S}")
+    L = cfg.layers // S
+    hd = cfg.d // cfg.heads
+    d, f, H, Hk, V = cfg.d, cfg.ffn, cfg.heads, cfg.kv_heads, cfg.vocab
+    dt = jnp.dtype(dtype)
+    mk = lambda *shape: jax.ShapeDtypeStruct((S, L) + shape, dt)
+    stage = {
+        "ln1": mk(d), "ln2": mk(d),
+        "wq": mk(d, H, hd), "wk": mk(d, Hk, hd), "wv": mk(d, Hk, hd),
+        "wo": mk(H, hd, d),
+        "w1": mk(d, f), "w3": mk(d, f), "w2": mk(f, d),
+    }
+    specs = {
+        "ln1": P("pp", None, None), "ln2": P("pp", None, None),
+        "wq": P("pp", None, "fsdp", "tp", None),
+        "wk": P("pp", None, "fsdp", "tp", None),
+        "wv": P("pp", None, "fsdp", "tp", None),
+        "wo": P("pp", None, "tp", None, "fsdp"),
+        "w1": P("pp", None, "fsdp", "tp"),
+        "w3": P("pp", None, "fsdp", "tp"),
+        "w2": P("pp", None, "tp", "fsdp"),
+    }
+    first = {"embed": jax.ShapeDtypeStruct((V, d), dt)}
+    first_specs = {"embed": P("fsdp", None)}
+    last = {"head": jax.ShapeDtypeStruct((d, V), dt),
+            "ln_f": jax.ShapeDtypeStruct((d,), dt)}
+    last_specs = {"head": P("fsdp", "tp"), "ln_f": P()}
+    n_params = (S * L * (2 * d + d * H * hd + 2 * d * Hk * hd + H * hd * d
+                         + 3 * d * f) + 2 * V * d + d)
+    return stage, first, last, specs, first_specs, last_specs, n_params
+
+
+# -- the abstract-lowering harness -------------------------------------------
+
+def _lower_pipeline_step(stage_fn, first_fn, last_fn, stage_avals,
+                         first_avals, last_avals, specs, first_specs,
+                         last_specs, mesh, M, optimizer, batch_shape, *,
+                         scatter_grads_per_tick=True, remat=True):
+    """Lower the exact PipelineTrainStep program on avals."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.pipeline import build_pipeline_step_fn
+
+    flat_avals, flat_specs = {}, {}
+    for n, a in stage_avals.items():
+        flat_avals[n] = a
+        flat_specs[n] = specs[n]
+    for prefix, tree, tsp in (("first/", first_avals, first_specs),
+                              ("last/", last_avals, last_specs)):
+        for n, a in tree.items():
+            flat_avals[prefix + n] = a
+            flat_specs[prefix + n] = tsp[n]
+
+    sh = lambda spec: NamedSharding(mesh, spec)
+    p_avals = {n: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                       sharding=sh(flat_specs[n]))
+               for n, a in flat_avals.items()}
+    opt_shapes = jax.eval_shape(
+        optimizer.init_state_pytree,
+        {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+         for n, a in flat_avals.items()})
+    opt_avals = {
+        n: jax.tree.map(
+            lambda s, _n=n: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=sh(flat_specs[_n])
+                if s.shape == flat_avals[_n].shape else sh(P())),
+            st)
+        for n, st in opt_shapes.items()}
+
+    dp = "dp" if "dp" in mesh.axis_names else None
+    fsdp = "fsdp" if "fsdp" in mesh.axis_names else None
+    step = build_pipeline_step_fn(
+        stage_fn, first_fn, last_fn, optimizer, mesh, M, flat_specs,
+        pp_axis="pp", dp_axis=dp, fsdp_axis=fsdp, remat=remat,
+        has_first=True, has_last=True,
+        scatter_grads_per_tick=scatter_grads_per_tick)
+
+    batch_sh = sh(P(None, tuple(a for a in (dp, fsdp) if a)))
+    mb_aval = jax.ShapeDtypeStruct(batch_shape, jnp.int32,
+                                   sharding=batch_sh)
+    step_aval = jax.ShapeDtypeStruct((), jnp.int32)
+    lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
+    return jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+        p_avals, opt_avals, step_aval, mb_aval, mb_aval, lr_aval)
+
+
+def _make_mesh(pp, dp, fsdp, tp):
+    import jax
+    from jax.sharding import Mesh
+
+    n = pp * dp * fsdp * tp
+    # jax.devices() lists only the DEFAULT platform's devices; ask for the
+    # virtual CPU platform explicitly (it exists even when a TPU plugin is
+    # the default), falling back to whatever is available
+    try:
+        devs = jax.devices("cpu")
+    except Exception:
+        devs = jax.devices()
+    if len(devs) < n:
+        import os
+        raise RuntimeError(
+            f"need {n} devices for mesh pp={pp} dp={dp} fsdp={fsdp} "
+            f"tp={tp}; have {len(devs)} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before jax init "
+            f"[debug: XLA_FLAGS={os.environ.get('XLA_FLAGS')!r} "
+            f"platforms={jax.config.jax_platforms!r} "
+            f"all={[d.platform for d in jax.devices()][:3]!r}]")
+    arr = np.array(devs[:n]).reshape(pp, dp, fsdp, tp)
+    return Mesh(arr, ("pp", "dp", "fsdp", "tp"))
+
+
+def _report(cfg_name, mesh_dims, n_params, compiled, seq, mb, M,
+            transient_bytes):
+    ma = compiled.memory_analysis()
+    return PlanReport(
+        config=cfg_name, mesh=mesh_dims,
+        n_devices=int(np.prod(list(mesh_dims.values()))),
+        params_total=int(n_params),
+        resident_bytes=int(ma.argument_size_in_bytes),
+        transient_bytes=int(transient_bytes),
+        host_temp_bytes=int(ma.temp_size_in_bytes),
+        seq=seq, microbatch=mb, num_microbatches=M)
+
+
+def _llama_transient_bytes(cfg: DenseConfig, pp, fsdp, tp, seq, mb_size,
+                           scatter_grads_per_tick):
+    """Analytic per-device working set of the 4-D step (see PlanReport):
+    ZeRO-gathered stage weights (alive across the tick scan), gathered
+    embed/head, pipeline boundary banks, fp32 grad accumulators, and the
+    remat recompute buffer of one block."""
+    d, f, V = cfg.d, cfg.ffn, cfg.vocab
+    hd = d // cfg.heads
+    block_params = cfg.layers * (2 * d + d * cfg.heads * hd
+                                 + 2 * d * cfg.kv_heads * hd
+                                 + cfg.heads * hd * d + 3 * d * f)
+    gathered_stage = block_params // pp // tp * 2          # bf16
+    gathered_embed = V * d * 2                             # fsdp-gathered
+    gathered_head = V * d // tp * 2
+    banks = (2 * pp + 2) * mb_size * seq * d * 2           # in/cot + wires
+    grad_stage = block_params // pp // tp * 4
+    if scatter_grads_per_tick:
+        grad_stage //= fsdp
+    grad_groups = (V * d + V * d // tp) * 4                # fp32, gathered
+    remat = mb_size * seq * (6 * d + 2 * f) * 2
+    attn_probs = mb_size * (cfg.heads // tp) * 512 * seq * 4
+    return (gathered_stage + gathered_embed + gathered_head + banks
+            + grad_stage + grad_groups + remat + attn_probs)
+
+
+def plan_llama(cfg: DenseConfig, *, pp: int, dp: int = 1, fsdp: int = 1,
+               tp: int = 1, seq: int = 8192, mb_size: int = 1,
+               num_microbatches: Optional[int] = None,
+               compute_dtype="bfloat16", learning_rate=3e-4,
+               scatter_grads_per_tick=True) -> PlanReport:
+    """AOT-compile cfg's full 4-D train step (1F1B + ZeRO + tp + AdamW
+    master weights) and return the per-device memory report."""
+    from paddle_tpu.optimizer import AdamW
+
+    mesh = _make_mesh(pp, dp, fsdp, tp)
+    M = num_microbatches or max(2 * pp, 2)
+    (stage, first, last, specs, fsp, lsp,
+     n_params) = llama_pipeline_avals(cfg, pp, compute_dtype)
+    opt = AdamW(learning_rate=learning_rate, multi_precision=True)
+    # per-data-shard microbatch: global microbatch = mb_size * dp * fsdp
+    batch_shape = (M, mb_size * dp * fsdp, seq)
+    lowered = _lower_pipeline_step(
+        _llama_stage_fn(cfg), _llama_first_fn, _llama_last_fn,
+        stage, first, last, specs, fsp, lsp, mesh, M, opt, batch_shape,
+        scatter_grads_per_tick=scatter_grads_per_tick)
+    compiled = lowered.compile()
+    transient = _llama_transient_bytes(cfg, pp, fsdp, tp, seq, mb_size,
+                                       scatter_grads_per_tick)
+    return _report(cfg.name, {"pp": pp, "dp": dp, "fsdp": fsdp, "tp": tp},
+                   n_params, compiled, seq, mb_size, M, transient)
+
+
+# -- MoE plan (GSPMD path: dp x fsdp x ep, no pipeline) ----------------------
+
+def moe_avals(cfg: MoEConfig, dtype="bfloat16"):
+    """DeepSeekMoE-style stack: dense attention + shared experts +
+    fine-grained routed experts, layers stacked for lax.scan."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    d, L, H, E, fe = cfg.d, cfg.layers, cfg.heads, cfg.n_experts, \
+        cfg.expert_ffn
+    hd = d // H
+    dt = jnp.dtype(dtype)
+    mk = lambda *shape: jax.ShapeDtypeStruct((L,) + shape, dt)
+    params = {
+        "ln1": mk(d), "ln2": mk(d),
+        "wq": mk(d, H, hd), "wk": mk(d, H, hd), "wv": mk(d, H, hd),
+        "wo": mk(H, hd, d),
+        "gate": mk(d, E),
+        # routed experts: [L, E, ...] sharded over ep
+        "ew1": mk(E, d, fe), "ew3": mk(E, d, fe), "ew2": mk(E, fe, d),
+        # shared experts: always-on, fused into one ffn of width n_shared*fe
+        "sw1": mk(d, cfg.n_shared * fe), "sw3": mk(d, cfg.n_shared * fe),
+        "sw2": mk(cfg.n_shared * fe, d),
+        "embed": jax.ShapeDtypeStruct((cfg.vocab, d), dt),
+        "head": jax.ShapeDtypeStruct((d, cfg.vocab), dt),
+        "ln_f": jax.ShapeDtypeStruct((d,), dt),
+    }
+    specs = {
+        "ln1": P(), "ln2": P(),
+        "wq": P(None, "fsdp", "tp", None), "wk": P(None, "fsdp", "tp", None),
+        "wv": P(None, "fsdp", "tp", None), "wo": P(None, "tp", None, "fsdp"),
+        "gate": P(None, "fsdp", None),
+        "ew1": P(None, "ep", "fsdp", None), "ew3": P(None, "ep", "fsdp", None),
+        "ew2": P(None, "ep", None, "fsdp"),
+        "sw1": P(None, "fsdp", "tp"), "sw3": P(None, "fsdp", "tp"),
+        "sw2": P(None, "tp", "fsdp"),
+        "embed": P("fsdp", None),
+        "head": P("fsdp", "tp"),
+        "ln_f": P(),
+    }
+    n_params = (L * (2 * d + 4 * d * H * hd + d * E
+                     + 3 * E * d * fe + 3 * d * cfg.n_shared * fe)
+                + 2 * cfg.vocab * d + d)
+    return params, specs, n_params
+
+
+def _moe_block(cfg: MoEConfig, x, lp):
+    """Dense attention + DeepSeek-style MoE ffn (shared + routed top-k,
+    dense einsum dispatch — GSPMD turns the [T,E,C] einsums into a2a)."""
+    import jax
+    import jax.numpy as jnp
+
+    h = _rmsnorm(x, lp["ln1"])
+    q = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wq"]), theta=10000.0)
+    k = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wk"]), theta=10000.0)
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    attn = _causal_attention_chunked(q, k, v)
+    x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
+
+    h2 = _rmsnorm(x, lp["ln2"])
+    B, s, d = h2.shape
+    T = B * s
+    ht = h2.reshape(T, d)
+    # shared experts: plain ffn
+    sh = jax.nn.silu(ht @ lp["sw1"]) * (ht @ lp["sw3"])
+    shared_out = sh @ lp["sw2"]
+    # routed: the LIBRARY's gating (distributed.moe.top_k_gating), so the
+    # plan compiles the same dispatch program the shipped MoELayer runs
+    from paddle_tpu.distributed.moe import top_k_gating
+
+    E = cfg.n_experts
+    C = max(1, int(2 * cfg.top_k * T // E))
+    logits = (ht @ lp["gate"]).astype(jnp.float32)
+    combine, dispatch, _aux = top_k_gating(logits, k=cfg.top_k, capacity=C)
+    xe = jnp.einsum("tec,td->ecd", dispatch.astype(ht.dtype), ht)
+    hh = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, lp["ew1"])) * \
+        jnp.einsum("ecd,edf->ecf", xe, lp["ew3"])
+    ye = jnp.einsum("ecf,efd->ecd", hh, lp["ew2"])        # [E, C, d]
+    routed = jnp.einsum("tec,ecd->td", combine.astype(ht.dtype), ye)
+    return x + (shared_out + routed).reshape(B, s, d)
+
+
+def plan_moe(cfg: MoEConfig, *, dp: int = 1, fsdp: int = 1, ep: int = 8,
+             tp: int = 1, seq: int = 4096, batch: int = 8,
+             compute_dtype="bfloat16", learning_rate=3e-4) -> PlanReport:
+    """AOT-compile the DeepSeekMoE train step on a (dp, fsdp, ep, tp)
+    GSPMD mesh (expert parallelism via sharded [E, ...] einsum dispatch;
+    XLA inserts the all_to_alls) and return the memory report."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.optimizer import AdamW
+
+    n = dp * fsdp * ep * tp
+    try:
+        devs = jax.devices("cpu")
+    except Exception:
+        devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(f"need {n} devices, have {len(devs)}")
+    mesh = Mesh(np.array(devs[:n]).reshape(dp, fsdp, ep, tp),
+                ("dp", "fsdp", "ep", "tp"))
+    params, specs, n_params = moe_avals(cfg, compute_dtype)
+    opt = AdamW(learning_rate=learning_rate, multi_precision=True)
+
+    def loss_fn(p, ids, labels):
+        x = p["embed"][ids]
+
+        def blk(xc, lp):
+            return _moe_block(cfg, xc, lp), None
+
+        x, _ = lax.scan(jax.checkpoint(blk), x,
+                        {k: v for k, v in p.items()
+                         if k not in ("embed", "head", "ln_f")})
+        h = _rmsnorm(x, p["ln_f"])
+        logits = jnp.einsum("bsd,dv->bsv", h, p["head"])
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), -1)
+        gold = jnp.take_along_axis(logits.astype(jnp.float32),
+                                   labels[..., None], -1).squeeze(-1)
+        return jnp.mean(lse - gold)
+
+    def step(p, opt_state, step_count, ids, labels, lr):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        step_count = step_count + 1
+        new_p, new_s = opt.apply_gradients(p, grads, opt_state, step_count,
+                                           lr=lr)
+        return loss, new_p, new_s, step_count
+
+    sh = lambda spec: NamedSharding(mesh, spec)
+    p_avals = {nme: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                         sharding=sh(specs[nme]))
+               for nme, a in params.items()}
+    opt_shapes = jax.eval_shape(
+        opt.init_state_pytree,
+        {nme: jax.ShapeDtypeStruct(a.shape, a.dtype)
+         for nme, a in params.items()})
+    opt_avals = {
+        nme: jax.tree.map(
+            lambda s, _n=nme: jax.ShapeDtypeStruct(
+                s.shape, s.dtype,
+                sharding=sh(specs[_n])
+                if s.shape == params[_n].shape else sh(P())),
+            st)
+        for nme, st in opt_shapes.items()}
+    ids_aval = jax.ShapeDtypeStruct((batch, seq), jnp.int32,
+                                    sharding=sh(P(("dp", "fsdp"))))
+    lowered = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+        p_avals, opt_avals, jax.ShapeDtypeStruct((), jnp.int32),
+        ids_aval, ids_aval, jax.ShapeDtypeStruct((), jnp.float32))
+    compiled = lowered.compile()
+    # analytic working set: fsdp-gathered weights of ONE layer (the scan
+    # is checkpointed per layer), layer-boundary activations, the [T,E,C]
+    # dispatch/combine buffers, and the fp32 grad shards
+    d, fe, E = cfg.d, cfg.expert_ffn, cfg.n_experts
+    hd = d // cfg.heads
+    layer_params = (2 * d + 4 * d * cfg.heads * hd + d * E
+                    + 3 * E * d * fe + 3 * d * cfg.n_shared * fe)
+    b_local = max(1, batch // (dp * fsdp))
+    T = b_local * seq
+    C = max(1, int(2 * cfg.top_k * T // E))
+    transient = (layer_params // ep * 2                      # gathered layer
+                 + cfg.layers * b_local * seq * d * 2        # boundaries
+                 + 3 * T * E * C * 4                         # disp/comb/pos
+                 + 2 * (E // ep) * C * d * 4                 # expert io
+                 + n_params // (dp * fsdp * ep) * 4)         # grad shards
+    return _report(cfg.name, {"dp": dp, "fsdp": fsdp, "ep": ep, "tp": tp},
+                   n_params, compiled, seq, batch, 1, transient)
+
+
+def _main():
+    import argparse
+    import json
+
+    # NOTE: no jax.config.update("jax_platforms", ...) here — the package
+    # import above may already have initialized backends, and a platform
+    # re-selection would re-create the CPU client AFTER the one-shot
+    # XLA_FLAGS parse, silently dropping --xla_force_host_platform_
+    # device_count (observed: 64 devices become 1).  _make_mesh targets
+    # jax.devices("cpu") explicitly, which works under any default
+    # platform.
+
+    ap = argparse.ArgumentParser(description="flagship capacity planner")
+    ap.add_argument("--config", required=True, choices=sorted(CONFIGS))
+    ap.add_argument("--pp", type=int, default=4)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--fsdp", type=int, default=8)
+    ap.add_argument("--ep", type=int, default=8)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--hbm-gb", type=float, default=95.0,
+                    help="per-chip HBM to check against (v5p: 95)")
+    args = ap.parse_args()
+    cfg = CONFIGS[args.config]
+    if isinstance(cfg, MoEConfig):
+        rep = plan_moe(cfg, dp=args.dp, fsdp=args.fsdp, ep=args.ep,
+                       tp=args.tp, seq=args.seq)
+    else:
+        rep = plan_llama(cfg, pp=args.pp, dp=args.dp, fsdp=args.fsdp,
+                         tp=args.tp, seq=args.seq)
+    print(rep.summary())
+    print(json.dumps({"fits": rep.fits(args.hbm_gb),
+                      "peak_gib": rep.peak_bytes_per_device / (1 << 30)}))
+
+
+if __name__ == "__main__":
+    _main()

@@ -334,3 +334,137 @@ class TestMoELayer:
         g = jax.grad(loss)(params, jnp.asarray(x))
         assert float(jnp.abs(g["gate.gate"]).sum()) > 0
         assert float(jnp.abs(g["experts.w1"]).sum()) > 0
+
+
+# -- pp x dp x ep: MoE (shared + routed experts) inside the 1F1B pipeline ----
+
+class TestMoEPipeline3D:
+    """VERDICT r2 item 6 'done' criterion: an MoE block with SHARED
+    experts trains inside PipelineTrainStep on a (pp, dp, ep) mesh with
+    parity vs the serial dense-routed oracle (dropless, so capacity
+    semantics cannot diverge)."""
+
+    S, DP, EP, M = 2, 2, 2, 4
+    d, hid, E, K = 8, 16, 4, 2
+    mbs, T = 4, 3
+
+    def _params(self, key):
+        S, d, hid, E = self.S, self.d, self.hid, self.E
+        ks = jax.random.split(key, 9)
+        s = 1 / np.sqrt(d)
+        return {
+            "wproj": jax.random.normal(ks[0], (S, d, d)) * s,
+            "gate": jax.random.normal(ks[1], (S, d, E)) * s,
+            "ew1": jax.random.normal(ks[2], (S, E, d, hid)) * s,
+            "eb1": jnp.zeros((S, E, hid)),
+            "ew2": jax.random.normal(ks[3], (S, E, hid, d)) * s,
+            "eb2": jnp.zeros((S, E, d)),
+            "sw1": jax.random.normal(ks[4], (S, d, hid)) * s,
+            "sw2": jax.random.normal(ks[5], (S, hid, d)) * s,
+        }
+
+    @staticmethod
+    def _stage_fn(p, x):
+        sq = lambda a: a[0]
+        mbs, s, d = x.shape
+        h = jnp.tanh(jnp.einsum("bsd,de->bse", x, sq(p["wproj"])))
+        # shared expert: always-on dense ffn
+        shared = jnp.einsum("bsh,hd->bsd",
+                            jax.nn.gelu(jnp.einsum("bsd,dh->bsh", h,
+                                                   sq(p["sw1"]))),
+                            sq(p["sw2"]))
+        x2d = h.reshape(-1, d)
+        routed, aux, dropped = dist.moe_shard_a2a(
+            x2d, sq(p["gate"]), sq(p["ew1"]), sq(p["eb1"]),
+            sq(p["ew2"]), sq(p["eb2"]), top_k=2,
+            capacity=x2d.shape[0])  # dropless: capacity == local tokens
+        return x + shared + routed.reshape(mbs, s, d)
+
+    @staticmethod
+    def _first_fn(p, raw):
+        return raw @ p["win"]
+
+    @staticmethod
+    def _last_fn(p, y, lab):
+        return jnp.mean((jnp.einsum("bsd,do->bso", y, p["wout"]) - lab) ** 2)
+
+    def _serial(self, ps, first, last, mb_in, mb_lab):
+        """Dense-routed oracle: per-token top-k over global softmax, the
+        exact math dropless dispatch computes."""
+        S, E, K = self.S, self.E, self.K
+
+        def moe_tok(p_s, h2d):
+            probs = jax.nn.softmax(h2d @ p_s["gate"], axis=-1)
+            topv, topi = jax.lax.top_k(probs, K)
+            w = topv / jnp.sum(topv, -1, keepdims=True)
+            outs = []
+            for e in range(E):
+                he = jax.nn.gelu(h2d @ p_s["ew1"][e] + p_s["eb1"][e])
+                outs.append(he @ p_s["ew2"][e] + p_s["eb2"][e])
+            outs = jnp.stack(outs, 1)                    # [T, E, d]
+            sel = jax.nn.one_hot(topi, E)                # [T, K, E]
+            return jnp.einsum("tk,tke,ted->td", w, sel, outs)
+
+        def stage(p_s, x):
+            mbs, s, d = x.shape
+            h = jnp.tanh(jnp.einsum("bsd,de->bse", x, p_s["wproj"]))
+            shared = jnp.einsum(
+                "bsh,hd->bsd",
+                jax.nn.gelu(jnp.einsum("bsd,dh->bsh", h, p_s["sw1"])),
+                p_s["sw2"])
+            routed = moe_tok(p_s, h.reshape(-1, d)).reshape(mbs, s, d)
+            return x + shared + routed
+
+        def one(m):
+            x = mb_in[m] @ first["win"]
+            for s_i in range(S):
+                x = stage(jax.tree.map(lambda a: a[s_i], ps), x)
+            return jnp.mean((jnp.einsum("bsd,do->bso", x, last["wout"])
+                             - mb_lab[m]) ** 2)
+
+        return sum(one(m) for m in range(self.M)) / self.M
+
+    def test_pp_dp_ep_parity_and_training(self):
+        S, DP, EP, M = self.S, self.DP, self.EP, self.M
+        d = self.d
+        devs = np.array(jax.devices("cpu")[:S * DP * EP]).reshape(S, DP, EP)
+        mesh = Mesh(devs, ("pp", "dp", "ep"))
+        params = self._params(jax.random.PRNGKey(0))
+        ks = jax.random.split(jax.random.PRNGKey(7), 2)
+        first = {"win": jax.random.normal(ks[0], (5, d)) * 0.5}
+        last = {"wout": jax.random.normal(ks[1], (d, 3)) * 0.5}
+        specs = {
+            "wproj": P("pp"), "gate": P("pp"),
+            "ew1": P("pp", "ep"), "eb1": P("pp", "ep"),
+            "ew2": P("pp", "ep"), "eb2": P("pp", "ep"),
+            "sw1": P("pp"), "sw2": P("pp"),
+        }
+        rng = np.random.default_rng(0)
+        mb_in = jnp.asarray(rng.standard_normal(
+            (M, self.mbs, self.T, 5)), jnp.float32)
+        mb_lab = jnp.asarray(rng.standard_normal(
+            (M, self.mbs, self.T, 3)), jnp.float32)
+
+        opt = pp.optimizer.SGD(learning_rate=0.05)
+        step = dist.PipelineTrainStep(
+            self._stage_fn, self._first_fn, self._last_fn, params, opt,
+            mesh, M, specs, first_params=first,
+            first_specs={"win": P()}, last_params=last,
+            last_specs={"wout": P()}, remat=True, extra_data_axes=("ep",))
+
+        want0 = float(self._serial(params, first, last, mb_in, mb_lab))
+        loss0 = float(step({"inputs": mb_in, "labels": mb_lab}))
+        np.testing.assert_allclose(loss0, want0, rtol=1e-4)
+
+        # one-step param parity vs serial SGD on a routed expert weight
+        g = jax.grad(lambda ps: self._serial(ps, first, last, mb_in,
+                                             mb_lab))(params)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(step.params["ew1"])),
+            np.asarray(params["ew1"] - 0.05 * g["ew1"]),
+            rtol=5e-3, atol=1e-5)
+
+        losses = [loss0]
+        for _ in range(4):
+            losses.append(float(step({"inputs": mb_in, "labels": mb_lab})))
+        assert losses[-1] < losses[0], losses

@@ -1,0 +1,108 @@
+"""Flagship-config capacity proof (VERDICT r2 item 2): AOT-compile the
+REAL Llama-3-8B / 70B 4-D programs and a DeepSeekMoE program on a virtual
+64-device CPU mesh and assert the per-device memory from XLA's buffer
+assignment fits v5p HBM (95 GiB).
+
+The 64-device runs happen in subprocesses because the virtual device count
+is fixed at first jax init (this suite runs on 8).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTinyPlanInProcess:
+    def test_llama_plan_reports_memory(self):
+        from paddle_tpu.distributed.planner import DenseConfig, plan_llama
+        tiny = DenseConfig("tiny", vocab=512, d=64, ffn=128, layers=4,
+                           heads=4, kv_heads=2)
+        rep = plan_llama(tiny, pp=2, dp=2, fsdp=2, tp=1, seq=64,
+                         mb_size=2, num_microbatches=4)
+        assert rep.n_devices == 8
+        assert rep.peak_bytes_per_device > 0
+        assert rep.resident_bytes > 0
+        # bf16 params + fp32 master+m+v, pp+fsdp sharded: arguments must
+        # be at least the resident param bytes per device
+        per_dev_param_bytes = rep.params_total * 2 / 8
+        assert rep.resident_bytes > per_dev_param_bytes
+        assert rep.fits(hbm_gb=8.0)
+        assert "tiny" in rep.summary()
+
+    def test_moe_plan_reports_memory(self):
+        from paddle_tpu.distributed.planner import MoEConfig, plan_moe
+        tiny = MoEConfig("tinymoe", vocab=512, d=64, layers=2, heads=4,
+                         n_experts=8, n_shared=1, top_k=2, expert_ffn=32)
+        rep = plan_moe(tiny, dp=1, fsdp=2, ep=4, tp=1, seq=64, batch=4)
+        assert rep.n_devices == 8
+        assert rep.peak_bytes_per_device > 0
+        assert rep.fits(hbm_gb=8.0)
+
+
+def _run_plan_subprocess(code: str) -> dict:
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=64"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-c", _PRELUDE + textwrap.dedent(code)], env=env,
+        capture_output=True, text=True, timeout=3000)
+    assert out.returncode == 0, out.stderr[-4000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+_PRELUDE = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+"""
+
+
+class TestFlagshipConfigsFitV5p:
+    """The BASELINE.md config matrix, compiled at full size on 64 virtual
+    devices; per-device peak must fit a v5p chip (95 GiB HBM)."""
+
+    def test_llama3_8b_4d_fits(self):
+        rep = _run_plan_subprocess("""
+        from paddle_tpu.distributed.planner import plan_llama, LLAMA3_8B
+        rep = plan_llama(LLAMA3_8B, pp=4, dp=2, fsdp=8, tp=1, seq=8192,
+                         mb_size=1)
+        print(rep.summary())
+        print(json.dumps({"fits": rep.fits(95.0), "peak": rep.peak_bytes_per_device,
+                          "resident": rep.resident_bytes,
+                          "params": rep.params_total}))
+        """)
+        assert rep["fits"], rep
+        assert 7.5e9 < rep["params"] < 8.5e9, rep["params"]
+        # resident args must at least hold the ZeRO-sharded state
+        assert rep["resident"] > rep["params"] * 14 / 64
+
+    def test_llama3_70b_4d_fits(self):
+        rep = _run_plan_subprocess("""
+        from paddle_tpu.distributed.planner import plan_llama, LLAMA3_70B
+        rep = plan_llama(LLAMA3_70B, pp=4, dp=1, fsdp=8, tp=2, seq=8192,
+                         mb_size=1, scatter_grads_per_tick=True)
+        print(rep.summary())
+        print(json.dumps({"fits": rep.fits(95.0), "peak": rep.peak_bytes_per_device,
+                          "params": rep.params_total}))
+        """)
+        assert rep["fits"], rep
+        assert 6.5e10 < rep["params"] < 7.5e10, rep["params"]
+
+    def test_deepseek_moe_fits(self):
+        rep = _run_plan_subprocess("""
+        from paddle_tpu.distributed.planner import plan_moe, DEEPSEEK_MOE_16B
+        rep = plan_moe(DEEPSEEK_MOE_16B, dp=2, fsdp=4, ep=8, tp=1,
+                       seq=4096, batch=8)
+        print(rep.summary())
+        print(json.dumps({"fits": rep.fits(95.0), "peak": rep.peak_bytes_per_device,
+                          "params": rep.params_total}))
+        """)
+        assert rep["fits"], rep
+        assert 1.2e10 < rep["params"] < 2.0e10, rep["params"]
