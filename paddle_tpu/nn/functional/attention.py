@@ -57,7 +57,14 @@ def _use_pallas(q_shape, head_dim):
     if _j.default_backend() != "tpu":
         return False
     # pallas kernel wants lane-aligned head_dim and block-aligned seq
-    # (the kernel picks block sizes of 128 and requires seq % block == 0)
+    # (block sizes of >=128 and seq % block == 0).  Even at sequence
+    # lengths where XLA's fused dense attention is FASTER in isolation
+    # (below ~4k on v5e), flash is what lets the training step fit: the
+    # dense path materializes the [b, h, s, s] score tensor per layer and
+    # the remat policy keeps those dot outputs live (at the bench model's
+    # shapes the dense variant fails to even compile on a 16 GB chip).
+    # Backward-implementation and block-size choice are autotuned
+    # (ops/pallas/autotune.py); at 8k+ flash also wins outright (6.4x).
     return head_dim % 128 == 0 and q_shape[1] >= 128 and \
         q_shape[1] % 128 == 0
 
@@ -71,10 +78,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             query.shape[1] == key.shape[1] and \
             _use_pallas(query.shape, query.shape[-1]):
         # no try/except: a lowering break in the flagship kernel must
-        # surface, not silently fall back (round-1 lesson)
+        # surface, not silently fall back (round-1 lesson).
+        # pallas_bwd=False: measured IN-MODEL (bench.py, b4/s2048 584M,
+        # v5e) the blockwise-jax backward gives MFU 0.514 vs 0.461 with
+        # the Pallas dq/dkv kernels, even though isolated microbenchmarks
+        # sometimes favor the kernels — under remat the XLA-fused
+        # blockwise bwd overlaps better with the surrounding step.
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
         return flash_attention(query, key, value, causal=is_causal,
-                               scale=scale)
+                               scale=scale, pallas_bwd=False)
     dk = None
     if use_dropout:
         from paddle_tpu.core import functional as _cf

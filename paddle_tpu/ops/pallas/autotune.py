@@ -1,0 +1,214 @@
+"""Kernel block-size autotuner with persistent caching.
+
+Reference parity: ``phi/kernels/autotune/auto_tune_base.h`` +
+``cache_base.h`` — the reference times kernel variants at first invocation
+and caches the winner per shape key.  TPU-native version: candidates are
+Pallas block-size configurations; each is compiled and timed ONCE on the
+real chip at first use of a shape (this works even when the op is hit
+inside a ``jit`` trace — the measurement runs concrete side inputs, not
+tracers), and the winner persists to a JSON cache so later processes skip
+the sweep entirely.
+
+Env knobs:
+  PADDLE_TPU_AUTOTUNE=0           disable (use the heuristic default)
+  PADDLE_TPU_AUTOTUNE_CACHE=path  cache file (default
+                                  ~/.cache/paddle_tpu_autotune.json)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Sequence, Tuple
+
+__all__ = ["autotune", "flash_block_sizes", "cache_path", "clear_cache"]
+
+_mem_cache: Dict[str, object] = {}
+_loaded = False
+
+
+def cache_path() -> str:
+    return os.environ.get(
+        "PADDLE_TPU_AUTOTUNE_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_autotune.json"))
+
+
+def _load():
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    try:
+        with open(cache_path()) as f:
+            _mem_cache.update(json.load(f))
+    except Exception:
+        pass
+
+
+def _save():
+    path = cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        # merge-then-atomic-replace: concurrent processes benching
+        # different shapes must not clobber each other or expose a
+        # half-written file to readers
+        merged = {}
+        try:
+            with open(path) as f:
+                merged.update(json.load(f))
+        except Exception:
+            pass
+        merged.update(_mem_cache)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(merged, f, indent=0, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass  # read-only fs: in-memory cache still works
+
+
+def clear_cache():
+    global _loaded
+    _mem_cache.clear()
+    _loaded = True
+    try:
+        os.remove(cache_path())
+    except OSError:
+        pass
+
+
+def enabled() -> bool:
+    if os.environ.get("PADDLE_TPU_AUTOTUNE", "1") == "0":
+        return False
+    # multi-controller runs must compile IDENTICAL programs on every
+    # process; per-host timing sweeps could disagree (noise) and deadlock
+    # the first collective — use the deterministic default there
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return False
+    except Exception:
+        pass
+    return True
+
+
+def _device_tag() -> str:
+    try:
+        import jax
+        dev = jax.devices()[0]
+        return f"{dev.platform}:{getattr(dev, 'device_kind', '?')}" \
+            .replace(" ", "_")
+    except Exception:
+        return "unknown"
+
+
+def autotune(op_name: str, key: str, candidates: Sequence,
+             bench: Callable[[object], float], default):
+    """Return the cached winner for (op_name, key), measuring once.
+
+    bench(candidate) -> seconds (lower is better); raise/inf to disqualify
+    a candidate.  Falls back to ``default`` when disabled or when every
+    candidate fails."""
+    full_key = f"{op_name}|{key}"
+    _load()
+    if full_key in _mem_cache:
+        got = _mem_cache[full_key]
+        return tuple(got) if isinstance(got, list) else got
+    if not enabled():
+        return default
+
+    best, best_t = None, float("inf")
+    for c in candidates:
+        try:
+            t = bench(c)
+        except Exception:
+            continue
+        if t < best_t:
+            best, best_t = c, t
+    if best is None:
+        best = default
+    _mem_cache[full_key] = list(best) if isinstance(best, tuple) else best
+    _save()
+    return best
+
+
+def _flash_candidates(s: int, d: int, dtype: str,
+                      pallas_bwd=None) -> list:
+    """(block_q, block_k, pallas_bwd) candidates: block sizes bounded by
+    the VMEM working set, crossed with the two backward implementations
+    (Pallas dq/dkv kernels vs the blockwise-jax recompute) — the variant
+    choice is part of the tuning space, reference auto_tune_base style.
+    A caller-pinned ``pallas_bwd`` constrains that dimension (no point
+    benching a variant the call site will never use)."""
+    blocks = []
+    sizes = (128, 256) if s < 4096 else (128, 256, 512)
+    for bq in sizes:
+        for bk in sizes:
+            if bq > s or bk > s or s % bq or s % bk:
+                continue
+            itemsize = 2 if "bfloat16" in dtype or "float16" in dtype else 4
+            vmem = (2 * (bq + 2 * bk) * d * itemsize   # double-buffered io
+                    + bq * bk * 4                      # score tile
+                    + 2 * bq * d * 4)                  # fp32 accumulators
+            if vmem < 10 * (1 << 20):
+                blocks.append((bq, bk))
+    blocks = blocks or [(min(128, s), min(128, s))]
+    pbs = (True, False) if pallas_bwd is None else (bool(pallas_bwd),)
+    return [(bq, bk, pb) for bq, bk in blocks for pb in pbs]
+
+
+def flash_block_sizes(b: int, s: int, h: int, hk: int, d: int,
+                      dtype: str, causal: bool,
+                      pallas_bwd=None) -> Tuple[int, int, bool]:
+    """Measured (block_q, block_k, pallas_bwd) for this shape (the last
+    entry echoes ``pallas_bwd`` when the caller pinned it)."""
+    default = (min(128, s), min(128, s),
+               True if pallas_bwd is None else bool(pallas_bwd))
+    cands = _flash_candidates(s, d, dtype, pallas_bwd)
+    if len(cands) == 1:
+        return cands[0]
+    pb_tag = "x" if pallas_bwd is None else str(int(bool(pallas_bwd)))
+    key = (f"b{b}s{s}h{h}k{hk}d{d}{dtype}c{int(causal)}"
+           f"pb{pb_tag}@{_device_tag()}")
+
+    def bench(blocks):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+        bq, bk, pb = blocks
+        iters = 8
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(dtype)
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), dt)
+        k = jnp.asarray(rng.standard_normal((b, s, hk, d)), dt)
+        v = jnp.asarray(rng.standard_normal((b, s, hk, d)), dt)
+
+        @jax.jit
+        def run(q_, k_, v_):
+            # iterations loop INSIDE the jit: one dispatch, so the
+            # tunneled chip's per-call RPC latency cannot bias the sweep
+            def loss(args):
+                o = flash_attention(*args, causal=causal, block_q=bq,
+                                    block_k=bk, pallas_bwd=pb,
+                                    autotune=False)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+
+            def body(i, carry):
+                g = jax.grad(loss)((q_ * (1 + carry * 1e-12).astype(dt),
+                                    k_, v_))
+                return carry + sum(
+                    jnp.sum(jnp.abs(x).astype(jnp.float32)) for x in g)
+            return lax.fori_loop(0, iters, body, 0.0)
+
+        np.asarray(run(q, k, v))                      # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(run(q, k, v))
+        return (time.perf_counter() - t0) / iters
+
+    return tuple(autotune("flash", key, cands, bench, default))

@@ -160,6 +160,214 @@ def _fwd_pallas(q, k, v, *, scale, causal, block_q, block_k,
     return out, lse5.reshape(b, hq, s)
 
 
+# -- backward: Pallas kernels (flash-attn-2 equations) -----------------------
+#
+# Both kernels work in TRANSPOSED score space (s_T[k, q] instead of
+# s[q, k]): the per-ROW softmax statistics (lse, delta) then enter as
+# [1, block_q] row vectors that broadcast over the k dimension with no
+# in-kernel transpose/relayout, and every contraction is a dot_general the
+# MXU handles directly.
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   acc_ref, *, block_q, block_k, scale, causal):
+    """Grid: (b, hq, nq, nk); k inner — dq accumulates across k blocks."""
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _compute():
+        q = q_ref[0, 0]                                 # [bq, d]
+        k = k_ref[0, 0]                                 # [bk, d]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
+        lse_row = lse_ref[0, 0, 0]                      # [1, bq]
+        delta_row = delta_ref[0, 0, 0]                  # [1, bq]
+
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bk, bq]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(q_pos >= k_pos, s_t, _NEG_INF)
+        p_t = jnp.exp(s_t - lse_row)                    # [bk, bq]
+        dp_t = jax.lax.dot_general(
+            v, g, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)         # [bk, bq]
+        ds_t = p_t * (dp_t - delta_row) * scale
+        # dq[q, d] = sum_k ds_T[k, q] * k[k, d]
+        acc_ref[:] += jax.lax.dot_general(
+            ds_t, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, block_q, block_k,
+                    nq, rep, scale, causal):
+    """Grid: (b, hk, nk, rep*nq); inner axis walks every (group head,
+    q block) pair — dk/dv accumulate over the whole query group, so
+    repeated KV heads are never materialized (GQA)."""
+    kj = pl.program_id(2)
+    t = pl.program_id(3)
+    nt = pl.num_programs(3)
+    qi = t % nq
+
+    @pl.when(t == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _compute():
+        q = q_ref[0, 0]                                 # [bq, d]
+        k = k_ref[0, 0]                                 # [bk, d]
+        v = v_ref[0, 0]
+        g = g_ref[0, 0]
+        lse_row = lse_ref[0, 0, 0]                      # [1, bq]
+        delta_row = delta_ref[0, 0, 0]
+
+        s_t = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bk, bq]
+        if causal:
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 0)
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_k, block_q), 1)
+            s_t = jnp.where(q_pos >= k_pos, s_t, _NEG_INF)
+        p_t = jnp.exp(s_t - lse_row)
+        # dv[k, d] = sum_q p_T[k, q] * g[q, d]
+        dv_acc[:] += jax.lax.dot_general(
+            p_t.astype(jnp.float32), g.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp_t = jax.lax.dot_general(
+            v, g, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds_t = p_t * (dp_t - delta_row) * scale
+        # dk[k, d] = sum_q ds_T[k, q] * q[q, d]
+        dk_acc[:] += jax.lax.dot_general(
+            ds_t, q.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kj * block_k <= qi * block_q + (block_q - 1))
+        def _():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(t == nt - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(res, g, *, scale, causal, block_q, block_k, interpret):
+    """Pallas flash backward: dq from one kernel (k inner), dk/dv from a
+    second (query-group inner).  lse/delta ride as [b,hq,nq,1,bq] so each
+    q block's statistics arrive as a [1, bq] row vector."""
+    q, k, v, out, lse = res      # q,out [b,hq,s,d]; k,v [b,hk,s,d]
+    b, hq, s, d = q.shape
+    hk = k.shape[1]
+    rep = hq // hk
+    nq = pl.cdiv(s, block_q)
+    nk = pl.cdiv(s, block_k)
+
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                              # [b, hq, s]
+    lse5 = lse.reshape(b, hq, nq, 1, block_q)
+    delta5 = delta.reshape(b, hq, nq, 1, block_q)
+
+    params = {}
+    if _HAVE_TPU_PL and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          scale=scale, causal=causal),
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, h, i, j: (b_, h // rep, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h, i, j: (b_, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, 1, block_q),
+                         lambda b_, h, i, j: (b_, h, i, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 1, block_q),
+                         lambda b_, h, i, j: (b_, h, i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda b_, h, i, j: (b_, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(q, k, v, g, lse5, delta5)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, nq=nq, rep=rep, scale=scale,
+                          causal=causal),
+        grid=(b, hk, nk, rep * nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, g_, j, t: (b_, g_ * rep + t // nq,
+                                               t % nq, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, g_, j, t: (b_, g_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, g_, j, t: (b_, g_, j, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, g_, j, t: (b_, g_ * rep + t // nq,
+                                               t % nq, 0)),
+            pl.BlockSpec((1, 1, 1, 1, block_q),
+                         lambda b_, g_, j, t: (b_, g_ * rep + t // nq,
+                                               t % nq, 0, 0)),
+            pl.BlockSpec((1, 1, 1, 1, block_q),
+                         lambda b_, g_, j, t: (b_, g_ * rep + t // nq,
+                                               t % nq, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, g_, j, t: (b_, g_, j, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda b_, g_, j, t: (b_, g_, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hk, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hk, s, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(q, k, v, g, lse5, delta5)
+    return dq, dk, dv
+
+
 # -- backward: blockwise recompute in JAX (flash-attn-2 equations) -----------
 
 def _bwd_blockwise(res, g, *, scale, causal, block_k):
@@ -216,20 +424,28 @@ def _bwd_blockwise(res, g, *, scale, causal, block_k):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core(q, k, v, scale, causal, block_q, block_k, interpret,
+                pallas_bwd):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        interpret, pallas_bwd)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               pallas_bwd):
     out, lse = _fwd_pallas(q, k, v, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
                            interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, pallas_bwd,
+               res, g):
+    if pallas_bwd:
+        return _bwd_pallas(res, g, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
     return _bwd_blockwise(res, g, scale=scale, causal=causal,
                           block_k=block_k)
 
@@ -239,11 +455,19 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = None, block_k: int = None,
-                    interpret: bool = None):
+                    interpret: bool = None, pallas_bwd: bool = None,
+                    autotune: bool = None):
     """q: [batch, seq, heads, head_dim]; k,v: [batch, seq, kv_heads,
     head_dim] (paddle layout).  Requires seq divisible by the block sizes
     (callers pad; the model stack keeps seq a multiple of 128 for MXU
-    efficiency anyway) and heads % kv_heads == 0."""
+    efficiency anyway) and heads % kv_heads == 0.
+
+    block_q/block_k — and the backward implementation, when
+    ``pallas_bwd`` is left None — default to the autotuner's cached
+    choice on TPU (measured once per shape, persisted — reference analog:
+    phi/kernels/autotune/auto_tune_base.h); elsewhere min(128, s) blocks
+    and the Pallas backward.  ``pallas_bwd=False`` forces the
+    blockwise-jax backward, True the Pallas dq/dkv kernels."""
     b, s, h, d = q.shape
     hk = k.shape[2]
     if h % hk:
@@ -252,10 +476,23 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if block_q is None:
-        block_q = min(128, s)
-    if block_k is None:
-        block_k = min(128, s)
+    if autotune is None:
+        autotune = not interpret
+    if block_q is None or block_k is None or pallas_bwd is None:
+        if autotune and not interpret:
+            from paddle_tpu.ops.pallas.autotune import flash_block_sizes
+            bq_t, bk_t, pb_t = flash_block_sizes(
+                b, s, h, hk, d, str(q.dtype), bool(causal),
+                pallas_bwd=pallas_bwd)
+            block_q = block_q or bq_t
+            block_k = block_k or bk_t
+            if pallas_bwd is None:
+                pallas_bwd = pb_t
+        else:
+            block_q = block_q or min(128, s)
+            block_k = block_k or min(128, s)
+            if pallas_bwd is None:
+                pallas_bwd = True
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     if s % block_q or s % block_k:
@@ -266,5 +503,6 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         return jnp.swapaxes(x, 1, 2)
 
     out = _flash_core(to_bhsd(q), to_bhsd(k), to_bhsd(v), float(scale),
-                      bool(causal), block_q, block_k, bool(interpret))
+                      bool(causal), block_q, block_k, bool(interpret),
+                      bool(pallas_bwd))
     return jnp.swapaxes(out, 1, 2)
