@@ -603,6 +603,7 @@ struct Predictor {
 
   ~Predictor() {
     for (auto* b : out_bufs) destroy_buffer(b);
+    if (!owner) return;  // clones share client/exec/params with the owner
     for (auto* b : param_bufs) destroy_buffer(b);
     if (exec) {
       PJRT_LoadedExecutable_Destroy_Args args;
@@ -622,6 +623,7 @@ struct Predictor {
   }
 
   std::string params_archive_;
+  bool owner = true;
 };
 
 }  // namespace
@@ -669,5 +671,25 @@ int pd_predictor_output_copy(void* h, int i, void* dst, int64_t dst_size) {
 }
 
 void pd_predictor_destroy(void* h) { delete static_cast<Predictor*>(h); }
+
+// Pool support (reference PredictorPool: clone the program, share the
+// weights): a clone shares the PJRT client, the compiled executable, and
+// the device-resident parameters with the owner, but keeps its OWN output
+// buffers, so concurrent requests on different clones never race on
+// results.  The owner must outlive its clones.
+void* pd_predictor_clone(void* h) {
+  auto* src = static_cast<Predictor*>(h);
+  auto p = std::make_unique<Predictor>();
+  p->dl = src->dl;
+  p->api = src->api;
+  p->client = src->client;
+  p->device = src->device;
+  p->exec = src->exec;
+  p->num_params = src->num_params;
+  p->num_outputs = src->num_outputs;
+  p->param_bufs = src->param_bufs;
+  p->owner = false;
+  return p.release();
+}
 
 }  // extern "C"

@@ -45,6 +45,12 @@ from paddle_tpu import device  # noqa: F401,E402
 from paddle_tpu import distributed  # noqa: F401,E402
 from paddle_tpu import distribution  # noqa: F401,E402
 from paddle_tpu import framework  # noqa: F401,E402
+# `import` (not `from ... import`): the generated top-level `fft` OP is
+# already bound on the package, and `from paddle_tpu import fft` would
+# return that function; importing the submodule rebinds the attr to the
+# module — paddle parity (paddle.fft is the namespace, paddle.fft.fft
+# the transform)
+import paddle_tpu.fft  # noqa: F401,E402
 from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import hapi  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402
@@ -57,6 +63,7 @@ from paddle_tpu import nn  # noqa: F401,E402
 from paddle_tpu import optimizer  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
 from paddle_tpu import sparse  # noqa: F401,E402
+from paddle_tpu import text  # noqa: F401,E402
 from paddle_tpu import static  # noqa: F401,E402
 from paddle_tpu import utils  # noqa: F401,E402
 from paddle_tpu import vision  # noqa: F401,E402

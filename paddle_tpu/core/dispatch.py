@@ -174,10 +174,16 @@ def dispatch(fn: Callable, *args, op_name: str = "", **kwargs):
     return jax.tree.unflatten(treedef, wrapped)
 
 
-def eager_op(fn: Callable = None, *, name: str = None):
+def eager_op(fn: Callable = None, *, name: str = None,
+             factory: bool = False):
     """Decorator: make a pure-jax op callable with Tensors (tape-aware) or raw
     jax values (direct). ``name=`` kwarg of the op itself (paddle API parity)
-    is swallowed before dispatch."""
+    is swallowed before dispatch.
+
+    ``factory=True`` marks tensor FACTORIES (zeros/ones/arange/... — no
+    tensor inputs): in eager context their outputs wrap into Tensors
+    (paddle parity: ``paddle.ones`` returns a Tensor), while traced
+    callers still get raw values."""
 
     def deco(f):
         opname = name or f.__name__
@@ -185,7 +191,14 @@ def eager_op(fn: Callable = None, *, name: str = None):
         @functools.wraps(f)
         def wrapper(*args, **kwargs):
             kwargs.pop("name", None)
-            return dispatch(f, *args, op_name=opname, **kwargs)
+            out = dispatch(f, *args, op_name=opname, **kwargs)
+            if factory:
+                from paddle_tpu.core import functional as _func
+                leaves = jax.tree.leaves(out)
+                if not _func.substitution_active() and leaves and not any(
+                        isinstance(v, jax.core.Tracer) for v in leaves):
+                    out = jax.tree.map(wrap_like, out)
+            return out
 
         wrapper.__wrapped_pure__ = f
         return wrapper

@@ -1,0 +1,17 @@
+"""paddle.fft parity — the spectral API surface.
+
+Reference: python/paddle/fft.py (fft_c2c/c2r/r2c ops over cuFFT/onemkl).
+TPU-native: every transform is a generated schema op (ops/gen/ops.yaml →
+ops/generated_math.py) lowering to jnp.fft — XLA's FFT emitter supplies
+the kernel; numpy oracles test each one in the OpTest harness.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.ops.generated_math import (  # noqa: F401
+    fft, fft2, fftfreq, fftn, fftshift, hfft, ifft, ifft2, ifftn,
+    ifftshift, ihfft, irfft, irfft2, irfftn, rfft, rfft2, rfftfreq, rfftn)
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft",
+           "irfft", "rfft2", "irfft2", "rfftn", "irfftn", "hfft", "ihfft",
+           "fftshift", "ifftshift", "fftfreq", "rfftfreq"]

@@ -17,7 +17,8 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["NativePredictor", "default_plugin_path", "native_available"]
+__all__ = ["NativePredictor", "NativePredictorPool", "default_plugin_path",
+           "native_available"]
 
 # keep in sync with code_to_pjrt/pjrt_to_code in predictor.cpp
 _DTYPE_CODES = {
@@ -80,6 +81,8 @@ def _lib():
     lib.pd_predictor_output_copy.argtypes = [
         ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_int64]
     lib.pd_predictor_destroy.argtypes = [ctypes.c_void_p]
+    lib.pd_predictor_clone.restype = ctypes.c_void_p
+    lib.pd_predictor_clone.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -193,7 +196,44 @@ class NativePredictor:
             outs.append(raw)
         return outs
 
+    def _clone(self) -> "NativePredictor":
+        """Share the compiled executable + device params; own out buffers
+        (csrc pd_predictor_clone — reference PredictorPool semantics)."""
+        h = self._lib.pd_predictor_clone(self._h)
+        if not h:
+            raise RuntimeError("clone failed: "
+                               + self._lib.pd_predictor_last_error().decode())
+        twin = object.__new__(NativePredictor)
+        twin._lib = self._lib
+        twin._h = h
+        twin._owner = self  # keep the owner (and its buffers) alive
+        return twin
+
     def __del__(self):
         if getattr(self, "_h", None):
             self._lib.pd_predictor_destroy(self._h)
             self._h = None
+
+
+class NativePredictorPool:
+    """N request slots over ONE compiled executable and ONE device-resident
+    parameter set (reference PredictorPool over AnalysisPredictor::Clone):
+    slot 0 owns the client/executable/params, the rest are clones with
+    their own output buffers, so concurrent requests on different slots
+    don't race on results."""
+
+    def __init__(self, model_prefix: str, size: int = 1,
+                 plugin_path: Optional[str] = None,
+                 options: Optional[str] = None):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        first = NativePredictor(model_prefix, plugin_path=plugin_path,
+                                options=options)
+        self._predictors = [first] + [first._clone()
+                                      for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> NativePredictor:
+        return self._predictors[idx]
+
+    def __len__(self):
+        return len(self._predictors)

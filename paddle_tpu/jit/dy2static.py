@@ -87,12 +87,76 @@ def cond_call(pred, true_fn, false_fn, operands, needed):
             fixed.append(jax.numpy.zeros(()))
         else:
             fixed.append(v)
+    if any(v is None for v in fixed):
+        # None marks the not-yet-set early-return value (__jst_rv): its
+        # type comes from whichever branch assigns it — trace the branches
+        # abstractly with scalar probes, then seed a typed zeros
+        # placeholder (sound: the value is only ever READ under the
+        # return flag, which is False until a real assignment happened)
+        import jax.numpy as jnp
+        probe = tuple(jnp.zeros(()) if v is None else v for v in fixed)
+        branch_avals = []
+        for branch in (true_fn, false_fn):
+            try:
+                branch_avals.append(jax.eval_shape(branch, probe))
+            except Exception:
+                pass
+        new_fixed = []
+        for i, v in enumerate(fixed):
+            if v is not None:
+                new_fixed.append(v)
+                continue
+            # prefer the branch that actually ASSIGNED the slot (its aval
+            # differs from the scalar probe); scalar zero if neither did
+            aval = None
+            probe_aval = jax.eval_shape(lambda: probe[i])
+            for avs in branch_avals:
+                a = avs[i]
+                # the assigning branch's output differs from the probe
+                # in SHAPE OR DTYPE (an int return must not be seeded
+                # with a float placeholder)
+                if (a.shape, a.dtype) != (probe_aval.shape,
+                                          probe_aval.dtype) \
+                        or len(branch_avals) == 1:
+                    aval = a
+                    break
+            new_fixed.append(jnp.zeros(aval.shape, aval.dtype)
+                             if aval is not None else jnp.zeros(()))
+        fixed = new_fixed
     try:
         return jax.lax.cond(raw, true_fn, false_fn, tuple(fixed))
     except TypeError as e:
         raise TypeError(
             "dy2static: the branches of a TRACED `if` must bind the same "
-            "variables with matching shapes/dtypes") from e
+            "variables with matching shapes/dtypes (early returns under a "
+            "traced condition must be type-stable across paths)") from e
+
+
+def bool_not(x):
+    """Traced-safe `not` (the early-exit flags may be traced)."""
+    raw = x._data if hasattr(x, "_data") else x
+    if _is_traced(raw):
+        import jax.numpy as jnp
+        return jnp.logical_not(raw)
+    return not raw
+
+
+def bool_and(a, b):
+    ar = a._data if hasattr(a, "_data") else a
+    br = b._data if hasattr(b, "_data") else b
+    if _is_traced(ar) or _is_traced(br):
+        import jax.numpy as jnp
+        return jnp.logical_and(ar, br)
+    return ar and br
+
+
+def bool_or(a, b):
+    ar = a._data if hasattr(a, "_data") else a
+    br = b._data if hasattr(b, "_data") else b
+    if _is_traced(ar) or _is_traced(br):
+        import jax.numpy as jnp
+        return jnp.logical_or(ar, br)
+    return ar or br
 
 
 def range_cont(i, stop, step):
@@ -104,23 +168,59 @@ def range_cont(i, stop, step):
     return jnp.where(raw > 0, i < stop, i > stop)
 
 
-def while_call(cond_fn, body_fn, carry):
-    """while-statement runtime: carry is the tuple of loop variables
-    (UNDEF entries are body-local temps with no pre-loop value)."""
+def while_call(cond_fn, body_fn, carry, seedable=None):
+    """while-statement runtime: carry is the tuple of loop variables.
+
+    UNDEF entries are body-local temps with no pre-loop value; entries
+    marked ``seedable`` (statically proven written-before-read in the
+    body — e.g. a nested loop's induction/flag temps) get a typed zeros
+    placeholder inferred from one abstract body evaluation; the rest
+    raise loudly.  ``None`` entries are not-yet-set early-return values,
+    promoted the same way."""
     first = cond_fn(carry)
     raw = first._data if hasattr(first, "_data") else first
     if not _is_traced(raw) and not any(
             _is_traced(v._data if hasattr(v, "_data") else v)
             for v in jax.tree.leaves(carry)):
-        while _concrete_bool(cond_fn(carry)):
+        # python path while everything is concrete; a traced `if` inside
+        # the body (e.g. an early return on traced data) can inject
+        # tracers into the carry mid-loop — hand the REMAINING iterations
+        # to lax.while_loop then instead of crashing on bool(tracer)
+        while True:
+            c = cond_fn(carry)
+            craw = c._data if hasattr(c, "_data") else c
+            if _is_traced(craw):
+                break
+            if not bool(craw):
+                return carry
             carry = body_fn(carry)
-        return carry
+            if any(_is_traced(v._data if hasattr(v, "_data") else v)
+                   for v in jax.tree.leaves(carry)):
+                break
 
-    if any(v is UNDEF for v in carry):
+    if seedable is None:
+        seedable = (False,) * len(carry)
+    if any(v is UNDEF and not s for v, s in zip(carry, seedable)):
         raise TypeError(
             "dy2static: a TRACED `while` body introduces a variable with "
             "no pre-loop value; initialise it before the loop so the "
             "carry has a stable type")
+
+    if any(v is UNDEF or v is None for v in carry):
+        # infer placeholder types from one abstract body evaluation
+        # (cond_call promotes inner Nones); sound for seedable slots —
+        # their pre-loop value is never read
+        import jax.numpy as jnp
+        probe = tuple(jnp.zeros(()) if v is UNDEF else v for v in carry)
+        try:
+            avals = jax.eval_shape(body_fn, probe)
+            carry = tuple(
+                jnp.zeros(a.shape, a.dtype)
+                if (v is None or v is UNDEF) else v
+                for v, a in zip(carry, avals))
+        except Exception:
+            carry = tuple(jnp.zeros(()) if (v is None or v is UNDEF)
+                          else v for v in carry)
 
     def cond_raw(c):
         out = cond_fn(c)
@@ -268,6 +368,9 @@ def _read_names(nodes):
 
 
 def _check_no_flow_escape(nodes, what):
+    """Break/continue/return that survived the early-exit rewrites (e.g.
+    inside non-range for loops the converter leaves as python) still can't
+    be functionalized — keep the loud diagnostic."""
     class V(ast.NodeVisitor):
         def visit_Return(self, node):
             raise _Unsupported(
@@ -289,6 +392,192 @@ def _check_no_flow_escape(nodes, what):
 
     for n in nodes:
         V().visit(n)
+
+
+# -- early-exit rewrites (reference: jit/dy2static's
+#    break_continue_transformer.py + return_transformer.py) ------------------
+
+def _name(n, ctx=ast.Load):
+    return ast.Name(id=n, ctx=ctx())
+
+def _assign(target, value):
+    return ast.Assign(targets=[_name(target, ast.Store)], value=value)
+
+def _call(fn, *args):
+    return ast.Call(func=_name(fn), args=list(args), keywords=[])
+
+def _not(expr):
+    # traced-safe: the flags these expressions read may be jax tracers
+    return _call("__jst_not", expr)
+
+def _and(a, b):
+    return _call("__jst_and", a, b)
+
+def _or(a, b):
+    return _call("__jst_or", a, b)
+
+
+def _contains_here(nodes, types, *, through_loops=True):
+    """Does any statement contain a node of `types`, NOT descending into
+    nested function defs (and optionally not into nested loops — break /
+    continue bind to the nearest loop)?"""
+    found = []
+
+    class V(ast.NodeVisitor):
+        def generic_visit(self, node):
+            if isinstance(node, types):
+                found.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if not through_loops and isinstance(node, (ast.While, ast.For)):
+                return
+            super().generic_visit(node)
+
+    for n in nodes:
+        V().visit(n)
+    return len(found) > 0
+
+
+class _BreakContinueRewriter(ast.NodeTransformer):
+    """Replace this loop's break/continue with flag assignments (does not
+    descend into nested loops or defs — they own their own statements)."""
+
+    def __init__(self, brk, cont):
+        self.brk = brk
+        self.cont = cont
+
+    def visit_Break(self, node):
+        return _assign(self.brk, ast.Constant(True))
+
+    def visit_Continue(self, node):
+        return _assign(self.cont, ast.Constant(True))
+
+    def visit_While(self, node):
+        return node  # nested loop: its breaks are its own
+
+    def visit_For(self, node):
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _guard_tail(stmts, flag_names):
+    """After any statement that may set an exit flag, wrap the REST of the
+    list in `if not (flag or ...):` — recursively inside If arms, so
+    post-break code never runs once a flag is up (reference
+    break_continue_transformer's BreakContinueTransformer)."""
+    def sets_flag(st):
+        for sub in ast.walk(st):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name) and t.id in flag_names:
+                        return True
+        return False
+
+    out = []
+    for i, st in enumerate(stmts):
+        if isinstance(st, ast.If):
+            st = ast.If(test=st.test,
+                        body=_guard_tail(st.body, flag_names),
+                        orelse=_guard_tail(st.orelse, flag_names))
+        elif isinstance(st, ast.While):
+            st = ast.While(test=st.test,
+                           body=_guard_tail(st.body, flag_names),
+                           orelse=st.orelse)
+        elif isinstance(st, ast.For):
+            st = ast.For(target=st.target, iter=st.iter,
+                         body=_guard_tail(st.body, flag_names),
+                         orelse=st.orelse)
+        out.append(st)
+        if sets_flag(st):
+            rest = _guard_tail(stmts[i + 1:], flag_names)
+            if rest:
+                cond = _name(flag_names[0])
+                for fn_ in flag_names[1:]:
+                    cond = _or(cond, _name(fn_))
+                out.append(ast.If(test=_not(cond), body=rest, orelse=[]))
+            return out
+    return out
+
+
+class _ReturnRewriter(ast.NodeTransformer):
+    """Function-level pass: turn every `return expr` into
+    `__jst_ret = True; __jst_rv = expr`, guard following statements, and
+    make every loop test include `not __jst_ret` (reference
+    return_transformer.py).  Applied only when some return sits inside a
+    compound statement (a plain trailing return needs nothing)."""
+
+    RET, RV = "__jst_ret", "__jst_rv"
+
+    def visit_Return(self, node):
+        # rv BEFORE the flag: _guard_tail guards everything after the
+        # first flag-set statement, and the value assignment must not be
+        # swallowed by its own guard
+        value = node.value if node.value is not None else ast.Constant(None)
+        return [_assign(self.RV, value),
+                _assign(self.RET, ast.Constant(True))]
+
+    def visit_FunctionDef(self, node):
+        return node  # nested defs own their returns
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _rewrite_returns(fdef):
+    """Apply the return rewrite when any return is non-trivially placed."""
+    nested = any(
+        _contains_here([st], (ast.Return,))
+        for st in fdef.body
+        if isinstance(st, (ast.If, ast.While, ast.For, ast.Try, ast.With)))
+    if not nested:
+        return
+    rw = _ReturnRewriter()
+    fdef.body = [rw.visit(st) for st in fdef.body]
+    # flatten lists the Return rewrite produced
+    flat = []
+    for st in fdef.body:
+        flat.extend(st if isinstance(st, list) else [st])
+    body = _guard_tail(flat, [_ReturnRewriter.RET])
+    prologue = [_assign(_ReturnRewriter.RET, ast.Constant(False)),
+                _assign(_ReturnRewriter.RV, ast.Constant(None))]
+    fdef.body = prologue + body + [
+        ast.Return(value=_name(_ReturnRewriter.RV))]
+
+
+def _rewrite_break_continue(node, uid):
+    """Rewrite a While body's break/continue into guarded flags; returns
+    (init_stmts, new_body, new_test)."""
+    has_brk = _contains_here(node.body, (ast.Break,), through_loops=False)
+    has_cont = _contains_here(node.body, (ast.Continue,),
+                              through_loops=False)
+    if not (has_brk or has_cont):
+        return [], node.body, node.test
+    brk = f"__jst_brk_{uid}"
+    cont = f"__jst_cont_{uid}"
+    rw = _BreakContinueRewriter(brk, cont)
+    body = []
+    for st in node.body:
+        new = rw.visit(st)
+        body.extend(new if isinstance(new, list) else [new])
+    flags = [f for f, used in ((brk, True), (cont, has_cont)) if used]
+    body = _guard_tail(body, flags)
+    # both flags need PRE-loop values too: they ride the while carry, and
+    # a traced while_loop needs a stable carry type from iteration zero
+    init = [_assign(brk, ast.Constant(False))]
+    prologue = []
+    if has_cont:
+        init.append(_assign(cont, ast.Constant(False)))
+        prologue = [_assign(cont, ast.Constant(False))]
+    test = _and(node.test, _not(_name(brk)))
+    return init, prologue + body, test
 
 
 def _names_tuple(names, ctx):
@@ -375,15 +664,31 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while -> while_call -------------------------------------------------
     def visit_While(self, node):
-        self.generic_visit(node)
         if node.orelse:
             raise _Unsupported("dy2static: while/else is not supported")
+        # a body that can set the early-return flag must stop the loop —
+        # applied HERE (not in _ReturnRewriter) so for-range loops, which
+        # only become While at conversion time, get the same exit test
+        ret = _ReturnRewriter.RET
+        if any(isinstance(sub, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == ret
+                for t in sub.targets)
+               for st in node.body for sub in ast.walk(st)):
+            node = ast.While(test=_and(node.test, _not(_name(ret))),
+                             body=node.body, orelse=node.orelse)
+        # rewrite THIS loop's break/continue into guarded flags before
+        # any conversion (reference break_continue_transformer.py); the
+        # guard ifs it introduces are then converted like user ifs
+        self._uid += 1
+        bc_init, bc_body, bc_test = _rewrite_break_continue(node, self._uid)
+        node = ast.While(test=bc_test, body=bc_body, orelse=[])
+        self.generic_visit(node)
         _check_no_flow_escape(node.body, "while")
         # carry = every var the body assigns (the test reads them through
         # the carry, not a stale closure)
         carried = _assigned_names(node.body)
         if not carried:
-            return node
+            return bc_init + [node] if bc_init else node
         carry_name = self._fresh("carry")
         unpack = ast.Assign(
             targets=[_names_tuple(carried, ast.Store)],
@@ -406,27 +711,34 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                  body=ast.Name(id=n, ctx=ast.Load()))],
                 keywords=[]) for n in carried],
             ctx=ast.Load())
+        # a carried name written before any read in the body never needs
+        # its pre-loop value — mark it seedable so while_call can give a
+        # typed placeholder when it is unbound at loop entry (nested
+        # loops' induction/flag temps live in the enclosing body)
+        rbs = _read_before_store(node.body)
+        seedable = ast.Tuple(
+            elts=[ast.Constant(n not in rbs) for n in carried],
+            ctx=ast.Load())
         call = ast.Assign(
             targets=[_names_tuple(carried, ast.Store)],
             value=ast.Call(
                 func=ast.Name(id="__jst_while_call", ctx=ast.Load()),
                 args=[ast.Name(id=cname, ctx=ast.Load()),
                       ast.Name(id=bname, ctx=ast.Load()),
-                      init_carry],
+                      init_carry, seedable],
                 keywords=[]))
-        return [cond_def, body_def, call]
+        return bc_init + [cond_def, body_def, call]
 
     # -- for i in range(...) -> while ---------------------------------------
     def visit_For(self, node):
-        self.generic_visit(node)
         is_range = (isinstance(node.iter, ast.Call)
                     and isinstance(node.iter.func, ast.Name)
                     and node.iter.func.id == "range"
                     and isinstance(node.target, ast.Name))
         if not is_range or node.orelse:
+            self.generic_visit(node)
             return node  # non-range iteration stays Python (unrolled
             # under trace — reference does the same for non-tensor iters)
-        _check_no_flow_escape(node.body, "for")
         i = node.target.id
         rargs = node.iter.args
         if len(rargs) == 1:
@@ -454,12 +766,19 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         incr = ast.AugAssign(target=ast.Name(id=i, ctx=ast.Store()),
                              op=ast.Add(),
                              value=ast.Name(id=step_name, ctx=ast.Load()))
-        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        # rewrite break/continue against THIS loop before appending the
+        # increment: `continue` must skip the rest of the body but still
+        # advance the induction variable (python range semantics)
+        self._uid += 1
+        bc_init, bc_body, bc_test = _rewrite_break_continue(
+            ast.While(test=test, body=node.body, orelse=[]), self._uid)
+        loop = ast.While(test=bc_test, body=bc_body + [incr], orelse=[])
         for n in init:
             ast.copy_location(n, node)
         ast.copy_location(loop, node)
+        ast.fix_missing_locations(loop)
         rewritten = self.visit_While(loop)
-        out = list(init)
+        out = list(init) + list(bc_init)
         out.extend(rewritten if isinstance(rewritten, list) else [rewritten])
         return out
 
@@ -491,6 +810,10 @@ def convert_to_static(fn):
         return fn
     # drop decorators (they already ran to produce this call)
     fdef.decorator_list = []
+    # returns nested in compound statements become flag+value assignments
+    # (reference return_transformer.py) BEFORE control-flow conversion, so
+    # the introduced guards convert like user ifs
+    _rewrite_returns(fdef)
     new = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new)
 
@@ -500,6 +823,9 @@ def convert_to_static(fn):
     glb["__jst_undef_lookup"] = undef_lookup
     glb["__jst_UNDEF"] = UNDEF
     glb["__jst_range_cont"] = range_cont
+    glb["__jst_not"] = bool_not
+    glb["__jst_and"] = bool_and
+    glb["__jst_or"] = bool_or
     # snapshot closure cells (the recompiled fn has no closure)
     if fn.__closure__:
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):

@@ -132,7 +132,7 @@ class OpTest:
         names = list(self.inputs)
         arrays = [jnp.asarray(self.inputs[n]) for n in names]
         want = self._ref_out()
-        multi = isinstance(want, tuple)
+        multi = isinstance(want, (tuple, list))
 
         dtype = arrays[0].dtype if arrays else np.float32
         rtol, atol = _tol_for(dtype, self.rtol, self.atol)

@@ -1,8 +1,9 @@
 """paddle_tpu.vision — models + transforms (reference:
 python/paddle/vision/)."""
 
+from paddle_tpu.vision import datasets  # noqa: F401
 from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import ops  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
 
-__all__ = ["models", "ops", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms"]

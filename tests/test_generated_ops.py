@@ -28,8 +28,17 @@ def test_generated_file_in_sync():
         "python -m paddle_tpu.ops.gen.generate")
 
 
-def test_schema_covers_100_ops():
-    assert len(ENTRIES) >= 100
+def test_schema_covers_300_ops():
+    """VERDICT r3 item 7 'done' criterion: >= 300 generated ops."""
+    assert len(ENTRIES) >= 300
+
+
+def test_fft_module_surface():
+    import paddle_tpu
+    import numpy as np_
+    out = paddle_tpu.fft.rfft(paddle_tpu.to_tensor(
+        np_.ones(8, np_.float32)))
+    assert out.shape == [5]
 
 
 def _oracle_fn(entry):
@@ -49,6 +58,34 @@ def _oracle_fn(entry):
     return fn
 
 
+def _expr_ns(seed=0):
+    """Tiny input DSL for `kind: expr` entries: deterministic generators
+    usable in the yaml's `inputs:` expressions."""
+    rng = np.random.default_rng(seed)
+
+    def rand(*shape, lo=-1.0, hi=1.0, dtype=np.float32):
+        return (rng.uniform(lo, hi, shape)).astype(dtype)
+
+    def randint(lo, hi, shape, dtype=np.int64):
+        return rng.integers(lo, hi, shape).astype(dtype)
+
+    def mask(*shape, p=0.5):
+        return rng.uniform(0, 1, shape) < p
+
+    def perm(n):
+        return rng.permutation(n).astype(np.int64)
+
+    def sorted_(*shape, lo=-1.0, hi=1.0):
+        return np.sort(rng.uniform(lo, hi, shape).astype(np.float32), -1)
+
+    def posdef(n):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+    return {"np": np, "rand": rand, "randint": randint, "mask": mask,
+            "perm": perm, "sorted": sorted_, "posdef": posdef}
+
+
 def _cases(entry):
     t = entry.get("test") or {}
     kind = t.get("kind", "skip")
@@ -62,8 +99,20 @@ def _cases(entry):
     grad = t.get("grad", True)
     grad_rtol = t.get("grad_rtol")
     attrs = t.get("attrs") or {}
-    kw = dict(attrs=attrs, grad_inputs=None if grad else [],
-              grad_rtol=grad_rtol)
+    kw = dict(attrs=attrs, grad_rtol=grad_rtol,
+              rtol=t.get("rtol"), atol=t.get("atol"))
+    if kind == "expr":
+        # declarative inputs: {name: "<expression over the DSL>"}; grad may
+        # be a LIST of input names (default: no grad check — most expr ops
+        # are indexing/integer ops)
+        ns = _expr_ns()
+        inputs = {n: eval(src, dict(ns))  # noqa: S307 — in-repo schema
+                  for n, src in (t.get("inputs") or {}).items()}
+        gi = grad if isinstance(grad, list) else ([] if grad in (
+            True, False) else [])
+        return [op_case(op, ref, inputs, grad_inputs=gi,
+                        out_index=t.get("out_index", 0), **kw)]
+    kw["grad_inputs"] = None if grad else []
     if kind == "binary":
         shapes = [((3, 4), (3, 4)), ((2, 3, 4), (3, 4)), ((3, 1), (1, 4))]
         return [op_case(op, ref, {"x": _rand(sx, np.float32, lo, hi),
