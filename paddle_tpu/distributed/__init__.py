@@ -41,7 +41,7 @@ from paddle_tpu.distributed.elastic import (  # noqa: F401
     ElasticAgent, ElasticManager)
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     AutoCheckpoint, Converter, async_save_state_dict, load_state_dict,
-    save_state_dict)
+    save_state_dict, validate_checkpoint)
 
 __all__ = [
     "ParallelEnv", "init_parallel_env", "get_rank", "get_world_size",
@@ -62,6 +62,7 @@ __all__ = [
     "ring_attention", "ulysses_attention", "make_ring_attention",
     "make_ulysses_attention",
     "checkpoint", "save_state_dict", "load_state_dict",
-    "async_save_state_dict", "Converter", "AutoCheckpoint",
+    "async_save_state_dict", "validate_checkpoint", "Converter",
+    "AutoCheckpoint",
     "ElasticAgent", "ElasticManager",
 ]

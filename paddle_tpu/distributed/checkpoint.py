@@ -1,4 +1,4 @@
-"""Distributed checkpoint: sharded save/load + cross-mesh re-slicing.
+"""Distributed checkpoint: per-shard sharded save/load + cross-mesh re-slicing.
 
 Reference parity (SURVEY.md §5.4): per-rank shard saves
 (``PipelineLayer.save_state_dict`` pp_layers.py:794), auto-parallel
@@ -6,73 +6,294 @@ Reference parity (SURVEY.md §5.4): per-rank shard saves
 (static/converter.py) that re-slices checkpoints when mesh/sharding change;
 auto-checkpoint epoch-resume (fluid/incubate/checkpoint/auto_checkpoint.py).
 
-TPU-native design: under single-controller SPMD every jax.Array is GLOBAL —
-a checkpoint saves the global view (fetched shard-by-shard via
-``.addressable_shards``), so "conversion" between parallel layouts happens
-for free at load: ``device_put`` against the NEW mesh/specs re-slices.
-Async save (the orbax pattern) snapshots device arrays to host then writes
-on a background thread so the train loop never blocks on disk.
+TPU-native design — NEVER-GLOBAL:
+  * save: each process writes ONLY its addressable shards (replica 0 of
+    each global piece), one ``.npy`` file per shard, plus a per-process
+    JSON index recording each shard's global offsets.  No global array is
+    ever materialized — a 70B optimizer state streams out shard-by-shard.
+  * load: ``jax.make_array_from_callback`` against the NEW mesh/specs; the
+    callback assembles exactly the requested region from the (mmapped)
+    shard files that overlap it.  Re-sharding across mesh/layout changes —
+    the reference Converter's job — is therefore free at load time and
+    still never builds the global tensor on any single host.
+  * async save (the orbax pattern): snapshot addressable shards to host
+    synchronously (cheap D2H), write files on a background thread so the
+    train loop never blocks on disk.
+
+Format 2 layout (format 1 = one global .npy per tensor remains loadable):
+
+    path/
+      index.0.json            # per-process shard index
+      index.1.json
+      <name>.shard.<o0a-o0b>_<o1a-o1b>.npy   # one file per unique shard
+      checkpoint_meta.json    # sentinel, written last by process 0
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
-           "Converter", "AutoCheckpoint"]
+           "validate_checkpoint", "Converter", "AutoCheckpoint"]
 
 _SENTINEL = "checkpoint_meta.json"
 
 
-def _to_host(arr) -> np.ndarray:
-    """Device → host.  Multi-host jax.Arrays are not fully addressable, so
-    np.asarray would raise; gather the global value across processes first
-    (every process participates — the coordinator gets the full array)."""
-    if hasattr(arr, "_data"):
-        arr = arr._data
-    if getattr(arr, "is_fully_addressable", True):
-        return np.asarray(arr)
-    from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+def _unwrap(arr):
+    return arr._data if hasattr(arr, "_data") else arr
+
+
+def _norm_offsets(index: Tuple, shape) -> List[List[int]]:
+    """Tuple-of-slices → [[start, stop], ...] with Nones resolved."""
+    out = []
+    for dim, sl in zip(shape, index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _shard_fname(name: str, offsets: List[List[int]]) -> str:
+    safe = name.replace("/", "__")
+    if not offsets:
+        return f"{safe}.shard.npy"
+    tag = "_".join(f"{a}-{b}" for a, b in offsets)
+    return f"{safe}.shard.{tag}.npy"
+
+
+def _snapshot_shards(state_dict: Dict[str, Any],
+                     coordinator_rank: int = 0) -> Dict[str, dict]:
+    """Device → host, addressable shards only (replica 0 of each piece).
+
+    Returns {name: {global_shape, dtype, shards: [(offsets, np_data)]}}.
+    Host memory touched = this process's shards, never the global array.
+    Host-only (non-jax.Array) values are written by `coordinator_rank`.
+    """
+    import jax
+    plan: Dict[str, dict] = {}
+    proc = jax.process_index()
+    for name, arr in state_dict.items():
+        a = _unwrap(arr)
+        if isinstance(a, jax.Array):
+            shards = []
+            for sh in a.addressable_shards:
+                if sh.replica_id != 0:
+                    continue  # replicated piece: exactly one writer globally
+                offsets = _norm_offsets(sh.index, a.shape)
+                shards.append((offsets, np.asarray(sh.data)))
+            plan[name] = {"global_shape": list(a.shape),
+                          "dtype": str(a.dtype), "shards": shards}
+        else:
+            np_arr = np.asarray(a)
+            shards = []
+            if proc == coordinator_rank:  # host-only values: written once
+                offsets = [[0, d] for d in np_arr.shape]
+                shards = [(offsets, np_arr)]
+            plan[name] = {"global_shape": list(np_arr.shape),
+                          "dtype": str(np_arr.dtype), "shards": shards}
+    return plan
+
+
+def _purge_stale(path: str):
+    """Remove any previous checkpoint artifacts so a re-save under a
+    different sharding cannot leave stale offset-tagged shard files that
+    a later load would merge with the new ones."""
+    for pattern in ("index.*.json", "*.shard.npy", "*.shard.*.npy"):
+        for f in glob.glob(os.path.join(glob.escape(path), pattern)):
+            os.remove(f)
+    sentinel = os.path.join(path, _SENTINEL)
+    if os.path.exists(sentinel):
+        os.remove(sentinel)
+
+
+def _write_plan(plan: Dict[str, dict], path: str, barrier: bool = True):
+    """Write this process's shards + index; process 0 purges stale
+    artifacts first and writes the sentinel last (with cross-process
+    barriers when running multi-controller)."""
+    import jax
+    proc, nprocs = jax.process_index(), jax.process_count()
+    os.makedirs(path, exist_ok=True)
+    # Purge previous artifacts so a re-save under a different sharding
+    # can't leave stale shard files.  Multi-controller async saves skip
+    # the purge entirely (no barrier is possible off the main thread, so
+    # purging could race peers' writes) — async callers must use fresh
+    # step dirs, which AutoCheckpoint always does.
+    if proc == 0 and (nprocs == 1 or barrier):
+        _purge_stale(path)
+    if nprocs > 1 and barrier:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_purge:{path}")
+    index = {}
+    for name, tmeta in plan.items():
+        entries = []
+        for offsets, data in tmeta["shards"]:
+            fname = _shard_fname(name, offsets)
+            np.save(os.path.join(path, fname), data)
+            entries.append({"file": fname, "offsets": offsets})
+        index[name] = {"global_shape": tmeta["global_shape"],
+                       "dtype": tmeta["dtype"], "shards": entries}
+    with open(os.path.join(path, f"index.{proc}.json"), "w") as f:
+        json.dump({"tensors": index, "process": proc}, f)
+    if nprocs > 1 and barrier:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(f"ckpt_save:{path}")
+    if proc == 0:
+        with open(os.path.join(path, _SENTINEL), "w") as f:
+            json.dump({"format": 2, "nprocs": nprocs}, f)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0):
-    """Write {name: array} to `path/` (one .npy per tensor + metadata).
-    Multi-host: only process 0 writes (arrays are global; for giant arrays
-    pass through async_save to overlap)."""
-    import jax
-    if jax.process_index() != coordinator_rank:
-        return
-    os.makedirs(path, exist_ok=True)
-    meta = {}
-    for name, arr in state_dict.items():
-        np_arr = _to_host(arr)
-        fname = name.replace("/", "__") + ".npy"
-        np.save(os.path.join(path, fname), np_arr)
-        meta[name] = {"file": fname, "shape": list(np_arr.shape),
-                      "dtype": str(np_arr.dtype)}
-    with open(os.path.join(path, _SENTINEL), "w") as f:
-        json.dump({"tensors": meta, "format": 1}, f)
+    """Write {name: array} to `path/`, one file per addressable shard.
+    Every process participates and writes only what it owns;
+    `coordinator_rank` selects the writer for host-only (non-jax.Array)
+    values.  `process_group` is accepted for reference-API compatibility
+    (sharding already determines ownership under SPMD)."""
+    _write_plan(_snapshot_shards(state_dict, coordinator_rank), path)
+
+
+def _merge_indexes(path: str, expected_nprocs: Optional[int] = None
+                   ) -> Dict[str, dict]:
+    idx_files = sorted(glob.glob(os.path.join(glob.escape(path),
+                                              "index.*.json")))
+    if expected_nprocs is not None and len(idx_files) != expected_nprocs:
+        raise ValueError(
+            f"checkpoint has {len(idx_files)} index files but was written "
+            f"by {expected_nprocs} processes — a writer crashed mid-save; "
+            "tensors it owned would silently vanish, refusing to load")
+    merged: Dict[str, dict] = {}
+    for idx_file in idx_files:
+        with open(idx_file) as f:
+            tensors = json.load(f)["tensors"]
+        for name, tmeta in tensors.items():
+            if name not in merged:
+                merged[name] = {"global_shape": tmeta["global_shape"],
+                                "dtype": tmeta["dtype"], "shards": []}
+            merged[name]["shards"].extend(tmeta["shards"])
+    return merged
+
+
+def _tile_region(shards: List[dict], want: List[List[int]]):
+    """For the shard entries overlapping region `want`, return
+    [(shard, src_slices, dst_slices)] after verifying they tile the region
+    EXACTLY — disjoint (duplicates/stale files must not mask a hole) and
+    fully covering.  Raises ValueError otherwise.  Shared by the real read
+    path (_read_region) and the metadata-only validator so the two can
+    never disagree on what a complete checkpoint is."""
+    covered, placed, out = 0, [], []
+    for sh in shards:
+        src_sl, dst_sl, empty = [], [], False
+        for (wa, wb), (sa, sb) in zip(want, sh["offsets"]):
+            lo, hi = max(wa, sa), min(wb, sb)
+            if lo >= hi:
+                empty = True
+                break
+            src_sl.append(slice(lo - sa, hi - sa))
+            dst_sl.append(slice(lo - wa, hi - wa))
+        if empty:
+            continue
+        dst_rng = [(s.start, s.stop) for s in dst_sl]
+        for prev in placed:
+            if all(a < pb and pa < b
+                   for (a, b), (pa, pb) in zip(dst_rng, prev)):
+                raise ValueError(
+                    f"checkpoint shards overlap within region {want} — "
+                    "duplicate or stale shard files from a previous save")
+        placed.append(dst_rng)
+        out.append((sh, tuple(src_sl), tuple(dst_sl)))
+        covered += int(np.prod([b - a for a, b in dst_rng]))
+    size = int(np.prod([b - a for a, b in want]))
+    if covered != size:
+        raise ValueError(
+            f"checkpoint region {want} is under-covered by shard files "
+            f"({covered}/{size} elements) — missing/partial shards "
+            "(peer crashed mid-write?)")
+    return out
+
+
+def _check_0d(shards: List[dict]):
+    if not shards:
+        raise ValueError("checkpoint 0-d tensor is under-covered: its "
+                         "single shard file is missing (owner process "
+                         "crashed mid-write?)")
+    if len(shards) > 1:
+        raise ValueError("checkpoint 0-d tensor has duplicate shard "
+                         "files — stale artifacts from a previous save")
+
+
+def _read_region(path: str, tmeta: dict, index: Tuple,
+                 cache: Optional[dict] = None) -> np.ndarray:
+    """Assemble exactly the requested global region from the shard files
+    that overlap it.  Files are mmapped so only the overlapping bytes are
+    read — loading a [vocab,d] slice never touches the rest of the file.
+    `cache` (per-tensor) keeps memmaps open across the one-callback-per-
+    device-region calls make_array_from_callback issues."""
+    gshape = tmeta["global_shape"]
+    dtype = np.dtype(tmeta["dtype"])
+    if not gshape:  # 0-d
+        _check_0d(tmeta["shards"])
+        return np.load(os.path.join(path, tmeta["shards"][0]["file"]))
+    want = _norm_offsets(index, gshape) if index else [[0, d] for d in gshape]
+    out = np.empty([b - a for a, b in want], dtype)
+    if cache is None:
+        cache = {}
+    for sh, src_sl, dst_sl in _tile_region(tmeta["shards"], want):
+        data = cache.get(sh["file"])
+        if data is None:
+            data = np.load(os.path.join(path, sh["file"]), mmap_mode="r")
+            cache[sh["file"]] = data
+        out[dst_sl] = data[src_sl]
+    return out
 
 
 def load_state_dict(path: str, mesh=None,
                     specs: Optional[Dict[str, Any]] = None,
                     dtype=None) -> Dict[str, Any]:
-    """Load a checkpoint; if `mesh`+`specs` are given, each array is placed
-    with its NamedSharding — this IS the reference Converter: a checkpoint
-    written under any previous parallel layout loads into any new one."""
+    """Load a checkpoint; if `mesh`+`specs` are given, each array is built
+    directly into its NamedSharding via make_array_from_callback — this IS
+    the reference Converter: a checkpoint written under any previous
+    parallel layout loads into any new one, and no host ever holds more
+    than the shards its devices need."""
     import jax
     import jax.numpy as jnp
     with open(os.path.join(path, _SENTINEL)) as f:
-        meta = json.load(f)["tensors"]
+        meta = json.load(f)
+    if meta.get("format", 1) < 2:  # legacy: one global .npy per tensor
+        return _load_format1(path, meta["tensors"], mesh, specs, dtype)
+    tensors = _merge_indexes(path, expected_nprocs=meta.get("nprocs"))
     out = {}
-    for name, info in meta.items():
+    for name, tmeta in tensors.items():
+        gshape = tuple(tmeta["global_shape"])
+        tgt_dtype = np.dtype(tmeta["dtype"])
+        if dtype is not None and np.issubdtype(tgt_dtype, np.floating):
+            tgt_dtype = np.dtype(dtype)
+
+        mmap_cache: dict = {}
+
+        def cb(index, _tm=tmeta, _dt=tgt_dtype, _cache=mmap_cache):
+            region = _read_region(path, _tm, index, cache=_cache)
+            return region.astype(_dt, copy=False)
+
+        if mesh is not None and specs is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(mesh, specs.get(name, P()))
+            out[name] = jax.make_array_from_callback(gshape, sharding, cb)
+        else:
+            out[name] = jnp.asarray(cb(()))
+    return out
+
+
+def _load_format1(path, tensors, mesh, specs, dtype):
+    import jax
+    import jax.numpy as jnp
+    out = {}
+    for name, info in tensors.items():
         arr = np.load(os.path.join(path, info["file"]))
         if dtype is not None and np.issubdtype(arr.dtype, np.floating):
             arr = arr.astype(dtype)
@@ -85,12 +306,57 @@ def load_state_dict(path: str, mesh=None,
     return out
 
 
+def validate_checkpoint(path: str) -> bool:
+    """Metadata-only global completeness check: sentinel + all per-process
+    index files present, every referenced shard file on disk, and every
+    tensor's FULL global region exactly tiled by its shard entries.
+
+    Because it inspects only (shared-storage) metadata — never local
+    device regions — every process reaches the SAME verdict, which is what
+    lets multi-controller ``restore_latest`` agree on a resume step."""
+    try:
+        with open(os.path.join(path, _SENTINEL)) as f:
+            meta = json.load(f)
+        if meta.get("format", 1) < 2:
+            return all(os.path.exists(os.path.join(path, i["file"]))
+                       for i in meta["tensors"].values())
+        tensors = _merge_indexes(path, expected_nprocs=meta.get("nprocs"))
+        for tmeta in tensors.values():
+            shards = tmeta["shards"]
+            for sh in shards:
+                if not os.path.exists(os.path.join(path, sh["file"])):
+                    return False
+            gshape = tmeta["global_shape"]
+            if not gshape:
+                _check_0d(shards)  # raises → caught below
+            else:
+                _tile_region(shards, [[0, d] for d in gshape])
+        return True
+    except (ValueError, OSError, KeyError, json.JSONDecodeError):
+        return False
+
+
 class _AsyncSave:
-    def __init__(self, thread):
-        self.thread = thread
+    """Handle for an in-flight background save.  The writer's exception
+    (disk full, permissions) is captured and re-raised from ``wait()`` —
+    a checkpoint that silently failed to write is worse than a crash."""
+
+    def __init__(self, target, args, kwargs):
+        self.error: Optional[BaseException] = None
+
+        def run():
+            try:
+                target(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — re-raised in wait()
+                self.error = e
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
 
     def wait(self):
         self.thread.join()
+        if self.error is not None:
+            raise self.error
 
     def done(self):
         return not self.thread.is_alive()
@@ -98,37 +364,27 @@ class _AsyncSave:
 
 def async_save_state_dict(state_dict: Dict[str, Any], path: str,
                           coordinator_rank: int = 0) -> _AsyncSave:
-    """Snapshot to host memory synchronously (cheap: D2H over PCIe/DMA),
-    write to disk on a background thread (the orbax async pattern).
+    """Snapshot this process's shards to host synchronously (cheap D2H),
+    write them on a background thread (the orbax async pattern).  Host
+    memory cost = local shards only, never the global state.
 
-    Multi-host: all processes participate in the snapshot only for arrays
-    that need a cross-process gather; otherwise non-coordinator ranks skip
-    the host copy entirely (no wasted host memory)."""
-    import jax
-    if jax.process_count() > 1 and jax.process_index() != coordinator_rank:
-        # participate in collective gathers for non-addressable arrays,
-        # drop the result immediately
-        for arr in state_dict.values():
-            a = arr._data if hasattr(arr, "_data") else arr
-            if not getattr(a, "is_fully_addressable", True):
-                _to_host(a)
-        t = threading.Thread(target=lambda: None, daemon=True)
-        t.start()
-        return _AsyncSave(t)
-    host_copy = {name: _to_host(arr) for name, arr in state_dict.items()}
-    t = threading.Thread(target=save_state_dict, args=(host_copy, path),
-                         daemon=True)
-    t.start()
-    return _AsyncSave(t)
+    Multi-controller note: the background thread skips the cross-process
+    barrier (collectives must not run off the main thread), so the
+    sentinel may appear before slow peers finish.  Call ``.wait()`` on
+    every process and then barrier on the main thread before treating the
+    checkpoint as globally complete — ``AutoCheckpoint.maybe_save`` does
+    exactly this for the previous in-flight save at the next interval."""
+    plan = _snapshot_shards(state_dict, coordinator_rank)
+    return _AsyncSave(_write_plan, (plan, path), {"barrier": False})
 
 
 class Converter:
     """Reference static/converter.py parity: re-slice a checkpoint between
     parallel strategies.  On TPU both directions are mechanical because the
-    stored artifact is the global tensor:
+    stored artifact is an offset-indexed set of shards:
 
-      merge:  per-shard files + dist attrs → global (``merge_with_dist_attr``)
-      slice:  global → per-device shards    (``device_put`` on load)
+      merge:  shard files + offsets → any requested region (lazy, mmapped)
+      slice:  ``make_array_from_callback`` against the new mesh on load
     """
 
     def __init__(self, checkpoint_path: str):
@@ -216,35 +472,85 @@ class AutoCheckpoint:
     def _step_dir(self, step: int) -> str:
         return os.path.join(self.dir, f"step_{step:012d}")
 
+    def _complete_steps(self) -> List[int]:
+        """Steps whose checkpoints pass the metadata validator, newest
+        first.  The sentinel alone is not proof — an async multi-controller
+        save cut down mid-write leaves a sentinel over missing shards."""
+        return sorted(
+            (s for s in (int(n[5:]) for n in os.listdir(self.dir)
+                         if n.startswith("step_"))
+             if validate_checkpoint(self._step_dir(s))), reverse=True)
+
     def latest_step(self) -> Optional[int]:
-        steps = []
-        for name in os.listdir(self.dir):
-            if name.startswith("step_") and os.path.exists(
-                    os.path.join(self.dir, name, _SENTINEL)):
-                steps.append(int(name[5:]))
-        return max(steps) if steps else None
+        """Newest step restore_latest would actually restore — callers
+        pairing `latest_step()` with `restore_latest()` stay consistent."""
+        steps = self._complete_steps()
+        return steps[0] if steps else None
 
     def maybe_save(self, step: int, state_dict: Dict[str, Any]):
         if step % self.interval:
             return None
+        import jax
         if self._pending is not None:
             self._pending.wait()  # backpressure: one in flight
-        self._pending = async_save_state_dict(state_dict,
-                                              self._step_dir(step))
+            if jax.process_count() > 1:
+                # all writer threads have finished locally; barrier on the
+                # MAIN thread so the previous checkpoint is globally
+                # complete before we start (and before _gc could touch it)
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("ckpt_prev_complete")
+        step_dir = self._step_dir(step)
+        if os.path.exists(step_dir):
+            # leftover from a crashed save at this step (possibly under a
+            # different sharding) — the async writer skips the stale-file
+            # purge, so guarantee its fresh-dir invariant here, on the
+            # main thread where a cross-process barrier is legal
+            import shutil
+            if jax.process_index() == 0:
+                shutil.rmtree(step_dir, ignore_errors=True)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(f"ckpt_fresh:{step}")
+        self._pending = async_save_state_dict(state_dict, step_dir)
         self._gc(step)
         return self._pending
 
     def restore_latest(self, mesh=None, specs=None):
-        step = self.latest_step()
-        if step is None:
+        """Restore from the newest LOADABLE checkpoint.  The sentinel can
+        exist for an incomplete multi-controller async save that was cut
+        down mid-write; the under-coverage check in load surfaces that, and
+        we fall back to the next-older checkpoint instead of failing the
+        whole resume."""
+        steps = self._complete_steps()
+        if not steps:
             return None, None
-        return step, load_state_dict(self._step_dir(step), mesh=mesh,
-                                     specs=specs)
+        # The metadata validator is deterministic over shared storage, so
+        # every process picks the SAME step.  A load failure on a
+        # validated checkpoint is a real storage fault — propagate it
+        # rather than silently restarting from step 0 (where subsequent
+        # saves + GC would destroy the surviving good checkpoints).
+        return steps[0], load_state_dict(self._step_dir(steps[0]),
+                                         mesh=mesh, specs=specs)
 
     def _gc(self, current_step: int):
-        steps = sorted(s for s in (
-            int(n[5:]) for n in os.listdir(self.dir)
-            if n.startswith("step_")) if s < current_step)
+        """Keep the newest `keep-1` COMPLETE checkpoints (the in-flight
+        `current_step` save will make `keep`); incomplete leftovers (a
+        crashed save — validator-failing, same definition restore uses)
+        are useless and always removed."""
         import shutil
-        for s in steps[:-(self.keep - 1)] if self.keep > 1 else steps:
+        complete, partial = [], []
+        for n in os.listdir(self.dir):
+            if not n.startswith("step_"):
+                continue
+            s = int(n[5:])
+            if s >= current_step:
+                continue
+            if validate_checkpoint(self._step_dir(s)):
+                complete.append(s)
+            else:
+                partial.append(s)
+        complete.sort()
+        doomed = partial + (
+            complete[:-(self.keep - 1)] if self.keep > 1 else complete)
+        for s in doomed:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
