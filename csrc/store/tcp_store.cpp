@@ -83,6 +83,23 @@ class Server {
     return true;
   }
 
+  // Wait until at most `max_clients` connections remain (the master's own
+  // client fd counts).  Lets the master drain peers before stop(): a peer
+  // whose final barrier poll is in flight gets its response instead of a
+  // reset connection (torch TCPStore wait_for_workers semantics).
+  bool wait_clients(int max_clients, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (true) {
+      {
+        std::lock_guard<std::mutex> lk(conn_mu_);
+        if (static_cast<int>(conn_fds_.size()) <= max_clients) return true;
+      }
+      if (std::chrono::steady_clock::now() > deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(2));
+    }
+  }
+
   void stop() {
     running_.store(false);
     ::shutdown(listen_fd_, SHUT_RDWR);
@@ -222,6 +239,13 @@ void* tcpstore_server_start(int port) {
     return nullptr;
   }
   return s;
+}
+
+int tcpstore_server_wait_clients(void* handle, int max_clients,
+                                 int timeout_ms) {
+  auto* s = static_cast<Server*>(handle);
+  if (!s) return -1;
+  return s->wait_clients(max_clients, timeout_ms) ? 0 : -1;
 }
 
 void tcpstore_server_stop(void* handle) {

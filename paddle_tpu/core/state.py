@@ -13,7 +13,11 @@ import jax
 
 _lock = threading.Lock()
 _default_dtype = "float32"
-_key = jax.random.key(0)
+# Lazy: creating a key initializes the XLA backend, which must not happen
+# at import time — multi-controller users need `import paddle_tpu` →
+# `distributed.init_parallel_env()` (jax.distributed.initialize) to run
+# BEFORE any backend-touching call.
+_key = None
 _seed = 0
 
 
@@ -49,11 +53,17 @@ def next_key():
     """Split the global eager key and return a fresh subkey."""
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.key(_seed)
         _key, sub = jax.random.split(_key)
     return sub
 
 
 def get_rng_state():
+    global _key
+    with _lock:
+        if _key is None:
+            _key = jax.random.key(_seed)
     return jax.random.key_data(_key)
 
 

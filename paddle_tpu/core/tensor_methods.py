@@ -94,6 +94,19 @@ def _install():
     Tensor.dim = lambda self: self.ndim
     Tensor.rank = lambda self: self.ndim
     Tensor.element_size = lambda self: jnp.dtype(self._data.dtype).itemsize
+    # Tensor.T property (python/paddle/tensor/attribute.py role): reverse
+    # ALL dims — paddle semantics, unlike numpy's 2-d-only convention
+    Tensor.T = property(lambda self: manipulation.transpose(
+        self, list(range(self.ndim))[::-1]))
+    Tensor.mT = property(_mT)
+
+
+def _mT(self):
+    if self.ndim < 2:
+        raise ValueError(
+            f"Tensor.mT needs ndim >= 2, got shape {self.shape}")
+    return manipulation.transpose(
+        self, list(range(self.ndim - 2)) + [self.ndim - 1, self.ndim - 2])
 
 
 _install()

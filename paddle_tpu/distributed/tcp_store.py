@@ -19,7 +19,8 @@ __all__ = ["TCPStore"]
 
 def _lib():
     from paddle_tpu.utils.cpp_extension import load_native
-    lib = load_native("store")
+    lib = load_native("store",
+                      required_symbol="tcpstore_server_wait_clients")
     lib.tcpstore_server_start.restype = ctypes.c_void_p
     lib.tcpstore_server_start.argtypes = [ctypes.c_int]
     lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
@@ -37,6 +38,9 @@ def _lib():
                                  ctypes.c_int64]
     lib.tcpstore_check.restype = ctypes.c_int
     lib.tcpstore_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.tcpstore_server_wait_clients.restype = ctypes.c_int
+    lib.tcpstore_server_wait_clients.argtypes = [ctypes.c_void_p,
+                                                 ctypes.c_int, ctypes.c_int]
     lib.tcpstore_close.argtypes = [ctypes.c_int]
     return lib
 
@@ -126,6 +130,12 @@ class TCPStore:
             self._lib.tcpstore_close(self._fd)
             self._fd = -1
         if self._server:
+            # drain peers first: a client whose final barrier poll is in
+            # flight must get its response, not a reset connection.  Short
+            # grace only — shutdown must not hang for the full rendezvous
+            # timeout when workers are still alive (elastic error paths)
+            grace_ms = int(min(self.timeout, 5.0) * 1000)
+            self._lib.tcpstore_server_wait_clients(self._server, 0, grace_ms)
             self._lib.tcpstore_server_stop(self._server)
             self._server = None
 

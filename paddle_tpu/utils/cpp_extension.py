@@ -34,9 +34,12 @@ def _build():
             f"native build failed:\n{res.stdout}\n{res.stderr}")
 
 
-def load_native(name: str, build_if_missing: bool = True
+def load_native(name: str, build_if_missing: bool = True,
+                required_symbol: Optional[str] = None
                 ) -> Optional[ctypes.CDLL]:
-    """Load libpt_<name>.so, building csrc/ on first use."""
+    """Load libpt_<name>.so, building csrc/ on first use.  A stale build
+    missing `required_symbol` (the source gained a C API since the .so was
+    last built) triggers a rebuild instead of an AttributeError later."""
     with _lock:
         if name in _cache:
             return _cache[name]
@@ -45,6 +48,11 @@ def load_native(name: str, build_if_missing: bool = True
             if not build_if_missing:
                 return None
             _build()
+        elif required_symbol is not None and build_if_missing:
+            probe = ctypes.CDLL(path)
+            if not hasattr(probe, required_symbol):
+                del probe
+                _build()
         if not os.path.exists(path):
             # optional component whose build prerequisites are absent
             # (e.g. the predictor needs the PJRT C API header); cache the

@@ -1,0 +1,91 @@
+"""Multi-controller worker: launched (2 processes) by the launch CLI from
+``test_multicontroller.py``.  NOT a pytest file.
+
+Flow mirrors the reference's real-multi-process test strategy
+(test/legacy_test/test_parallel_dygraph_dataparallel.py:100,156): pre-init
+barrier through the native TCPStore, rendezvous via
+``init_parallel_env`` → ``jax.distributed.initialize``, one DP train step
+over the global (2 procs × 2 virtual CPU devices) mesh, then a per-shard
+distributed checkpoint save where each process writes only its own shards.
+Rank 0 dumps loss/grads for the parent to compare against a
+single-process run.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+out_dir = sys.argv[1]
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+# (a) pre-init barrier on the native TCPStore (the reference bootstrap's
+# store role, tcp_store.h:120) — proves the C++ store works cross-process
+from paddle_tpu.distributed.tcp_store import TCPStore  # noqa: E402
+
+host = os.environ["PADDLE_MASTER"].rsplit(":", 1)[0]
+store_port = int(os.environ["PADDLE_STORE_PORT"])  # parent-verified free
+store = TCPStore(host, store_port, is_master=(rank == 0),
+                 world_size=world, timeout=60.0)
+store.barrier("preinit")
+
+# (b) jax.distributed.initialize rendezvous (must precede any backend use)
+import paddle_tpu.distributed as dist  # noqa: E402
+
+env = dist.init_parallel_env()
+assert env.world_size == world, (env.world_size, world)
+assert jax.device_count() == 2 * world
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+# deterministic params + global batch (identical in the parent's 1-proc run)
+rs = np.random.RandomState(0)
+w1 = rs.randn(8, 16).astype(np.float32)
+w2 = rs.randn(16, 4).astype(np.float32)
+xg = rs.randn(8, 8).astype(np.float32)
+yg = rs.randint(0, 4, size=(8, 1))
+
+mesh = Mesh(np.array(jax.devices()).reshape(-1), ("dp",))
+repl = NamedSharding(mesh, P())
+row = NamedSharding(mesh, P("dp"))
+
+params = {
+    "w1": jax.make_array_from_callback(w1.shape, repl, lambda i: w1[i]),
+    "w2": jax.make_array_from_callback(w2.shape, repl, lambda i: w2[i]),
+}
+x = jax.make_array_from_callback(xg.shape, row, lambda i: xg[i])
+y = jax.make_array_from_callback(yg.shape, row, lambda i: yg[i])
+
+
+def loss_fn(p, xb, yb):
+    h = jnp.tanh(xb @ p["w1"])
+    logits = h @ p["w2"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, yb, axis=1))
+
+
+step = jax.jit(jax.value_and_grad(loss_fn),
+               out_shardings=(repl, {"w1": repl, "w2": repl}))
+loss, grads = step(params, x, y)
+
+# (c) per-shard checkpoint: each process writes ONLY its own dp shards
+ckpt_dir = os.path.join(out_dir, "ckpt")
+w1_sharded = jax.device_put(params["w1"], NamedSharding(mesh, P("dp", None)))
+dist.save_state_dict({"w1": w1_sharded, "step": 1}, ckpt_dir)
+
+if rank == 0:
+    np.savez(os.path.join(out_dir, "grads.npz"),
+             w1=np.asarray(grads["w1"]), w2=np.asarray(grads["w2"]))
+    with open(os.path.join(out_dir, "result.json"), "w") as f:
+        json.dump({"loss": float(loss), "world": env.world_size,
+                   "devices": jax.device_count()}, f)
+store.barrier("done")
+store.close()

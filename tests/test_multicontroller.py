@@ -1,0 +1,100 @@
+"""Multi-controller execution proof (VERDICT r3 Missing #3).
+
+Spawns 2 REAL processes through the launch CLI; they barrier on the native
+TCPStore, rendezvous via ``distributed.env.init_parallel_env`` →
+``jax.distributed.initialize`` (gloo CPU collectives), run a DP train step
+over the 4-device global mesh, and write a per-shard checkpoint.  The
+parent asserts loss/grad parity against the identical single-process
+computation and that the checkpoint really is per-process-sharded.
+
+Reference pattern: test/legacy_test/test_parallel_dygraph_dataparallel.py
+(N procs on one host, compare against serial run).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mc_train_worker.py")
+
+
+from paddle_tpu.distributed.elastic import free_port as _free_port  # noqa: E402
+
+
+def _single_process_reference():
+    """The worker's math, eagerly, in this (already-initialized) process."""
+    import jax
+    import jax.numpy as jnp
+    rs = np.random.RandomState(0)
+    w1 = jnp.asarray(rs.randn(8, 16).astype(np.float32))
+    w2 = jnp.asarray(rs.randn(16, 4).astype(np.float32))
+    x = jnp.asarray(rs.randn(8, 8).astype(np.float32))
+    y = jnp.asarray(rs.randint(0, 4, size=(8, 1)))
+
+    def loss_fn(p, xb, yb):
+        h = jnp.tanh(xb @ p["w1"])
+        logits = h @ p["w2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, yb, axis=1))
+
+    loss, grads = jax.value_and_grad(loss_fn)({"w1": w1, "w2": w2}, x, y)
+    return float(loss), grads
+
+
+def test_two_process_dp_parity(tmp_path):
+    port = _free_port()
+    store_port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_STORE_PORT"] = str(store_port)
+    # scrub any leftover rendezvous env from the pytest process
+    for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_MASTER"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{port}",
+         "--log_dir", str(tmp_path / "logs"), WORKER, str(tmp_path)],
+        env=env, timeout=300, capture_output=True, text=True)
+    logs = ""
+    log_dir = tmp_path / "logs"
+    if log_dir.exists():
+        for f in sorted(log_dir.iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-4000:]
+    assert proc.returncode == 0, f"launcher rc={proc.returncode}\n{logs}"
+
+    with open(tmp_path / "result.json") as f:
+        result = json.load(f)
+    assert result["world"] == 2
+    assert result["devices"] == 4
+
+    ref_loss, ref_grads = _single_process_reference()
+    assert abs(result["loss"] - ref_loss) < 1e-5
+
+    dumped = np.load(tmp_path / "grads.npz")
+    np.testing.assert_allclose(dumped["w1"], np.asarray(ref_grads["w1"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dumped["w2"], np.asarray(ref_grads["w2"]),
+                               rtol=1e-5, atol=1e-6)
+
+    # checkpoint written cooperatively: one index per process, w1 split in
+    # 4 dp shards of (2, 16) — no single file holds the global array
+    ckpt = tmp_path / "ckpt"
+    names = os.listdir(ckpt)
+    assert "index.0.json" in names and "index.1.json" in names
+    w1_shards = [n for n in names if n.startswith("w1") and ".shard." in n]
+    assert len(w1_shards) == 4
+    for n in w1_shards:
+        assert np.load(ckpt / n).shape == (2, 16)
+
+    import paddle_tpu.distributed as dist
+    assert dist.validate_checkpoint(str(ckpt))
+    loaded = dist.load_state_dict(str(ckpt))
+    rs = np.random.RandomState(0)
+    np.testing.assert_allclose(np.asarray(loaded["w1"]),
+                               rs.randn(8, 16).astype(np.float32))
+    assert int(loaded["step"]) == 1
