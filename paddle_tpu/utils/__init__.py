@@ -8,8 +8,12 @@ built with make+g++ and bound via ctypes (no pybind11 in this image).
 """
 
 from paddle_tpu.utils.cpp_extension import load_native  # noqa: F401
+from paddle_tpu.utils.register_op import (  # noqa: F401
+    check_registered_op, get_registered_op, register_op, registered_ops,
+    unregister_op)
 
-__all__ = ["load_native"]
+__all__ = ["load_native", "register_op", "get_registered_op",
+           "registered_ops", "unregister_op", "check_registered_op"]
 
 
 def try_import(name: str):

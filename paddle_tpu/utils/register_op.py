@@ -1,0 +1,144 @@
+"""Out-of-tree custom-op registration — the TPU-native custom-op story.
+
+Reference parity: ``PD_BUILD_OP`` (paddle/phi/api/ext/op_meta_info.h:874 —
+name + kernel fn + infer-meta + optional grad kernel registered into the
+global OpMetaInfoMap) and the JIT build toolchain
+(python/paddle/utils/cpp_extension/cpp_extension.py).  On TPU the "kernel"
+is a pure-jax or Pallas callable, the infer-meta is jax abstract eval, and
+the build step is XLA's — so registration reduces to wiring the callable
+into the framework's three integration points:
+
+  1. the dual-mode dispatcher (``eager_op``): the op works on Tensors with
+     tape autograd AND on raw arrays under jit;
+  2. the ``OP_INFO`` schema registry (sharding hint for GSPMD consumers,
+     arg/attr signature, custom_vjp flag) — same record the generated ops
+     carry;
+  3. the OpTest harness: a registered numpy oracle + example inputs make
+     the op auto-testable with ``check_registered_op`` (output parity in
+     eager/jit/functional modes, gradients vs finite differences) — the
+     reference's OpTest-over-custom-op flow (test_custom_relu_op_setup.py).
+
+A worked Pallas-kernel registration lives in
+tests/test_register_op.py::test_pallas_custom_op.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, Optional
+
+__all__ = ["register_op", "get_registered_op", "registered_ops",
+           "unregister_op", "check_registered_op"]
+
+# name -> record; separate from OP_INFO so the oracle/example factories
+# (test-only payload) don't leak into the schema registry
+_CUSTOM_OPS: Dict[str, dict] = {}
+
+
+def register_op(name: str, impl: Callable, *,
+                vjp: Optional[tuple] = None,
+                sharding: str = "elementwise",
+                oracle: Optional[Callable] = None,
+                example_inputs: Optional[Callable] = None,
+                attrs: Optional[Dict] = None,
+                namespace=None) -> Callable:
+    """Register an out-of-tree op and return its dual-mode callable.
+
+    Args:
+        name: op name; must not collide with an existing OP_INFO entry.
+        impl: pure function over raw jax arrays (jnp ops or a Pallas
+            ``pallas_call``).  Positional array args + keyword attrs.
+        vjp: optional ``(fwd, bwd)`` pair wired via ``jax.custom_vjp`` —
+            ``fwd(*args, **attrs) -> (out, residuals)``,
+            ``bwd(residuals, cotangent) -> tuple(d_args)``.  The reference's
+            grad-kernel slot in PD_BUILD_OP.
+        sharding: GSPMD hint recorded in OP_INFO ('elementwise',
+            'contraction', 'reduction', ... — same vocabulary as ops.yaml).
+        oracle: numpy reference implementation (enables the OpTest harness).
+        example_inputs: zero-arg callable returning {arg_name: np.ndarray}
+            used by ``check_registered_op``.
+        attrs: default attr dict recorded in the schema.
+        namespace: optional module/object to also ``setattr(name, op)`` on
+            (e.g. ``paddle_tpu.incubate``).
+
+    Returns:
+        The wrapped op: accepts Tensors (eager, tape-recorded) or raw
+        arrays (jit/functional), like every built-in op.
+    """
+    from paddle_tpu.core.dispatch import eager_op
+    from paddle_tpu.ops.generated_math import OP_INFO
+
+    if name in OP_INFO or name in _CUSTOM_OPS:
+        raise ValueError(f"op '{name}' is already registered")
+
+    try:
+        params = list(inspect.signature(impl).parameters.values())
+    except (TypeError, ValueError):  # builtins / partials without signature
+        params = []
+    arg_names = [p.name for p in params
+                 if p.default is inspect.Parameter.empty]
+    attr_names = [p.name for p in params
+                  if p.default is not inspect.Parameter.empty]
+
+    core = impl
+    if vjp is not None:
+        if attr_names:
+            # jax.custom_vjp's nondiff handling would prepend attrs to
+            # bwd's arguments, silently breaking the documented
+            # bwd(residuals, cotangent) contract — demand closures instead
+            raise ValueError(
+                f"op '{name}': vjp ops must take array arguments only "
+                f"(found attr params {attr_names}); close over attrs in "
+                "impl/fwd/bwd (functools.partial) instead")
+        import jax
+        fwd, bwd = vjp
+        core = jax.custom_vjp(impl)
+        core.defvjp(fwd, bwd)
+
+    wrapped = eager_op(core, name=name)
+
+    OP_INFO[name] = {"args": arg_names, "attrs": dict(attrs or {}),
+                     "sharding": sharding, "custom_vjp": vjp is not None,
+                     "custom": True}
+    _CUSTOM_OPS[name] = {"op": wrapped, "impl": impl, "oracle": oracle,
+                         "example_inputs": example_inputs,
+                         "attrs": dict(attrs or {})}
+    if namespace is not None:
+        setattr(namespace, name, wrapped)
+    return wrapped
+
+
+def get_registered_op(name: str) -> Callable:
+    return _CUSTOM_OPS[name]["op"]
+
+
+def registered_ops():
+    return sorted(_CUSTOM_OPS)
+
+
+def unregister_op(name: str):
+    """Remove a registration (tests; the reference map is append-only).
+    Only custom entries are removable — built-in schema rows are safe."""
+    from paddle_tpu.ops.generated_math import OP_INFO
+    if _CUSTOM_OPS.pop(name, None) is not None:
+        OP_INFO.pop(name, None)
+
+
+def check_registered_op(name: str, grad: bool = True,
+                        rtol=None, atol=None, grad_rtol=None):
+    """Run the OpTest harness on a registered op: output parity against
+    its numpy oracle in eager/jit/functional modes, plus tape- and
+    jax.grad-vs-finite-difference checks when the op is differentiable.
+
+    The auto-test the reference gives PD_BUILD_OP ops via OpTest
+    (test/custom_op/test_custom_relu_op_setup.py pattern)."""
+    rec = _CUSTOM_OPS[name]
+    if rec["oracle"] is None or rec["example_inputs"] is None:
+        raise ValueError(
+            f"op '{name}' was registered without oracle/example_inputs; "
+            "pass both to make it harness-testable")
+    from paddle_tpu.testing import op_case
+    case = op_case(rec["op"], rec["oracle"], rec["example_inputs"](),
+                   attrs=rec["attrs"], rtol=rtol, atol=atol,
+                   grad_rtol=grad_rtol)
+    case.run(grad=grad)

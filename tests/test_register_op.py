@@ -1,0 +1,173 @@
+"""Custom-op registration story (VERDICT r3 Missing #4).
+
+Reference: PD_BUILD_OP (paddle/phi/api/ext/op_meta_info.h:874) + the
+custom-op OpTest flow (test/custom_op/test_custom_relu_op_setup.py).
+Here: register_op wires an out-of-tree jax/Pallas callable into the
+dispatcher, the OP_INFO schema registry, and the OpTest harness.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.utils import (check_registered_op, get_registered_op,
+                              register_op, registered_ops, unregister_op)
+
+
+@pytest.fixture
+def cleanup():
+    names = []
+    yield names
+    for n in names:
+        unregister_op(n)
+
+
+class TestRegisterOp:
+    def test_basic_jnp_op(self, cleanup):
+        import jax.numpy as jnp
+
+        def softclip(x, alpha=1.0):
+            return jnp.tanh(x * alpha) / alpha
+
+        op = register_op(
+            "softclip_test", softclip, sharding="elementwise",
+            oracle=lambda x, alpha=1.0: np.tanh(x * alpha) / alpha,
+            example_inputs=lambda: {"x": np.random.RandomState(0)
+                                    .randn(3, 4).astype(np.float32)},
+            attrs={"alpha": 1.0})
+        cleanup.append("softclip_test")
+
+        # eager Tensor path with tape autograd
+        t = pp.randn([4, 4])
+        t.stop_gradient = False
+        out = op(t, alpha=2.0)
+        assert type(out).__name__ == "Tensor"
+        out.sum().backward()
+        assert t.grad is not None
+
+        # schema registry
+        from paddle_tpu.ops.generated_math import OP_INFO
+        info = OP_INFO["softclip_test"]
+        assert info["sharding"] == "elementwise"
+        assert info["args"] == ["x"]
+        assert info["custom"] is True
+        assert "softclip_test" in registered_ops()
+        assert get_registered_op("softclip_test") is op
+
+        # the harness auto-tests it: eager/jit/functional output parity +
+        # tape and jax.grad vs central finite differences
+        check_registered_op("softclip_test")
+
+    def test_duplicate_name_rejected(self, cleanup):
+        import jax.numpy as jnp
+        register_op("dup_test", lambda x: x, oracle=lambda x: x)
+        cleanup.append("dup_test")
+        with pytest.raises(ValueError, match="already registered"):
+            register_op("dup_test", lambda x: x)
+        with pytest.raises(ValueError, match="already registered"):
+            register_op("add", jnp.add)  # collides with a built-in
+
+    def test_custom_vjp(self, cleanup):
+        """The grad-kernel slot: a custom_vjp whose backward is a scaled
+        straight-through estimator — detectably different from autodiff."""
+        import jax.numpy as jnp
+
+        def hard_round(x):
+            return jnp.round(x)
+
+        def fwd(x):
+            return jnp.round(x), ()
+
+        def bwd(res, g):
+            return (2.0 * g,)  # STE with a marker factor
+
+        op = register_op("ste_round_test", hard_round, vjp=(fwd, bwd))
+        cleanup.append("ste_round_test")
+        t = pp.to_tensor([0.4, 1.6], stop_gradient=False)
+        op(t).sum().backward()
+        np.testing.assert_allclose(np.asarray(t.grad), [2.0, 2.0])
+
+        import jax
+        g = jax.grad(lambda x: op(x).sum())(jnp.asarray([0.4, 1.6]))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 2.0])
+
+    def test_pallas_custom_op(self, cleanup):
+        """Worked example: an out-of-tree Pallas kernel (fused
+        bias+gelu) with custom_vjp, registered and harness-tested.
+        interpret=True so the kernel runs on the CPU mesh; on TPU the
+        same code compiles to Mosaic."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _kernel(x_ref, b_ref, o_ref):
+            x = x_ref[...] + b_ref[...]
+            o_ref[...] = 0.5 * x * (1 + jnp.tanh(
+                0.7978845608 * (x + 0.044715 * x ** 3)))
+
+        def bias_gelu_pallas(x, b):
+            return pl.pallas_call(
+                _kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=jax.default_backend() != "tpu",
+            )(x, jnp.broadcast_to(b, x.shape))
+
+        def fwd(x, b):
+            return bias_gelu_pallas(x, b), (x, b)
+
+        def bwd(res, g):
+            x, b = res
+            # recompute gelu'(x+b) in plain jax for the backward
+            z = x + b
+            t = jnp.tanh(0.7978845608 * (z + 0.044715 * z ** 3))
+            dgelu = 0.5 * (1 + t) + 0.5 * z * (1 - t ** 2) * \
+                0.7978845608 * (1 + 3 * 0.044715 * z ** 2)
+            gx = g * dgelu
+            return gx, jnp.sum(gx, axis=tuple(range(gx.ndim - 1)))
+
+        def oracle(x, b):
+            z = x + b
+            return 0.5 * z * (1 + np.tanh(
+                0.7978845608 * (z + 0.044715 * z ** 3)))
+
+        rng = np.random.RandomState(0)
+        op = register_op(
+            "bias_gelu_pallas_test", bias_gelu_pallas, vjp=(fwd, bwd),
+            sharding="elementwise", oracle=oracle,
+            example_inputs=lambda: {
+                "x": rng.randn(4, 8).astype(np.float32),
+                "b": rng.randn(8).astype(np.float32)})
+        cleanup.append("bias_gelu_pallas_test")
+
+        # harness: output parity in all modes + grads vs finite differences
+        check_registered_op("bias_gelu_pallas_test", grad_rtol=5e-2)
+
+        # and composes under jit like any op
+        f = jax.jit(functools.partial(op))
+        x = jnp.asarray(rng.randn(2, 8).astype(np.float32))
+        b = jnp.asarray(rng.randn(8).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(f(x, b)),
+                                   oracle(np.asarray(x), np.asarray(b)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_vjp_with_attrs_rejected(self, cleanup):
+        """vjp ops must close over attrs — the harness refuses the
+        footgun where jax would break the bwd(res, g) contract."""
+        import jax.numpy as jnp
+        with pytest.raises(ValueError, match="array arguments only"):
+            register_op("bad_vjp_test",
+                        lambda x, alpha=1.0: x * alpha,
+                        vjp=(lambda x, alpha=1.0: (x * alpha, ()),
+                             lambda res, g: (g,)))
+
+    def test_unregister_cannot_remove_builtin(self, cleanup):
+        from paddle_tpu.ops.generated_math import OP_INFO
+        unregister_op("add")  # silently refuses
+        assert "add" in OP_INFO
+
+    def test_missing_oracle_rejected(self, cleanup):
+        register_op("no_oracle_test", lambda x: x)
+        cleanup.append("no_oracle_test")
+        with pytest.raises(ValueError, match="oracle"):
+            check_registered_op("no_oracle_test")
