@@ -43,7 +43,8 @@ from typing import Optional
 import numpy as np
 
 __all__ = ["plan_llama", "plan_moe", "PlanReport",
-           "LLAMA3_8B", "LLAMA3_70B", "DEEPSEEK_MOE_16B", "CONFIGS"]
+           "LLAMA3_8B", "LLAMA3_70B", "DEEPSEEK_MOE_16B",
+           "ERNIE45_21B_A3B", "CONFIGS"]
 
 
 # -- configs (public architecture numbers) -----------------------------------
@@ -70,6 +71,10 @@ class MoEConfig:
     n_shared: int           # always-on shared experts
     top_k: int
     expert_ffn: int         # per-expert hidden size
+    kv_heads: int = 0       # 0 → MHA (kv_heads == heads); else GQA
+    # note: the planned stack is UNIFORM (lax.scan over layers) — a
+    # first-k-dense layer (DeepSeek/ERNIE first_k_dense_replace=1) is
+    # approximated as MoE, a <1% params overestimate on 28-layer configs
 
 
 LLAMA3_8B = DenseConfig("llama3-8b", vocab=128256, d=4096, ffn=14336,
@@ -79,7 +84,12 @@ LLAMA3_70B = DenseConfig("llama3-70b", vocab=128256, d=8192, ffn=28672,
 DEEPSEEK_MOE_16B = MoEConfig("deepseek-moe-16b", vocab=102400, d=2048,
                              layers=28, heads=16, n_experts=64, n_shared=2,
                              top_k=6, expert_ffn=1408)
-CONFIGS = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, DEEPSEEK_MOE_16B)}
+# ERNIE-4.5-21B-A3B public shape (models/ernie.py ernie45_moe_config)
+ERNIE45_21B_A3B = MoEConfig("ernie45-21b-a3b", vocab=103424, d=2560,
+                            layers=28, heads=20, n_experts=64, n_shared=2,
+                            top_k=6, expert_ffn=1536, kv_heads=4)
+CONFIGS = {c.name: c for c in (LLAMA3_8B, LLAMA3_70B, DEEPSEEK_MOE_16B,
+                               ERNIE45_21B_A3B)}
 
 
 @dataclass
@@ -472,12 +482,13 @@ def moe_avals(cfg: MoEConfig, dtype="bfloat16"):
 
     d, L, H, E, fe = cfg.d, cfg.layers, cfg.heads, cfg.n_experts, \
         cfg.expert_ffn
+    Hk = cfg.kv_heads or H
     hd = d // H
     dt = jnp.dtype(dtype)
     mk = lambda *shape: jax.ShapeDtypeStruct((L,) + shape, dt)
     params = {
         "ln1": mk(d), "ln2": mk(d),
-        "wq": mk(d, H, hd), "wk": mk(d, H, hd), "wv": mk(d, H, hd),
+        "wq": mk(d, H, hd), "wk": mk(d, Hk, hd), "wv": mk(d, Hk, hd),
         "wo": mk(H, hd, d),
         "gate": mk(d, E),
         # routed experts: [L, E, ...] sharded over ep
@@ -502,7 +513,7 @@ def moe_avals(cfg: MoEConfig, dtype="bfloat16"):
         "head": P("fsdp", "tp"),
         "ln_f": P(),
     }
-    n_params = (L * (2 * d + 4 * d * H * hd + d * E
+    n_params = (L * (2 * d + 2 * d * H * hd + 2 * d * Hk * hd + d * E
                      + 3 * E * d * fe + 3 * d * cfg.n_shared * fe)
                 + 2 * cfg.vocab * d + d)
     return params, specs, n_params
@@ -518,6 +529,10 @@ def _moe_block(cfg: MoEConfig, x, lp):
     q = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wq"]), theta=10000.0)
     k = _rope(jnp.einsum("bsd,dhk->bshk", h, lp["wk"]), theta=10000.0)
     v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    if k.shape[2] != q.shape[2]:            # GQA: repeat KV to q heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     attn = _causal_attention_chunked(q, k, v)
     x = x + jnp.einsum("bshk,hkd->bsd", attn, lp["wo"])
 

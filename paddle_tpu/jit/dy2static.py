@@ -8,10 +8,14 @@ statements become calls to runtime helpers that pick plain Python when
 the condition is concrete (eager) and ``lax.cond`` / ``lax.while_loop``
 when it is traced (inside jit), so ONE source serves both modes.
 
-Supported: If / While / for-over-range with single-name assignments in
-the rewritten blocks.  Unsupported constructs (return/break/continue
-inside converted blocks) raise a clear error at conversion time, like
-the reference's transformer diagnostics.
+Supported: If / While / for-over-range including tuple/aug assignments,
+``break`` / ``continue`` inside converted loops (rewritten to guarded
+flags — reference break_continue_transformer.py), and early ``return``
+anywhere (rewritten to a flag + return-value slot — reference
+return_transformer.py).  Genuinely dynamic structure (data-dependent
+shapes, `return` of differently-typed values per branch, iteration over
+traced non-range iterables) still raises a clear error at trace time,
+like the reference's transformer diagnostics.
 """
 
 from __future__ import annotations

@@ -13,9 +13,15 @@ from paddle_tpu.models.gpt import (GPTConfig, GPTDecoderLayer, GPTForCausalLM,
 from paddle_tpu.models.moe_llm import (MoEConfig, MoEDecoderLayer,
                                        MoEForCausalLM, MoEModel)
 from paddle_tpu.models.dit import DiT, DiTBlock, DiTConfig
+from paddle_tpu.models.ernie import (ErnieConfig, ErnieForCausalLM,
+                                     ErnieForMaskedLM,
+                                     ErnieForSequenceClassification,
+                                     ErnieModel, ernie45_moe_config)
 
 __all__ = ["LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
            "LlamaModel", "LlamaForCausalLM",
            "GPTConfig", "GPTDecoderLayer", "GPTModel", "GPTForCausalLM",
            "MoEConfig", "MoEDecoderLayer", "MoEModel", "MoEForCausalLM",
-           "DiTConfig", "DiTBlock", "DiT"]
+           "DiTConfig", "DiTBlock", "DiT",
+           "ErnieConfig", "ErnieModel", "ErnieForSequenceClassification",
+           "ErnieForMaskedLM", "ErnieForCausalLM", "ernie45_moe_config"]

@@ -226,3 +226,90 @@ class TestResNet:
         assert out.shape == (3, 28, 28)
         assert out.dtype == np.float32
         assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+class TestErnie:
+    """ERNIE family (VERDICT r3 Missing #1/#8): encoder NLU models +
+    ERNIE 4.5 MoE decoder (models/ernie.py)."""
+
+    def test_encoder_forward_shapes(self):
+        from paddle_tpu.models import ErnieConfig, ErnieModel
+        pp.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieModel(cfg)
+        ids = pp.to_tensor(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 12)).astype("int32"))
+        h, pooled = model(ids)
+        assert tuple(h.shape) == (2, 12, cfg.hidden_size)
+        assert tuple(pooled.shape) == (2, cfg.hidden_size)
+
+    def test_classifier_trains_to_loss_drop(self):
+        from paddle_tpu.models import (ErnieConfig,
+                                       ErnieForSequenceClassification)
+        pp.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForSequenceClassification(cfg, num_classes=2)
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (8, 12)).astype("int32")
+        # learnable signal: class = whether token 0 appears
+        labels = (ids == 0).any(axis=1).astype("int64")
+        ids_t, y_t = pp.to_tensor(ids), pp.to_tensor(labels)
+        losses = []
+        for _ in range(12):
+            loss = model.loss(ids_t, y_t)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_masked_lm_ignore_index(self):
+        from paddle_tpu.models import ErnieConfig, ErnieForMaskedLM
+        pp.seed(0)
+        cfg = ErnieConfig.tiny()
+        model = ErnieForMaskedLM(cfg)
+        rng = np.random.default_rng(0)
+        ids = pp.to_tensor(rng.integers(0, cfg.vocab_size,
+                                        (2, 10)).astype("int32"))
+        logits = model(ids)
+        assert tuple(logits.shape) == (2, 10, cfg.vocab_size)
+        labels = np.full((2, 10), -100, np.int64)
+        labels[:, 3] = 7          # only one masked position scored
+        loss = model.loss(ids, pp.to_tensor(labels))
+        assert np.isfinite(float(loss))
+
+    def test_ernie45_decoder_train_step(self):
+        from paddle_tpu.models import ErnieForCausalLM, ernie45_moe_config
+        pp.seed(0)
+        cfg = ernie45_moe_config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, num_experts=4,
+            num_experts_per_tok=2, num_shared_experts=1,
+            max_position_embeddings=64, dtype="float32")
+        model = ErnieForCausalLM(cfg)
+        # heterogeneous MoE: first layer dense, second routed+shared
+        assert model.model.layers[0].is_dense
+        assert not model.model.layers[1].is_dense
+        opt = pp.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (4, 17))
+        batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+        losses = [float(step(batch)) for _ in range(6)]
+        assert losses[-1] < losses[0]
+
+    def test_ernie45_sharding_rules(self):
+        from paddle_tpu.models import ErnieForCausalLM, ernie45_moe_config
+        cfg = ernie45_moe_config(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            moe_intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2, num_experts=8,
+            num_experts_per_tok=2, num_shared_experts=1,
+            max_position_embeddings=64, dtype="float32")
+        rules = ErnieForCausalLM.partition_specs(cfg)
+        assert ErnieForCausalLM.spec_for(
+            "model.layers_1.moe.experts.w1", rules) == P("ep", None, "tp")
