@@ -38,6 +38,11 @@ def _topo_order(roots: List[GradNode]):
 
 def _add(a, b):
     """Pairwise grad accumulation; taped when either side carries history."""
+    from paddle_tpu.core.sparse_grad import RowSparseGrad
+    if isinstance(a, RowSparseGrad):
+        return a + b          # sparse+sparse → concat; sparse+dense → dense
+    if isinstance(b, RowSparseGrad):
+        return b + a
     if isinstance(a, Tensor) or isinstance(b, Tensor):
         # both sides must be Tensors: a raw jax.Array's __add__ would coerce
         # the Tensor via __jax_array__ and silently drop its grad history
@@ -99,7 +104,9 @@ def calc_gradients(outputs, inputs, grad_outputs=None, retain_graph=False,
         if g is None:
             result.append(None)
         else:
-            result.append(g if isinstance(g, Tensor) else Tensor._wrap(g))
+            from paddle_tpu.core.sparse_grad import RowSparseGrad
+            result.append(g if isinstance(g, (Tensor, RowSparseGrad))
+                          else Tensor._wrap(g))
     return result
 
 
@@ -215,9 +222,22 @@ def _backward_impl(tensors, grad_tensors, retain_graph, accumulate_into_grad,
             break
 
     if accumulate_into_grad:
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
         for tid, g in leaf_grads.items():
             t = tensor_by_id[tid]
             if t.stop_gradient and t._grad_node is not None:
+                continue
+            if isinstance(g, RowSparseGrad) or \
+                    isinstance(t._grad, RowSparseGrad):
+                # SelectedRows-style grad: stored raw on .grad (the
+                # reference's embedding(sparse=True) grad is a
+                # SelectedRows, not a dense LoDTensor)
+                prev = t._grad
+                if prev is not None and isinstance(prev, Tensor):
+                    prev = prev._data
+                acc = g if prev is None else _add(prev, g)
+                t._grad = acc if isinstance(acc, RowSparseGrad) \
+                    else Tensor._wrap(acc)
                 continue
             g_t = g if isinstance(g, Tensor) else Tensor._wrap(g)
             if t._grad is None:

@@ -1,0 +1,92 @@
+"""Row-sparse gradients — the SelectedRows analog for embedding-scale params.
+
+Reference parity: ``paddle/phi/core/selected_rows.h`` (a {rows, value,
+height} triple used as the gradient type of ``embedding(sparse=True)``)
+plus the sparse-kernel family under ``phi/kernels/selected_rows/``
+(sgd/adam updates proportional to touched rows, ~3.5k LoC).
+
+TPU-native: the triple is two arrays — ``rows`` [N] int32 and ``values``
+[N, d] — and every consumer is a gather/scatter the TPU executes natively:
+  * accumulation  = concatenation (no densification),
+  * optimizer update = ``param.at[rows].add/...`` on the donated buffer,
+  * lazy Adam     = moment gather → rule → scatter, rows-touched only.
+A [vocab, d] dense gradient is never materialized anywhere on the path.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["RowSparseGrad"]
+
+
+class RowSparseGrad:
+    """Gradient of shape `shape` that is zero outside `rows`.
+
+    ``rows`` may contain duplicates (the same token appearing twice in a
+    batch); semantics are scatter-ADD.  ``coalesce()`` returns an
+    equivalent grad with unique rows (summed values) — optimizer moment
+    updates need that form, plain SGD scatter-adds don't.
+    """
+
+    def __init__(self, rows, values, shape: Tuple[int, ...],
+                 coalesced: bool = False):
+        self.rows = jnp.asarray(rows, dtype=jnp.int32)
+        self.values = values
+        self.shape = tuple(shape)
+        self.coalesced = coalesced  # rows known unique → coalesce() no-ops
+        if self.values.shape[1:] != self.shape[1:]:
+            raise ValueError(
+                f"values trailing dims {self.values.shape[1:]} != dense "
+                f"trailing dims {self.shape[1:]}")
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def nnz_rows(self) -> int:
+        return int(self.rows.shape[0])
+
+    def to_dense(self):
+        return jnp.zeros(self.shape, self.values.dtype).at[self.rows].add(
+            self.values)
+
+    def coalesce(self) -> "RowSparseGrad":
+        """Unique rows with summed values (eager-only: output shape is
+        data-dependent).  Idempotent: a grad already marked coalesced is
+        returned as-is (clip coalesces, the optimizer must not re-pay)."""
+        if self.coalesced:
+            return self
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        summed = jnp.zeros((uniq.shape[0],) + self.values.shape[1:],
+                           self.values.dtype).at[inv].add(self.values)
+        return RowSparseGrad(uniq, summed, self.shape, coalesced=True)
+
+    def scale(self, s) -> "RowSparseGrad":
+        return RowSparseGrad(self.rows, self.values * s, self.shape,
+                             coalesced=self.coalesced)
+
+    def astype(self, dtype) -> "RowSparseGrad":
+        return RowSparseGrad(self.rows, self.values.astype(dtype),
+                             self.shape, coalesced=self.coalesced)
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseGrad):
+            if other.shape != self.shape:
+                raise ValueError(f"shape mismatch {self.shape} vs "
+                                 f"{other.shape}")
+            return RowSparseGrad(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.shape)
+        # sparse + dense → dense (mixed consumers forced an upgrade)
+        arr = other._data if hasattr(other, "_data") else jnp.asarray(other)
+        return self.to_dense() + arr
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"RowSparseGrad(shape={self.shape}, "
+                f"nnz_rows={self.nnz_rows}, dtype={self.dtype})")

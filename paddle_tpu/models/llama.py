@@ -44,6 +44,11 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
+    # sparse_embed=True gives the embedding a SelectedRows-style
+    # RowSparseGrad in EAGER training (rows-touched optimizer update, no
+    # dense [vocab, d] grad — core/sparse_grad.py); the jitted TrainStep
+    # path keeps dense grads (XLA fuses its scatter-add)
+    sparse_embed: bool = False
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -175,7 +180,8 @@ class LlamaModel(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
         self.config = config
-        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size)
+        self.embed_tokens = Embedding(config.vocab_size, config.hidden_size,
+                                      sparse=config.sparse_embed)
         self.layers = []
         for i in range(config.num_hidden_layers):
             layer = LlamaDecoderLayer(config)

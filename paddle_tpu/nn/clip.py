@@ -43,10 +43,13 @@ class ClipGradByGlobalNorm(ClipGradBase):
                                             for g in leaves])
 
     def __call__(self, params_grads):
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
         grads = [g for p, g in params_grads if g is not None
                  and getattr(p, "need_clip", True)]
         if not grads:
             return params_grads
+        if any(isinstance(g, RowSparseGrad) for g in grads):
+            return self._call_with_sparse(params_grads)
 
         def _clip(*gs):
             scale, _ = self._scale(gs)
@@ -62,6 +65,38 @@ class ClipGradByGlobalNorm(ClipGradBase):
                 out.append((p, g))
         return out
 
+    def _call_with_sparse(self, params_grads):
+        """Global-norm clip when some grads are RowSparseGrad: a sparse
+        grad's norm is the norm of its COALESCED values (scatter-add
+        semantics: duplicate rows sum before the norm), and clipping
+        scales values in place — still no densification."""
+        from paddle_tpu.core.dispatch import unwrap
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
+        prepared, sq = [], 0.0
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                prepared.append((p, g, False))
+                continue
+            if isinstance(g, RowSparseGrad):
+                g = g.coalesce()
+                sq = sq + jnp.sum(jnp.square(g.values.astype(jnp.float32)))
+            else:
+                sq = sq + jnp.sum(jnp.square(
+                    unwrap(g).astype(jnp.float32)))
+            prepared.append((p, g, True))
+        scale = jnp.minimum(1.0, self.clip_norm
+                            / jnp.maximum(jnp.sqrt(sq), 1e-12))
+        out = []
+        for p, g, clip in prepared:
+            if not clip:
+                out.append((p, g))
+            elif isinstance(g, RowSparseGrad):
+                out.append((p, g.scale(scale).astype(g.dtype)))
+            else:
+                gv = unwrap(g)
+                out.append((p, (gv * scale).astype(gv.dtype)))
+        return out
+
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm=1.0):
@@ -75,10 +110,17 @@ class ClipGradByNorm(ClipGradBase):
         return jax.tree.map(one, grads)
 
     def __call__(self, params_grads):
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, RowSparseGrad):
+                g = g.coalesce()  # duplicate rows sum before the norm
+                n = jnp.linalg.norm(g.values.astype(jnp.float32).ravel())
+                s = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+                out.append((p, g.scale(s).astype(g.dtype)))
                 continue
             out.append((p, dispatch(
                 lambda gv: (gv * jnp.minimum(
@@ -98,10 +140,19 @@ class ClipGradByValue(ClipGradBase):
         return jax.tree.map(lambda g: jnp.clip(g, self.min, self.max), grads)
 
     def __call__(self, params_grads):
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
         out = []
         for p, g in params_grads:
             if g is None or not getattr(p, "need_clip", True):
                 out.append((p, g))
+                continue
+            if isinstance(g, RowSparseGrad):
+                # value-clip is elementwise on the SUMMED grad: coalesce
+                # first so duplicate rows don't get clipped pre-sum
+                g = g.coalesce()
+                out.append((p, RowSparseGrad(
+                    g.rows, jnp.clip(g.values, self.min, self.max),
+                    g.shape, coalesced=True)))
                 continue
             out.append((p, dispatch(lambda gv: jnp.clip(gv, self.min, self.max),
                                     g, op_name="clip_value")))

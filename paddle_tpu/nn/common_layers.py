@@ -53,6 +53,7 @@ class Embedding(Layer):
                  sparse=False, weight_attr=None, name=None):
         super().__init__()
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0) if weight_attr is None
@@ -63,7 +64,8 @@ class Embedding(Layer):
                 self.weight._data.at[padding_idx].set(0.0))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
 
 class Dropout(Layer):

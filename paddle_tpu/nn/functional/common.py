@@ -81,13 +81,35 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return dispatch(_drop, x, op_name="alpha_dropout")
 
 
-@eager_op
-def embedding(x, weight, padding_idx=None, sparse=False):
+def _embedding_pure(x, weight, padding_idx=None):
     out = jnp.take(weight, x, axis=0)
     if padding_idx is not None:
         mask = (x == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros((), out.dtype), out)
     return out
+
+
+_embedding_dense = eager_op(_embedding_pure, name="embedding")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """``sparse=True`` (reference: ``nn.functional.embedding(sparse=True)``
+    → SelectedRows grad, phi/kernels/selected_rows/) produces a
+    ``RowSparseGrad`` for `weight` on the eager tape: rows-touched only,
+    no dense [vocab, d] gradient is ever materialized.  Under jit, with
+    grads disabled, or when `weight` is not a LEAF tensor (an upstream
+    pullback could not consume a sparse cotangent) the dense path runs
+    (XLA fuses the scatter-add)."""
+    if sparse:
+        from paddle_tpu.core.tensor import Tensor, is_grad_enabled
+        from paddle_tpu.core import functional as _func
+        if (isinstance(weight, Tensor) and not weight.stop_gradient
+                and weight._grad_node is None
+                and is_grad_enabled() and not _func.substitution_active()):
+            from paddle_tpu.nn.functional.sparse_embed import (
+                sparse_embedding_lookup)
+            return sparse_embedding_lookup(x, weight, padding_idx)
+    return _embedding_dense(x, weight, padding_idx=padding_idx)
 
 
 @eager_op

@@ -1,0 +1,46 @@
+"""Sparse-gradient embedding lookup — the eager tape node whose backward
+emits a RowSparseGrad (reference: embedding(sparse=True) → SelectedRows,
+paddle/phi/core/selected_rows.h + kernels/selected_rows/).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.core.dispatch import unwrap, wrap_like
+from paddle_tpu.core.sparse_grad import RowSparseGrad
+
+__all__ = ["sparse_embedding_lookup"]
+
+
+class _SparseEmbedding(PyLayer):
+    @staticmethod
+    def forward(ctx, weight, ids, padding_idx):
+        w = unwrap(weight)
+        ctx.ids = ids
+        ctx.padding_idx = padding_idx
+        ctx.wshape = w.shape
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return wrap_like(out)
+
+    @staticmethod
+    def backward(ctx, g):
+        gv = unwrap(g)
+        rows = ctx.ids.reshape(-1)
+        vals = gv.reshape(-1, gv.shape[-1])
+        if ctx.padding_idx is not None:
+            # the padding row receives no gradient (its fwd output was
+            # masked to zero anyway)
+            vals = jnp.where((rows != ctx.padding_idx)[:, None], vals, 0.0)
+        return RowSparseGrad(rows, vals, ctx.wshape)
+
+
+def sparse_embedding_lookup(x, weight, padding_idx=None):
+    ids = unwrap(x)
+    if not jnp.issubdtype(ids.dtype, jnp.integer):
+        raise TypeError(f"embedding ids must be integer, got {ids.dtype}")
+    return _SparseEmbedding.apply(weight, ids, padding_idx)

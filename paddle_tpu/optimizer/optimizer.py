@@ -76,6 +76,16 @@ class Optimizer:
         """Pure rule: arrays in, arrays out. Override in subclasses."""
         raise NotImplementedError
 
+    def _update_sparse(self, param, grad, state, lr, step):
+        """Rule for a RowSparseGrad (embedding(sparse=True) — the
+        reference's selected_rows kernel slot, phi/kernels/selected_rows/).
+        Default: densify (correct for any optimizer); SGD/Adam/AdamW
+        override with rows-touched scatter updates that never build the
+        [vocab, d] dense gradient.  Weight decay under sparse grads is
+        LAZY: it touches only the gradient's rows (reference lazy_mode
+        semantics)."""
+        return self._update(param, grad.to_dense(), state, lr, step)
+
     def _apply_weight_decay(self, param, grad):
         """Default: L2 regularization folded into the gradient (reference
         optimizer.py regularization path). AdamW overrides to decoupled."""
@@ -112,6 +122,7 @@ class Optimizer:
         """Shared by eager and functional paths: optional fp32 master weight
         (kept in the optimizer state under '_master'), weight decay policy,
         then the subclass rule."""
+        from paddle_tpu.core.sparse_grad import RowSparseGrad
         use_master = self._multi_precision and pv.dtype in (
             jnp.bfloat16, jnp.float16)
         if use_master:
@@ -121,10 +132,15 @@ class Optimizer:
             work_p = master
         else:
             work_p = pv
-        if not isinstance(self, _DecoupledWD):
-            gv = self._apply_weight_decay(work_p, gv)
         inner = {k: v for k, v in state.items() if k != "_master"}
-        new_p, new_inner = self._update(work_p, gv, inner, lr, step)
+        if isinstance(gv, RowSparseGrad):
+            # weight decay is applied lazily inside the sparse rule
+            new_p, new_inner = self._update_sparse(work_p, gv, inner, lr,
+                                                   step)
+        else:
+            if not isinstance(self, _DecoupledWD):
+                gv = self._apply_weight_decay(work_p, gv)
+            new_p, new_inner = self._update(work_p, gv, inner, lr, step)
         if use_master:
             new_inner = dict(new_inner)
             new_inner["_master"] = new_p

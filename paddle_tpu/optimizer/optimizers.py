@@ -16,6 +16,17 @@ class SGD(Optimizer):
     def _update(self, p, g, s, lr, step):
         return p - lr * g.astype(p.dtype), s
 
+    def _update_sparse(self, p, g, s, lr, step):
+        """Rows-touched scatter-add (reference sgd selected_rows kernel):
+        no dense [vocab, d] grad/update buffer exists."""
+        if self._weight_decay:
+            g = g.coalesce()  # wd must hit each touched row exactly once
+            vals = g.values.astype(p.dtype) + \
+                self._weight_decay * p[g.rows]
+        else:
+            vals = g.values.astype(p.dtype)
+        return p.at[g.rows].add(-lr * vals), s
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -47,10 +58,55 @@ class Adam(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lazy = bool(lazy_mode)
 
     def _init_state(self, p):
         return {"moment1": jnp.zeros_like(p, dtype=jnp.float32),
                 "moment2": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _sparse_wd(self):
+        """L2-into-grad coefficient for the sparse rule (AdamW overrides:
+        its decay is decoupled)."""
+        return self._weight_decay
+
+    def _decoupled_wd(self):
+        return 0.0
+
+    def _update_sparse(self, p, g, s, lr, step):
+        """Reference adam selected_rows kernel (lazy_mode toggles whether
+        moments decay on untouched rows).  Either way the [vocab, d]
+        dense GRADIENT is never built.
+
+        lazy_mode=True: moments + params update ONLY on touched rows —
+        O(rows) work, the recommender/embedding-scale fast path.
+        lazy_mode=False: full-Adam semantics (moments decay everywhere,
+        every row moves by its mhat/vhat) via moment-wide decay plus a
+        row scatter of the gradient term."""
+        g = g.coalesce()
+        r = g.rows
+        gf = g.values.astype(jnp.float32)
+        if self._sparse_wd():
+            gf = gf + self._sparse_wd() * p[r].astype(jnp.float32)
+        m, v = s["moment1"], s["moment2"]
+        bc1 = 1 - self._beta1 ** step
+        bc2 = 1 - self._beta2 ** step
+        pf = p.astype(jnp.float32)
+        wd = self._decoupled_wd()
+        if self._lazy:
+            m_r = self._beta1 * m[r] + (1 - self._beta1) * gf
+            v_r = self._beta2 * v[r] + (1 - self._beta2) * jnp.square(gf)
+            upd = (m_r / bc1) / (jnp.sqrt(v_r / bc2) + self._eps)
+            if wd:
+                upd = upd + wd * pf[r]
+            new_p = pf.at[r].add(-lr * upd).astype(p.dtype)
+            return new_p, {"moment1": m.at[r].set(m_r),
+                           "moment2": v.at[r].set(v_r)}
+        m = (self._beta1 * m).at[r].add((1 - self._beta1) * gf)
+        v = (self._beta2 * v).at[r].add((1 - self._beta2) * jnp.square(gf))
+        upd = (m / bc1) / (jnp.sqrt(v / bc2) + self._eps)
+        if wd:
+            upd = upd + wd * pf
+        return (pf - lr * upd).astype(p.dtype), {"moment1": m, "moment2": v}
 
     def _update(self, p, g, s, lr, step):
         gf = g.astype(jnp.float32)
@@ -75,6 +131,16 @@ class AdamW(Adam, _DecoupledWD):
         self._weight_decay = float(weight_decay) if weight_decay else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
 
+    def _sparse_wd(self):
+        return 0.0  # decoupled, not folded into the gradient
+
+    def _decoupled_wd(self):
+        wd = self._weight_decay
+        if wd and self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(self._current_param_name or ""):
+            return 0.0
+        return wd
+
     def _update(self, p, g, s, lr, step):
         gf = g.astype(jnp.float32)
         m = self._beta1 * s["moment1"] + (1 - self._beta1) * gf
@@ -82,10 +148,7 @@ class AdamW(Adam, _DecoupledWD):
         mhat = m / (1 - self._beta1 ** step)
         vhat = v / (1 - self._beta2 ** step)
         upd = mhat / (jnp.sqrt(vhat) + self._eps)
-        wd = self._weight_decay
-        if wd and self._apply_decay_param_fun is not None and \
-                not self._apply_decay_param_fun(self._current_param_name or ""):
-            wd = 0.0
+        wd = self._decoupled_wd()
         pf = p.astype(jnp.float32)
         pf = pf - lr * (upd + wd * pf)
         return pf.astype(p.dtype), {"moment1": m, "moment2": v}

@@ -1,0 +1,215 @@
+"""SelectedRows-analog sparse embedding gradients (VERDICT r3 Missing #5).
+
+Reference: paddle/phi/core/selected_rows.h + phi/kernels/selected_rows/
+(sparse sgd/adam, lazy_mode).  Every test checks the sparse path against
+the dense path on identical inputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.core.sparse_grad import RowSparseGrad
+
+
+def _embed_pair(vocab=32, d=8, sparse=True, seed=0):
+    pp.seed(seed)
+    e = pp.nn.Embedding(vocab, d, sparse=sparse)
+    return e
+
+
+def _clone_embed(src, sparse):
+    dst = pp.nn.Embedding(*src.weight.shape, sparse=sparse)
+    dst.weight._set_data(src.weight._data)
+    return dst
+
+
+class TestRowSparseGrad:
+    def test_backward_produces_sparse_grad(self):
+        e = _embed_pair()
+        ids = pp.to_tensor(np.array([[1, 2, 2, 5]], np.int32))
+        out = e(ids)
+        out.sum().backward()
+        g = e.weight.grad
+        assert isinstance(g, RowSparseGrad)
+        assert g.nnz_rows == 4          # duplicates kept until coalesce
+        assert g.shape == tuple(e.weight.shape)
+
+    def test_sparse_grad_matches_dense(self):
+        e_s = _embed_pair(sparse=True)
+        e_d = _clone_embed(e_s, sparse=False)
+        ids = pp.to_tensor(np.array([[3, 7, 3], [0, 1, 7]], np.int32))
+        (e_s(ids) ** 2).sum().backward()
+        (e_d(ids) ** 2).sum().backward()
+        dense_from_sparse = np.asarray(e_s.weight.grad.to_dense())
+        np.testing.assert_allclose(dense_from_sparse,
+                                   np.asarray(e_d.weight.grad),
+                                   rtol=1e-6)
+
+    def test_coalesce_sums_duplicates(self):
+        g = RowSparseGrad(jnp.asarray([2, 5, 2]),
+                          jnp.asarray([[1.0], [2.0], [3.0]]), (8, 1))
+        c = g.coalesce()
+        assert c.nnz_rows == 2
+        np.testing.assert_allclose(np.asarray(c.to_dense()),
+                                   np.asarray(g.to_dense()))
+
+    def test_accumulation_across_backwards(self):
+        e = _embed_pair()
+        ids = pp.to_tensor(np.array([[1, 2]], np.int32))
+        e(ids).sum().backward()
+        e(ids).sum().backward()          # second backward accumulates
+        g = e.weight.grad
+        assert isinstance(g, RowSparseGrad)
+        dense = np.asarray(g.to_dense())
+        assert dense[1].sum() == pytest.approx(2.0 * e.weight.shape[1])
+
+    def test_padding_idx_gets_no_grad(self):
+        e = pp.nn.Embedding(16, 4, padding_idx=0, sparse=True)
+        ids = pp.to_tensor(np.array([[0, 3]], np.int32))
+        e(ids).sum().backward()
+        dense = np.asarray(e.weight.grad.to_dense())
+        np.testing.assert_allclose(dense[0], 0.0)
+        assert dense[3].sum() != 0.0
+
+
+class TestSparseOptimizers:
+    def _train(self, opt_cls, sparse, steps=3, **opt_kw):
+        e = _embed_pair(vocab=32, d=8, sparse=sparse, seed=0)
+        opt = opt_cls(learning_rate=0.1, parameters=e.parameters(), **opt_kw)
+        rng = np.random.default_rng(0)
+        for _ in range(steps):
+            ids = pp.to_tensor(rng.integers(0, 32, (4, 6)).astype("int32"))
+            loss = (e(ids) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(e.weight._data)
+
+    def test_sgd_parity(self):
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.SGD, sparse=True),
+            self._train(pp.optimizer.SGD, sparse=False), rtol=1e-5)
+
+    def test_sgd_weight_decay_touches_rows_only(self):
+        e = _embed_pair(vocab=8, d=2, sparse=True)
+        w0 = np.asarray(e.weight._data).copy()
+        opt = pp.optimizer.SGD(learning_rate=0.1,
+                               parameters=e.parameters(), weight_decay=0.5)
+        ids = pp.to_tensor(np.array([[1]], np.int32))
+        e(ids).sum().backward()
+        opt.step()
+        w1 = np.asarray(e.weight._data)
+        np.testing.assert_allclose(w1[0], w0[0])   # untouched row: no decay
+        assert not np.allclose(w1[1], w0[1])
+
+    def test_adam_nonlazy_parity(self):
+        """lazy_mode=False must match dense Adam exactly (moments decay
+        everywhere)."""
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.Adam, sparse=True),
+            self._train(pp.optimizer.Adam, sparse=False), rtol=1e-5,
+            atol=1e-6)
+
+    def test_adamw_nonlazy_parity(self):
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.AdamW, sparse=True),
+            self._train(pp.optimizer.AdamW, sparse=False), rtol=1e-5,
+            atol=1e-6)
+
+    def test_adam_lazy_touches_rows_only(self):
+        e = _embed_pair(vocab=8, d=2, sparse=True)
+        w0 = np.asarray(e.weight._data).copy()
+        opt = pp.optimizer.Adam(learning_rate=0.1, lazy_mode=True,
+                                parameters=e.parameters())
+        ids = pp.to_tensor(np.array([[2, 5]], np.int32))
+        e(ids).sum().backward()
+        opt.step()
+        w1 = np.asarray(e.weight._data)
+        for r in range(8):
+            if r in (2, 5):
+                assert not np.allclose(w1[r], w0[r])
+            else:
+                np.testing.assert_allclose(w1[r], w0[r])
+
+    def test_adam_lazy_matches_dense_on_touched_rows_first_step(self):
+        """On step 1 from zero moments, lazy and dense Adam agree on the
+        touched rows."""
+        e_s = _embed_pair(sparse=True)
+        e_d = _clone_embed(e_s, sparse=False)
+        opt_s = pp.optimizer.Adam(learning_rate=0.1, lazy_mode=True,
+                                  parameters=e_s.parameters())
+        opt_d = pp.optimizer.Adam(learning_rate=0.1,
+                                  parameters=e_d.parameters())
+        ids = pp.to_tensor(np.array([[4, 9, 4]], np.int32))
+        (e_s(ids) ** 2).sum().backward()
+        (e_d(ids) ** 2).sum().backward()
+        opt_s.step(); opt_d.step()
+        ws, wd = np.asarray(e_s.weight._data), np.asarray(e_d.weight._data)
+        np.testing.assert_allclose(ws[4], wd[4], rtol=1e-5)
+        np.testing.assert_allclose(ws[9], wd[9], rtol=1e-5)
+
+    def test_global_norm_clip_parity(self):
+        kw = dict(grad_clip=pp.nn.ClipGradByGlobalNorm(0.01))
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.SGD, sparse=True, **kw),
+            self._train(pp.optimizer.SGD, sparse=False, **kw), rtol=1e-5)
+
+    def test_by_norm_clip_parity(self):
+        kw = dict(grad_clip=pp.nn.ClipGradByNorm(0.01))
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.SGD, sparse=True, **kw),
+            self._train(pp.optimizer.SGD, sparse=False, **kw), rtol=1e-5)
+
+    def test_by_value_clip_parity(self):
+        kw = dict(grad_clip=pp.nn.ClipGradByValue(0.05))
+        np.testing.assert_allclose(
+            self._train(pp.optimizer.SGD, sparse=True, **kw),
+            self._train(pp.optimizer.SGD, sparse=False, **kw), rtol=1e-5)
+
+
+class TestSparseGates:
+    def test_non_leaf_weight_falls_back_to_dense(self):
+        """sparse=True on a NON-leaf weight must run the dense path: an
+        upstream pullback can't consume a RowSparseGrad cotangent."""
+        import paddle_tpu.nn.functional as F
+        e = _embed_pair(vocab=16, d=4)
+        w2 = e.weight * 1.0                      # non-leaf
+        ids = pp.to_tensor(np.array([[1, 2]], np.int32))
+        F.embedding(ids, w2, sparse=True).sum().backward()
+        assert not isinstance(e.weight.grad, RowSparseGrad)
+        assert e.weight.grad is not None
+
+    def test_name_kwarg_accepted(self):
+        import paddle_tpu.nn.functional as F
+        e = _embed_pair(vocab=16, d=4)
+        ids = pp.to_tensor(np.array([[1]], np.int32))
+        out = F.embedding(ids, e.weight, name="emb")
+        assert tuple(out.shape) == (1, 1, 4)
+
+
+class TestLlamaSparseEmbed:
+    def test_llama_eager_step_with_sparse_embed(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        pp.seed(0)
+        cfg = LlamaConfig.tiny(vocab_size=64)
+        cfg.sparse_embed = True
+        model = LlamaForCausalLM(cfg)
+        assert model.model.embed_tokens._sparse
+        opt = pp.optimizer.AdamW(learning_rate=1e-3, lazy_mode=True,
+                                 parameters=model.parameters())
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 64, (2, 17))
+        losses = []
+        for _ in range(4):
+            loss = model.loss(pp.to_tensor(ids[:, :-1].astype("int32")),
+                              pp.to_tensor(ids[:, 1:].astype("int32")))
+            loss.backward()
+            g = model.model.embed_tokens.weight.grad
+            assert isinstance(g, RowSparseGrad)
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
