@@ -8,13 +8,30 @@ jaxpr compiled by XLA replaces ProgramDesc + InterpreterCore (SURVEY.md
 * ``InputSpec`` — shape/dtype declaration (shared with jit.save)
 * ``save_inference_model`` / ``load_inference_model`` — thin veneers over
   jit.save/jit.load producing the same artifacts
+* ``static.nn`` — the layer-builder API (fc/conv2d/batch_norm/embedding/
+  layer_norm) over a Program-like parameter scope with ``program_guard``
+  name reuse (static/nn.py)
+* ``data`` — input placeholder declaration → InputSpec
+
+Deliberately ABSENT (scope decision): Program/Block/Executor object
+graphs, append_op, and the 267 IR passes — jax tracing + XLA are that
+machinery here; building a ProgramDesc replica would duplicate the jaxpr.
 """
 
 from __future__ import annotations
 
 from paddle_tpu.jit.save_load import InputSpec  # noqa: F401
+from paddle_tpu.static import nn  # noqa: F401
+from paddle_tpu.static.nn import program_guard, reset_program  # noqa: F401
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model",
+           "nn", "data", "program_guard", "reset_program"]
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Reference ``static.data``: declare a graph input.  Returns an
+    InputSpec consumable by to_static/jit.save."""
+    return InputSpec(shape, dtype=dtype, name=name)
 
 
 def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,

@@ -140,6 +140,8 @@ _INT8_ROUNDTRIP = textwrap.dedent("""
         net(pp.to_tensor(x))
     net = ptq.convert(net)           # QuantedLinear: int8 weights
     assert net[0].qweight.numpy().dtype == np.int8
+    # real int8 x int8 -> int32 dot path, not weight-only dequant
+    assert net[0].act_scale is not None
     want = np.asarray(net(pp.to_tensor(x))._data)
 
     # int8 artifact through jit.save -> C++ PJRT predictor

@@ -1,0 +1,149 @@
+"""static.nn layer-builder facade (VERDICT r3 Weak #8 / next #10).
+
+Reference: paddle.static.nn (python/paddle/static/nn/common.py) — builders
+that create parameters in the ambient Program; here the Program is a
+parameter scope with program_guard name-reuse (static/nn.py).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _fresh_program():
+    static.reset_program()
+    yield
+    static.reset_program()
+
+
+class TestStaticNN:
+    def test_fc_forward_and_param_reuse(self):
+        x = pp.randn([4, 8])
+        with static.program_guard():
+            y1 = static.nn.fc(x, 16, activation="relu")
+        with static.program_guard():
+            y2 = static.nn.fc(x, 16, activation="relu")
+        # same auto-name sequence → same parameter → same output
+        np.testing.assert_allclose(np.asarray(y1._data),
+                                   np.asarray(y2._data))
+        assert tuple(y1.shape) == (4, 16)
+        assert len(static.nn.parameters()) == 2  # w + b
+
+    def test_fc_trains(self):
+        rng = np.random.default_rng(0)
+        x = pp.to_tensor(rng.normal(size=(16, 8)).astype("float32"))
+        y = pp.to_tensor((rng.normal(size=(16, 1)) > 0)
+                         .astype("float32"))
+
+        def forward():
+            with static.program_guard():
+                h = static.nn.fc(x, 16, activation="tanh")
+                return static.nn.fc(h, 1)
+
+        forward()  # materialize params
+        opt = pp.optimizer.Adam(learning_rate=5e-2,
+                                parameters=static.nn.parameters())
+        losses = []
+        for _ in range(15):
+            out = forward()
+            loss = pp.nn.functional.binary_cross_entropy_with_logits(out, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_conv_bn_stack(self):
+        x = pp.randn([2, 3, 8, 8])
+        with static.program_guard():
+            h = static.nn.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                                 activation="relu")
+            h = static.nn.batch_norm(h)
+            out = static.nn.fc(h, 10)
+        assert tuple(h.shape) == (2, 4, 8, 8)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_batch_norm_updates_running_stats(self):
+        x = pp.to_tensor(np.random.default_rng(0)
+                         .normal(3.0, 2.0, (8, 4, 5, 5)).astype("float32"))
+        with static.program_guard():
+            static.nn.batch_norm(x, name="bn")
+        mean = next(p for p in static.nn.parameters()
+                    if p.name == "bn.mean")
+        assert np.abs(np.asarray(mean._data)).sum() > 0  # moved off zero
+
+    def test_embedding_and_layer_norm(self):
+        ids = pp.to_tensor(np.array([[1, 2, 3]], np.int32))
+        with static.program_guard():
+            e = static.nn.embedding(ids, size=[16, 8])
+            out = static.nn.layer_norm(e, begin_norm_axis=2)
+        assert tuple(out.shape) == (1, 3, 8)
+        np.testing.assert_allclose(
+            np.asarray(out._data).mean(axis=-1), 0.0, atol=1e-5)
+
+    def test_shape_conflict_rejected(self):
+        x = pp.randn([4, 8])
+        with static.program_guard():
+            static.nn.fc(x, 16, name="shared")
+        with pytest.raises(ValueError, match="same parameter"):
+            static.nn.fc(x, 32, name="shared")
+
+    def test_static_data_returns_input_spec(self):
+        spec = static.data("x", [None, 8], "float32")
+        assert spec.dtype is not None
+
+    def test_input_spec_into_builder_clear_error(self):
+        spec = static.data("x", [None, 8], "float32")
+        with pytest.raises(TypeError, match="to_static"):
+            static.nn.fc(spec, 16)
+
+    def test_same_shape_layers_differ_at_init(self):
+        x = pp.randn([4, 16])
+        with static.program_guard():
+            h = static.nn.fc(x, 16, name="a")
+            static.nn.fc(h, 16, name="b")
+        wa = next(p for p in static.nn.parameters() if p.name == "a.w")
+        wb = next(p for p in static.nn.parameters() if p.name == "b.w")
+        assert not np.allclose(np.asarray(wa._data), np.asarray(wb._data))
+
+    def test_batch_norm_under_to_static_no_tracer_leak(self):
+        """Tracing the builder (to_static — the supported static path)
+        must not store tracers into the running stats."""
+        from paddle_tpu.jit import to_static
+        x0 = pp.randn([4, 3, 5, 5])
+        with static.program_guard():
+            static.nn.batch_norm(x0, name="jbn")  # materialize params
+
+        @to_static
+        def f(xv):
+            with static.program_guard():
+                return static.nn.batch_norm(xv, name="jbn")
+
+        out = f(pp.randn([4, 3, 5, 5]))
+        assert tuple(out.shape) == (4, 3, 5, 5)
+        mean = next(p for p in static.nn.parameters()
+                    if p.name == "jbn.mean")
+        np.asarray(mean._data)  # must be concrete, not a leaked tracer
+
+    def test_under_jit(self):
+        """The builder code traces under jax.jit: the captured jaxpr IS
+        the reference's ProgramDesc."""
+        import jax
+        x0 = pp.randn([4, 8])
+        with static.program_guard():
+            static.nn.fc(x0, 16, name="jfc")  # materialize params
+
+        def f(xv):
+            with static.program_guard():
+                return static.nn.fc(xv, 16, name="jfc")
+
+        import jax.numpy as jnp
+        xv = jnp.asarray(np.random.default_rng(0)
+                         .normal(size=(4, 8)).astype("float32"))
+        got = jax.jit(f)(xv)
+        want = f(xv)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5)
