@@ -1,0 +1,120 @@
+"""DiT train MFU on the chip (BASELINE DiT / Stable-Diffusion-3 family;
+VERDICT r3 #1b — the vision/diffusion config with no measured number).
+
+Full train step (fwd+bwd+AdamW) of a DiT-L/2-proportioned model on
+32x32x4 latents: patchify conv + 24 adaLN transformer blocks + unpatchify
+— the PaddleMIX DiT recipe shape, sized for one 16G chip with full
+optimizer state.  FLOPs = 6N per patch token + attention term.
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as pp
+    from paddle_tpu.core.dispatch import unwrap
+    from paddle_tpu.core.functional import functional_call, params_of
+    from paddle_tpu.models import DiT, DiTConfig
+    from bench import _PEAK
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = DiTConfig(input_size=32, patch_size=2, in_channels=4,
+                        hidden_size=1024, depth=24, num_heads=16,
+                        num_classes=1000, dtype="bfloat16")
+        batch, iters, warmup = 32, 8, 2
+    else:
+        cfg = DiTConfig.tiny()
+        batch, iters, warmup = 2, 2, 1
+
+    pp.seed(0)
+    model = DiT(cfg)
+    params = params_of(model)
+    n_params = sum(int(np.prod(a.shape)) for a in
+                   jax.tree.leaves(params))
+
+    rng = np.random.default_rng(0)
+    dt_ = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+    x = jnp.asarray(rng.normal(size=(batch, cfg.in_channels,
+                                     cfg.input_size, cfg.input_size)), dt_)
+    noise = jnp.asarray(rng.normal(size=x.shape), dt_)
+    t = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, cfg.num_classes, (batch,)), jnp.int32)
+
+    def loss_fn(ps):
+        out = functional_call(model, ps, pp.Tensor(x), pp.Tensor(t),
+                              pp.Tensor(y))
+        eps = unwrap(out)[:, :cfg.in_channels]
+        return jnp.mean((eps.astype(jnp.float32)
+                         - noise.astype(jnp.float32)) ** 2)
+
+    # AdamW-style update inline (fp32 master + moments)
+    def init_state(p):
+        # explicit copy: fp32 leaves would otherwise ALIAS the param
+        # buffer (astype is a no-op) and double-donate in step()
+        return {"m": jnp.zeros_like(p, jnp.float32),
+                "v": jnp.zeros_like(p, jnp.float32),
+                "w": jnp.array(p, jnp.float32, copy=True)}
+
+    state = jax.tree.map(init_state, params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(ps, st, i):
+        l, g = jax.value_and_grad(loss_fn)(ps)
+
+        def upd(gr, s):
+            m = 0.9 * s["m"] + 0.1 * gr.astype(jnp.float32)
+            v = 0.999 * s["v"] + 0.001 * jnp.square(gr.astype(jnp.float32))
+            mh = m / (1 - 0.9 ** i)
+            vh = v / (1 - 0.999 ** i)
+            w = s["w"] - 1e-4 * (mh / (jnp.sqrt(vh) + 1e-8) + 0.01 * s["w"])
+            return {"m": m, "v": v, "w": w}
+
+        st = jax.tree.map(upd, g, st)
+        ps = jax.tree.map(lambda p, s: s["w"].astype(p.dtype), ps, st)
+        return l, ps, st
+
+    i = jnp.asarray(1)
+    for _ in range(warmup):
+        loss, params, state = step(params, state, i)
+        i = i + 1
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss, params, state = step(params, state, i)
+        i = i + 1
+    jax.block_until_ready(params)
+    dts = (time.perf_counter() - t0) / iters
+
+    tokens = batch * cfg.num_patches
+    flops_per_token = 6 * n_params + \
+        12 * cfg.depth * cfg.num_patches * cfg.hidden_size
+    kind = getattr(dev, "device_kind", "").lower()
+    peak = next((v for k, v in sorted(_PEAK.items(),
+                                      key=lambda kv: -len(kv[0]))
+                 if k in kind), 459e12)
+    mfu = flops_per_token * tokens / dts / peak
+    print(json.dumps({
+        "metric": "dit_train_mfu", "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "detail": {"params": n_params, "batch": batch,
+                   "patch_tokens": cfg.num_patches,
+                   "images_per_sec": round(batch / dts, 1),
+                   "step_time_s": round(dts, 4),
+                   "device": getattr(dev, "device_kind", dev.platform),
+                   "final_loss": float(loss)}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Llama long-context train MFU at seq 4096 / 8192 (BASELINE config
+matrix + VERDICT r3 #1/#4).
+
+At long sequence the attention term dominates and the Pallas flash
+kernels must carry the step; this bench measures the FULL train step
+(fwd+bwd+AdamW) per sequence length for BOTH backward implementations —
+the Pallas dq/dkv kernels and the blockwise-jax recompute — and reports
+which one wins in-model, alongside the autotuner's isolated choice.
+
+Prints one JSON line per (seq, backward) plus a summary line per seq.
+Run on the TPU chip (the driver's tunnel); falls back to a tiny CPU
+smoke shape off-TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _peak_flops(device) -> float:
+    from bench import _PEAK
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in sorted(_PEAK.items(), key=lambda kv: -len(kv[0])):
+        if key in kind:
+            return val
+    return 459e12
+
+
+def run_one(cfg, batch, seq, pallas_bwd, iters=8, warmup=2, remat=False,
+            remat_policy=None):
+    import jax
+    import paddle_tpu as pp
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import LlamaForCausalLM
+
+    pp.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True)
+    import os
+    os.environ["PT_FLASH_PALLAS_BWD"] = str(int(pallas_bwd))
+    step = TrainStep(model, opt, remat=remat, remat_policy=remat_policy)
+    n_params = sum(int(np.prod(a.shape)) for a in step.params.values())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    batch_dict = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    for _ in range(warmup):
+        step(batch_dict)
+    jax.block_until_ready(step.params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        step(batch_dict)
+    jax.block_until_ready(step.params)
+    dt = (time.perf_counter() - t0) / iters
+    tokens = batch * seq
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_hidden_layers * seq * cfg.hidden_size
+    dev = jax.devices()[0]
+    mfu = flops_per_token * tokens / dt / _peak_flops(dev)
+    return mfu, tokens / dt, dt
+
+
+def _plans(on_tpu):
+    if on_tpu:
+        # same Llama-3-8B-proportioned single-chip model as bench.py;
+        # long context: batch shrinks to fit HBM, remat at 8k
+        base = dict(vocab_size=32000, hidden_size=2048,
+                    intermediate_size=7168, num_hidden_layers=8,
+                    num_attention_heads=16, num_key_value_heads=8,
+                    rope_theta=500000.0, dtype="bfloat16")
+        return base, [
+            dict(seq=4096, batch=2, remat=False, remat_policy=None),
+            dict(seq=8192, batch=1, remat=True,
+                 remat_policy="dots_no_batch"),
+        ]
+    base = dict(vocab_size=256, hidden_size=128, intermediate_size=256,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, dtype="float32")
+    return base, [dict(seq=256, batch=2, remat=False, remat_policy=None)]
+
+
+def _child(seq: int, pb: int):
+    """One measurement per process: a fresh 584M model + full AdamW state
+    twice in one process OOMs the 16G chip (freeing is async)."""
+    import jax
+    from paddle_tpu.models import LlamaConfig
+    on_tpu = jax.devices()[0].platform == "tpu"
+    base, plans = _plans(on_tpu)
+    plan = next(p for p in plans if p["seq"] == seq)
+    cfg = LlamaConfig(max_position_embeddings=seq, **base)
+    mfu, tps, dt = run_one(cfg, plan["batch"], seq, bool(pb),
+                           remat=plan["remat"],
+                           remat_policy=plan["remat_policy"])
+    print("RESULT " + json.dumps({
+        "mfu": mfu, "tps": tps, "dt": dt, "batch": plan["batch"],
+        "remat": plan["remat"]}), flush=True)
+
+
+def main():
+    import subprocess
+    import sys
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    _, plans = _plans(on_tpu)
+    for plan in plans:
+        seq, per = plan["seq"], {}
+        for pb in (True, False):
+            proc = subprocess.run(
+                [sys.executable, __file__, "--child", str(seq),
+                 str(int(pb))],
+                capture_output=True, text=True, timeout=3000)
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("RESULT ")), None)
+            if line is None:
+                print(json.dumps({
+                    "metric": f"llama_s{seq}_mfu_"
+                              f"{'pallas_bwd' if pb else 'blockwise_bwd'}",
+                    "value": None, "error": proc.stderr[-500:]}),
+                    flush=True)
+                continue
+            r = json.loads(line[len("RESULT "):])
+            per[pb] = r["mfu"]
+            print(json.dumps({
+                "metric": f"llama_s{seq}_mfu_"
+                          f"{'pallas_bwd' if pb else 'blockwise_bwd'}",
+                "value": round(r["mfu"], 4), "unit": "fraction_of_peak",
+                "detail": {"batch": r["batch"], "seq": seq,
+                           "tokens_per_sec_per_chip": round(r["tps"], 1),
+                           "step_time_s": round(r["dt"], 4),
+                           "remat": r["remat"]}}), flush=True)
+        if len(per) == 2:
+            winner = "pallas" if per[True] >= per[False] else "blockwise"
+            print(json.dumps({
+                "metric": f"llama_s{seq}_mfu",
+                "value": round(max(per.values()), 4),
+                "unit": "fraction_of_peak",
+                "detail": {"in_model_winner": winner,
+                           "pallas_bwd_mfu": round(per[True], 4),
+                           "blockwise_bwd_mfu": round(per[False], 4)}}),
+                flush=True)
+
+
+if __name__ == "__main__":
+    import sys
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        main()

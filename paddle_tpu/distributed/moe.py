@@ -29,7 +29,8 @@ from paddle_tpu.nn import functional as F
 from paddle_tpu.distributed.mpu import constrain
 
 __all__ = ["top_k_gating", "NaiveGate", "SwitchGate", "GShardGate",
-           "MoELayer", "ExpertFFN", "moe_shard_a2a", "moe_forward_a2a"]
+           "MoELayer", "ExpertFFN", "moe_shard_a2a", "moe_forward_a2a",
+           "top_k_gating_indices", "moe_forward_index"]
 
 
 def top_k_gating(gate_logits, k: int, capacity: int,
@@ -44,51 +45,97 @@ def top_k_gating(gate_logits, k: int, capacity: int,
       aux_loss: load-balance loss (mean_prob * mean_assignment * E),
       router z-loss is folded in by callers that want it.
     """
-    tokens, E = gate_logits.shape
     if jitter_key is not None and jitter_eps > 0:
         noise = jax.random.uniform(jitter_key, gate_logits.shape,
                                    minval=1 - jitter_eps,
                                    maxval=1 + jitter_eps)
         gate_logits = gate_logits * noise
-    probs = jax.nn.softmax(gate_logits, axis=-1)          # [T, E]
+    E = gate_logits.shape[1]
+    topi, slot, w, keep, aux_loss = top_k_gating_indices(
+        gate_logits, k=k, capacity=capacity)
+    # densify the index form into GShard's [T, E, C] one-hot tensors
+    onehot = jax.nn.one_hot(topi, E, dtype=w.dtype)       # [T, k, E]
+    cap_onehot = jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
+                                dtype=w.dtype)            # [T, k, C]
+    combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot, w)
+    dispatch = jnp.einsum("tke,tkc->tec",
+                          onehot * keep[..., None].astype(w.dtype),
+                          cap_onehot) > 0
+    return combine, dispatch, aux_loss
 
-    # fully vectorized (no Python loop over k): lax.top_k selects the same
-    # experts k sequential argmax passes would; queue positions come from
-    # one cumsum over the k-major flattening (all 1st choices in token
-    # order, then all 2nd choices, ...).  Standard GShard bookkeeping: an
-    # over-capacity assignment still occupies its position number, so
-    # under overflow a later-rank choice may be pushed past capacity where
-    # the earlier k-pass implementation (which recycled dropped slots
-    # between passes) would have admitted it — slightly more conservative,
-    # identical whenever capacity is not exceeded (and always under
-    # dropless).
+
+def top_k_gating_indices(gate_logits, k: int, capacity: int):
+    """Index-form gating — the single implementation of the GShard
+    bookkeeping (``top_k_gating`` densifies this form).  Returns
+    per-(token, choice) indices, the input to the gather/scatter dispatch
+    whose cost is O(T·k·d) instead of the dense contraction's
+    O(T·E·C·d) (at bench shapes the dense dispatch einsum costs 3x the
+    expert math itself).
+
+    Fully vectorized (no Python loop over k): lax.top_k selects the same
+    experts k sequential argmax passes would; queue positions come from
+    one cumsum over the k-major flattening (all 1st choices in token
+    order, then all 2nd choices, ...).  Standard GShard bookkeeping: an
+    over-capacity assignment still occupies its position number, so under
+    overflow a later-rank choice may be pushed past capacity where a
+    k-pass implementation (recycling dropped slots between passes) would
+    have admitted it — slightly more conservative, identical whenever
+    capacity is not exceeded (and always under dropless).
+
+    Returns:
+      topi:  [T, k] int32 expert ids
+      slot:  [T, k] int32 capacity slot within the expert
+      w:     [T, k] combine weights, normalized over kept choices
+      keep:  [T, k] bool — in-capacity assignments
+      aux_loss: scalar GShard load-balance loss
+    """
+    tokens, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
     k = min(k, E)  # degenerate configs (fewer experts than choices)
     topv, topi = jax.lax.top_k(probs, k)                  # [T, k]
     onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)   # [T, k, E]
     flat = onehot.transpose(1, 0, 2).reshape(k * tokens, E)
     pos_flat = jnp.cumsum(flat, axis=0) - flat
-    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)  # [T, k, E]
-    in_cap = (pos < capacity) & (onehot > 0)              # [T, k, E]
+    pos = pos_flat.reshape(k, tokens, E).transpose(1, 0, 2)
+    in_cap = (pos < capacity) & (onehot > 0)
     slot = (pos * onehot).sum(-1).astype(jnp.int32)       # [T, k]
-    cap_onehot = jax.nn.one_hot(jnp.clip(slot, 0, capacity - 1), capacity,
-                                dtype=probs.dtype)        # [T, k, C]
-    sel = in_cap.any(-1).astype(probs.dtype)              # [T, k]
-    combine = jnp.einsum("tke,tkc,tk->tec", onehot, cap_onehot,
-                         topv * sel)
-    dispatch = jnp.einsum("tke,tkc->tec",
-                          onehot * in_cap.astype(probs.dtype),
-                          cap_onehot) > 0
-
-    # normalise combine weights over the k experts per token
-    denom = combine.sum(axis=(1, 2), keepdims=True)
-    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9),
-                        combine)
-
+    keep = in_cap.any(-1)                                 # [T, k]
+    w = topv * keep.astype(probs.dtype)
+    denom = w.sum(axis=1, keepdims=True)
+    w = jnp.where(denom > 0, w / jnp.maximum(denom, 1e-9), w)
     # GShard load-balance loss: E * mean_e(frac_tokens_e * mean_prob_e)
-    me = probs.mean(axis=0)                               # [E]
+    me = probs.mean(axis=0)
     ce = (onehot.sum(1) > 0).astype(probs.dtype).mean(axis=0) / k
     aux_loss = (me * ce).sum() * E
-    return combine, dispatch, aux_loss
+    return topi, slot, w, keep, aux_loss
+
+
+def moe_forward_index(x2d, logits, experts_fn, *, E: int, top_k: int,
+                      capacity: int):
+    """Gather/scatter expert dispatch (single-program; MaxText-style).
+
+    Builds [E, C] token-index buffers with one masked scatter (dropped
+    assignments target an out-of-bounds row, mode='drop'), gathers
+    expert inputs directly from the token axis, and combines with a
+    [T, k, d] gather — no [T, E, C] tensor exists anywhere.  Gradients
+    flow through the gathers (scatter-add transposes).
+    """
+    T, d = x2d.shape
+    topi, slot, w, keep, aux = top_k_gating_indices(logits, k=top_k,
+                                                    capacity=capacity)
+    safe_e = jnp.where(keep, topi, E)      # OOB row → dropped by scatter
+    tok_ids = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[:, None],
+                               topi.shape)
+    tok_for = jnp.zeros((E, capacity), jnp.int32).at[safe_e, slot].set(
+        tok_ids, mode="drop")
+    filled = jnp.zeros((E, capacity), x2d.dtype).at[safe_e, slot].set(
+        1.0, mode="drop")
+    expert_in = x2d[tok_for] * filled[..., None]          # [E, C, d]
+    expert_out = experts_fn(expert_in)                    # [E, C, d]
+    picked = expert_out[topi, slot]                       # [T, k, d]
+    out = jnp.einsum("tkd,tk->td", picked, w.astype(x2d.dtype))
+    dropped = 1.0 - keep.astype(jnp.float32).mean()
+    return out, aux, dropped
 
 
 class NaiveGate(Layer):
@@ -270,7 +317,7 @@ class MoELayer(Layer):
                  dispatch_mode: str = "einsum", dropless: bool = False,
                  mesh=None):
         super().__init__()
-        if dispatch_mode not in ("einsum", "all_to_all"):
+        if dispatch_mode not in ("einsum", "all_to_all", "index"):
             raise ValueError(f"unknown dispatch_mode {dispatch_mode}")
         if dispatch_mode == "all_to_all" and mesh is None:
             raise ValueError("dispatch_mode='all_to_all' needs mesh=")
@@ -323,12 +370,7 @@ class MoELayer(Layer):
                 with_stats=True)
             self.aux_loss = aux
             self.router_stats = {"dropped_frac": dropped}
-            if hasattr(x, "_data"):
-                from paddle_tpu.core.tensor import Tensor
-                t = Tensor(out)
-                t.stop_gradient = x.stop_gradient
-                return t
-            return out
+            return self._wrap_out(x, out)
 
         E = self.num_experts
         x2d = data.reshape(T, d)
@@ -344,6 +386,27 @@ class MoELayer(Layer):
             capacity = max(1, int(self.capacity_factor * self.gate.top_k
                                   * T / E))
         logits = unwrap(self.gate.logits(x2d))
+        if self.dispatch_mode == "index":
+            # gather/scatter dispatch: O(T·k·d) — the single-program fast
+            # path (under ep sharding keep "einsum": GSPMD lowers that
+            # contraction to the all_to_all; a cross-shard gather would
+            # all-gather the tokens instead)
+            if not isinstance(self.experts, ExpertFFN):
+                raise ValueError("index dispatch requires the stacked "
+                                 "ExpertFFN experts")
+
+            def experts_fn(buf):
+                return _expert_ffn(
+                    buf, unwrap(self.experts.w1), unwrap(self.experts.b1),
+                    unwrap(self.experts.w2), unwrap(self.experts.b2),
+                    lambda v: unwrap(self.experts.activation(v)))
+
+            out, aux, dropped = moe_forward_index(
+                x2d, logits, experts_fn, E=E, top_k=self.gate.top_k,
+                capacity=capacity)
+            self.aux_loss = aux
+            self.router_stats = {"dropped_frac": dropped}
+            return self._wrap_out(x, out.reshape(B, S, d))
         combine, dispatch, aux = top_k_gating(
             logits, k=self.gate.top_k, capacity=capacity)
         self.aux_loss = aux
@@ -359,7 +422,10 @@ class MoELayer(Layer):
         # combine: [T,E,C] x [E,C,d] -> [T,d]
         out = jnp.einsum("tec,ecd->td", combine.astype(data.dtype),
                          expert_out)
-        out = out.reshape(B, S, d)
+        return self._wrap_out(x, out.reshape(B, S, d))
+
+    @staticmethod
+    def _wrap_out(x, out):
         if hasattr(x, "_data"):
             from paddle_tpu.core.tensor import Tensor
             t = Tensor(out)

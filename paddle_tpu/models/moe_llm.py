@@ -48,6 +48,9 @@ class MoEConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # "einsum" (GSPMD lowers to a2a under ep sharding), "index"
+    # (gather/scatter fast path for single-program / dp-only runs)
+    dispatch_mode: str = "einsum"
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -130,7 +133,8 @@ class MoEDecoderLayer(Layer):
                 num_experts=config.num_experts,
                 d_hidden=config.moe_intermediate_size,
                 gate="naive", top_k=config.num_experts_per_tok,
-                capacity_factor=config.capacity_factor)
+                capacity_factor=config.capacity_factor,
+                dispatch_mode=config.dispatch_mode)
 
     def forward(self, x, rope_cos, rope_sin):
         x = x + self.self_attn(self.input_layernorm(x), rope_cos, rope_sin)

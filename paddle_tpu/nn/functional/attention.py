@@ -79,14 +79,25 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             _use_pallas(query.shape, query.shape[-1]):
         # no try/except: a lowering break in the flagship kernel must
         # surface, not silently fall back (round-1 lesson).
-        # pallas_bwd=False: measured IN-MODEL (bench.py, b4/s2048 584M,
-        # v5e) the blockwise-jax backward gives MFU 0.514 vs 0.461 with
-        # the Pallas dq/dkv kernels, even though isolated microbenchmarks
-        # sometimes favor the kernels — under remat the XLA-fused
-        # blockwise bwd overlaps better with the surrounding step.
+        # Backward implementation: blockwise-jax recompute, pinned from
+        # IN-MODEL measurement on v5e (bench.py +
+        # benchmarks/llama_seq_bench.py, full train step, both variants):
+        #   b4/s2048: 0.514 vs 0.461   b2/s4096: 0.404 vs 0.361
+        #   b1/s8192 (remat): 0.241 vs 0.218
+        # — no crossover up to 8k: XLA fuses the recompute chain into the
+        # surrounding step better than the separate dq + dkv Pallas
+        # dispatches (two extra HBM passes over q/k/v/g).  The Pallas
+        # backward kernels remain available (pallas_bwd=True /
+        # PT_FLASH_PALLAS_BWD=1) and win in ISOLATED microbenches
+        # (benchmarks/pallas_kernels_bench.py) — a documented niche:
+        # standalone attention grads without a surrounding fusable step.
+        import os
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
+        pb_env = os.environ.get("PT_FLASH_PALLAS_BWD")
+        pb = (pb_env.strip().lower() in ("1", "true", "yes", "on")
+              if pb_env is not None else False)
         return flash_attention(query, key, value, causal=is_causal,
-                               scale=scale, pallas_bwd=False)
+                               scale=scale, pallas_bwd=pb)
     dk = None
     if use_dropout:
         from paddle_tpu.core import functional as _cf
