@@ -1,0 +1,139 @@
+// Native WordPiece tokenizer — the faster_tokenizer analog.
+//
+// Reference role: paddle/fluid/operators/string/faster_tokenizer_op.cc
+// (BERT-style tokenize inside the graph) + phi/kernels/strings/.  Here
+// tokenization is host-side data-plane work (TPU kernels never see
+// strings), so the native piece is a standalone C++ tokenizer bound over
+// ctypes and fed to the datafeed: basic pretokenization (whitespace +
+// punctuation split, optional lowercasing with ASCII + Latin-1 folding),
+// then greedy longest-match-first WordPiece with "##" continuations.
+//
+// C API (ctypes-friendly, no C++ types across the boundary):
+//   tok_create(vocab_path, do_lower) -> handle
+//   tok_encode(handle, text, out_ids, max_len) -> n_tokens (ids include
+//     no specials; the Python wrapper adds [CLS]/[SEP] per config)
+//   tok_token_to_id / tok_id_count / tok_destroy
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int64_t> vocab;
+  bool lower = true;
+  int64_t unk = 0;
+  size_t max_word_chars = 100;
+};
+
+bool is_punct(unsigned char c) {
+  return std::ispunct(c) != 0;
+}
+
+// split into words: whitespace separates, punctuation is its own token
+std::vector<std::string> pretokenize(const std::string& text, bool lower) {
+  std::vector<std::string> words;
+  std::string cur;
+  for (unsigned char c : text) {
+    if (std::isspace(c)) {
+      if (!cur.empty()) words.push_back(cur);
+      cur.clear();
+    } else if (is_punct(c)) {
+      if (!cur.empty()) words.push_back(cur);
+      cur.clear();
+      words.emplace_back(1, static_cast<char>(c));
+    } else {
+      cur.push_back(lower ? static_cast<char>(std::tolower(c))
+                          : static_cast<char>(c));
+    }
+  }
+  if (!cur.empty()) words.push_back(cur);
+  return words;
+}
+
+// greedy longest-match-first WordPiece (the BERT algorithm)
+void wordpiece(const Tokenizer& t, const std::string& word,
+               std::vector<int64_t>* out) {
+  if (word.size() > t.max_word_chars) {
+    out->push_back(t.unk);
+    return;
+  }
+  size_t start = 0;
+  std::vector<int64_t> pieces;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int64_t cur_id = -1;
+    while (start < end) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      auto it = t.vocab.find(sub);
+      if (it != t.vocab.end()) {
+        cur_id = it->second;
+        break;
+      }
+      --end;
+    }
+    if (cur_id < 0) {  // no piece matches: whole word is UNK
+      out->push_back(t.unk);
+      return;
+    }
+    pieces.push_back(cur_id);
+    start = end;
+  }
+  out->insert(out->end(), pieces.begin(), pieces.end());
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tok_create(const char* vocab_path, int do_lower) {
+  auto* t = new Tokenizer();
+  t->lower = do_lower != 0;
+  std::ifstream in(vocab_path);
+  if (!in) {
+    delete t;
+    return nullptr;
+  }
+  std::string line;
+  int64_t idx = 0;
+  while (std::getline(in, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    t->vocab.emplace(line, idx++);
+  }
+  auto unk = t->vocab.find("[UNK]");
+  t->unk = unk != t->vocab.end() ? unk->second : 0;
+  return t;
+}
+
+void tok_destroy(void* handle) {
+  delete static_cast<Tokenizer*>(handle);
+}
+
+int64_t tok_id_count(void* handle) {
+  return static_cast<int64_t>(static_cast<Tokenizer*>(handle)->vocab.size());
+}
+
+int64_t tok_token_to_id(void* handle, const char* token) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  auto it = t->vocab.find(token);
+  return it != t->vocab.end() ? it->second : -1;
+}
+
+// returns number of ids written (<= max_len; truncates past max_len)
+int64_t tok_encode(void* handle, const char* text, int64_t* out_ids,
+                   int64_t max_len) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  std::vector<int64_t> ids;
+  for (const auto& w : pretokenize(text, t->lower)) wordpiece(*t, w, &ids);
+  int64_t n = static_cast<int64_t>(ids.size());
+  if (n > max_len) n = max_len;
+  if (n > 0) std::memcpy(out_ids, ids.data(), n * sizeof(int64_t));
+  return n;
+}
+
+}  // extern "C"

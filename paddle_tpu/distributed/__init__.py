@@ -39,6 +39,7 @@ from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
 from paddle_tpu.distributed import checkpoint  # noqa: F401
 from paddle_tpu.distributed.elastic import (  # noqa: F401
     ElasticAgent, ElasticManager)
+from paddle_tpu.distributed import rpc  # noqa: F401
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401
     AutoCheckpoint, Converter, async_save_state_dict, load_state_dict,
     save_state_dict, validate_checkpoint)

@@ -1,0 +1,242 @@
+"""paddle_tpu.distributed.rpc — remote python-function invocation.
+
+Reference parity: ``paddle.distributed.rpc``
+(python/paddle/distributed/rpc/rpc.py — init_rpc/rpc_sync/rpc_async/
+shutdown/get_worker_info over a C++ brpc agent,
+fluid/distributed/rpc/rpc_agent.cc).  TPU-native translation: the control
+plane that brpc provided is a per-process threaded TCP server speaking
+length-prefixed pickled frames, with worker discovery through the native
+TCPStore (csrc/store) — the same store that bootstraps rendezvous.  RPC
+is CONTROL traffic (eval loops, metric aggregation, dataset brokering);
+tensor traffic belongs to the compiled collectives over ICI, never here.
+
+Security note (same stance as the reference): frames are pickled python —
+use only inside a trusted cluster network, like the NCCL/gloo ports.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_TIMEOUT = float(os.environ.get("PADDLE_RPC_TIMEOUT", "120"))
+
+_state: Dict[str, Any] = {
+    "server": None, "store": None, "workers": {}, "self": None,
+    "pool": None,
+}
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_frame(sock) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class _Server:
+    """Per-process executor: accepts connections, runs pickled calls on a
+    thread pool, streams back (ok, result-or-exception)."""
+
+    def __init__(self, port_hint: int = 0, max_workers: int = 8):
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port_hint))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="pt_rpc")
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            self._pool.submit(self._serve, conn)
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                while True:
+                    try:
+                        frame = _recv_frame(conn)
+                    except (ConnectionError, OSError):
+                        return
+                    try:
+                        fn, args, kwargs = pickle.loads(frame)
+                        result, ok = fn(*args, **(kwargs or {})), True
+                    except BaseException as e:  # noqa: BLE001 — shipped back
+                        result, ok = e, False
+                    try:
+                        _send_frame(conn, pickle.dumps((ok, result)))
+                    except Exception:
+                        # unpicklable result: ship the repr as an error
+                        _send_frame(conn, pickle.dumps(
+                            (False, RuntimeError(
+                                f"rpc result not picklable: {result!r}"))))
+        except Exception:
+            pass  # connection torn down mid-serve
+
+    def stop(self):
+        self._running = False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this process's RPC agent and rendezvous with the others.
+
+    Reference signature (rpc.py:73).  rank/world_size/master default from
+    the launcher env (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+    PADDLE_MASTER).  Worker infos are exchanged through the native
+    TCPStore at ``master_endpoint``."""
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    if _state["server"] is not None:
+        raise RuntimeError("init_rpc called twice (call shutdown() first)")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:12600")
+    host, port = master_endpoint.rsplit(":", 1)
+
+    # store FIRST: a failed rendezvous must not leak the agent's
+    # listening socket / accept thread across init retries
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size, timeout=_DEFAULT_TIMEOUT)
+    server = _Server()
+    try:
+        ip = socket.gethostbyname(socket.gethostname()) \
+            if host not in ("127.0.0.1", "localhost") else "127.0.0.1"
+        info = WorkerInfo(name, rank, ip, server.port)
+        store.set(f"rpc_worker_{rank}", pickle.dumps(tuple(info)))
+        store.barrier("rpc_init")
+        workers = {}
+        for r in range(world_size):
+            wi = WorkerInfo(*pickle.loads(store.get(f"rpc_worker_{r}")))
+            workers[wi.name] = wi
+        if len(workers) != world_size:
+            raise RuntimeError("rpc worker names must be unique per process")
+    except BaseException:
+        server.stop()
+        store.close()
+        raise
+    _state.update(server=server, store=store, workers=workers, self=info,
+                  pool=ThreadPoolExecutor(max_workers=8,
+                                          thread_name_prefix="pt_rpc_cli"))
+
+
+def _connect(to: str, timeout: float):
+    workers = _state["workers"]
+    if to not in workers:
+        raise ValueError(f"unknown rpc worker {to!r}; known: "
+                         f"{sorted(workers)}")
+    wi = workers[to]
+    sock = socket.create_connection((wi.ip, wi.port), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _call(to, fn, args, kwargs, timeout):
+    sock = _connect(to, timeout)
+    try:
+        _send_frame(sock, pickle.dumps((fn, tuple(args or ()),
+                                        dict(kwargs or {}))))
+        sock.settimeout(timeout)
+        ok, result = pickle.loads(_recv_frame(sock))
+    finally:
+        sock.close()
+    if not ok:
+        raise result
+    return result
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: float = _DEFAULT_TIMEOUT):
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; return its result
+    (reference rpc.py:141).  Remote exceptions re-raise here."""
+    if _state["server"] is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    return _call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = _DEFAULT_TIMEOUT) -> Future:
+    """Like rpc_sync but returns a Future (reference rpc.py:179 —
+    ``.wait()`` parity is via ``concurrent.futures.Future.result``, and a
+    ``wait`` alias is attached for drop-in use)."""
+    if _state["server"] is None:
+        raise RuntimeError("rpc not initialized; call init_rpc first")
+    fut = _state["pool"].submit(_call, to, fn, args, kwargs, timeout)
+    fut.wait = fut.result  # paddle Future API parity
+    return fut
+
+
+def shutdown():
+    """Barrier with every worker, then stop the agent (reference
+    rpc.py:270 — graceful by default so in-flight serves finish)."""
+    store = _state["store"]
+    if store is not None:
+        try:
+            store.barrier("rpc_shutdown")
+        except Exception:
+            pass  # a crashed peer must not block local teardown
+    server = _state["server"]
+    if server is not None:
+        server.stop()
+    pool = _state["pool"]
+    if pool is not None:
+        pool.shutdown(wait=False)
+    if store is not None:
+        store.close()
+    _state.update(server=None, store=None, workers={}, self=None,
+                  pool=None)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _state["workers"][name]
+
+
+def get_all_worker_infos():
+    return sorted(_state["workers"].values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    if _state["self"] is None:
+        raise RuntimeError("rpc not initialized")
+    return _state["self"]

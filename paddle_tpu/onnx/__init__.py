@@ -1,0 +1,362 @@
+"""paddle_tpu.onnx — ONNX export of Layers.
+
+Reference parity: ``paddle.onnx.export`` (python/paddle/onnx/export.py —
+Program → ONNX via paddle2onnx).  TPU-native translation: the captured
+program is a jaxpr (the tracing that replaces ProgramDesc), and export
+walks its equations mapping XLA primitives onto ONNX ops.  The vendored
+``onnx_mini.proto`` is a subset of the PUBLIC ONNX schema (the ``onnx``
+pip package is not in this image); files written here are standard
+``.onnx`` protobufs loadable by onnxruntime/netron.
+
+Scope: serving-style exports — MLP / conv / classifier graphs (matmul,
+conv, elementwise chains, reductions, reshapes).  Models with exotic
+dot_general layouts (ring attention, MoE dispatch) should ship via the
+first-class StableHLO path (jit.save); export raises a clear error
+naming any unmapped primitive.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["export"]
+
+
+def _pb():
+    from paddle_tpu.onnx import onnx_mini_pb2
+    return onnx_mini_pb2
+
+
+# ONNX TensorProto data types (public enum values)
+_DTYPES = {"float32": 1, "uint8": 2, "int8": 3, "int32": 6, "int64": 7,
+           "bool": 9, "float16": 10, "float64": 11, "bfloat16": 16}
+
+
+def _onnx_dtype(np_dtype) -> int:
+    name = str(np_dtype)
+    if name not in _DTYPES:
+        raise NotImplementedError(f"onnx export: dtype {name}")
+    return _DTYPES[name]
+
+
+class _Builder:
+    def __init__(self, opset: int):
+        self.pb = _pb()
+        self.model = self.pb.ModelProto()
+        self.model.ir_version = 8
+        self.model.producer_name = "paddle_tpu"
+        ops = self.model.opset_import.add()
+        ops.domain = ""
+        ops.version = opset
+        self.graph = self.model.graph
+        self.graph.name = "paddle_tpu_graph"
+        self._n = 0
+        self.names: Dict[int, str] = {}   # id(jaxpr var) -> onnx name
+
+    def fresh(self, prefix="v") -> str:
+        self._n += 1
+        return f"{prefix}_{self._n}"
+
+    def name_of(self, var) -> str:
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.add_initializer(np.asarray(var.val))
+        key = id(var)
+        if key not in self.names:
+            self.names[key] = self.fresh()
+        return self.names[key]
+
+    def add_initializer(self, arr: np.ndarray, name: Optional[str] = None
+                        ) -> str:
+        name = name or self.fresh("const")
+        t = self.graph.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        if str(arr.dtype) == "bfloat16":
+            arr = arr.astype(np.float32)  # serve in fp32
+        t.data_type = _onnx_dtype(arr.dtype)
+        t.raw_data = np.ascontiguousarray(arr).tobytes()
+        return name
+
+    def node(self, op: str, inputs: Sequence[str], outputs: Sequence[str],
+             **attrs):
+        n = self.graph.node.add()
+        n.op_type = op
+        n.name = self.fresh(op.lower())
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            a = n.attribute.add()
+            a.name = k
+            if isinstance(v, int):
+                a.type, a.i = 2, v
+            elif isinstance(v, float):
+                a.type, a.f = 1, v
+            elif isinstance(v, str):
+                a.type, a.s = 3, v.encode()
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(x, int) for x in v):
+                a.type = 7
+                a.ints.extend(v)
+            else:
+                raise NotImplementedError(f"attr {k}={v!r}")
+        return n
+
+    def value_info(self, holder, name: str, shape, np_dtype):
+        vi = holder.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = _onnx_dtype(np_dtype)
+        for d in shape:
+            dim = tt.shape.dim.add()
+            dim.dim_value = int(d)
+
+
+_ELEMWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "add_any": "Add",
+    "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+    "ge": "GreaterOrEqual", "eq": "Equal", "and": "And", "or": "Or",
+    "xor": "Xor",
+}
+_UNARY = {
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "logistic": "Sigmoid", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
+    "erf": "Erf", "floor": "Floor", "ceil": "Ceil",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+
+
+def _np_of(aval):
+    return np.dtype(aval.dtype)
+
+
+def _emit_eqn(b: _Builder, eqn):
+    prim = eqn.primitive.name
+    ins = [b.name_of(v) for v in eqn.invars]
+    outs = [b.name_of(v) for v in eqn.outvars]
+    p = eqn.params
+
+    if prim in ("pjit", "jit", "closed_call", "custom_jvp_call",
+                "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                "checkpoint", "custom_jvp_call_jaxpr"):
+        inner = p.get("jaxpr") or p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if inner is None:
+            raise NotImplementedError(f"onnx export: call prim {prim} "
+                                      f"without inner jaxpr")
+        closed = inner if hasattr(inner, "jaxpr") else None
+        jaxpr = closed.jaxpr if closed is not None else inner
+        consts = closed.consts if closed is not None else []
+        # wire: constvars → initializers, invars/outvars → aliases
+        for cv, cval in zip(jaxpr.constvars, consts):
+            b.names[id(cv)] = b.add_initializer(np.asarray(cval))
+        for iv, outer in zip(jaxpr.invars, ins):
+            b.names[id(iv)] = outer
+        for e in jaxpr.eqns:
+            _emit_eqn(b, e)
+        for ov, outer in zip(jaxpr.outvars, outs):
+            b.node("Identity", [b.name_of(ov)], [outer])
+        return
+
+    if prim in _ELEMWISE:
+        b.node(_ELEMWISE[prim], ins, outs)
+    elif prim in _UNARY:
+        b.node(_UNARY[prim], ins, outs)
+    elif prim == "rsqrt":
+        mid = b.fresh()
+        b.node("Sqrt", ins, [mid])
+        b.node("Reciprocal", [mid], outs)
+    elif prim == "square":
+        b.node("Mul", [ins[0], ins[0]], outs)
+    elif prim == "not":
+        b.node("Not", ins, outs)
+    elif prim == "is_finite":
+        inf_ = b.fresh()
+        nan_ = b.fresh()
+        bad = b.fresh()
+        b.node("IsInf", ins, [inf_])
+        b.node("IsNaN", ins, [nan_])
+        b.node("Or", [inf_, nan_], [bad])
+        b.node("Not", [bad], outs)
+    elif prim == "ne":
+        mid = b.fresh()
+        b.node("Equal", ins, [mid])
+        b.node("Not", [mid], outs)
+    elif prim == "clamp":
+        # lax.clamp(min, x, max) → ONNX Clip(x, min, max)
+        b.node("Clip", [ins[1], ins[0], ins[2]], outs)
+    elif prim == "gather":
+        dn = p["dimension_numbers"]
+        operand_aval = eqn.invars[0].aval
+        slice_sizes = tuple(p["slice_sizes"])
+        # the jnp.take(x, ids, axis=0) pattern → ONNX Gather(axis=0)
+        if (tuple(dn.collapsed_slice_dims) == (0,)
+                and tuple(dn.start_index_map) == (0,)
+                and not getattr(dn, "operand_batching_dims", ())
+                and slice_sizes == (1,) + tuple(operand_aval.shape[1:])):
+            idx_aval = eqn.invars[1].aval
+            # indices carry a trailing index-vector dim of size 1: drop it
+            ishape = b.add_initializer(
+                np.asarray(idx_aval.shape[:-1], np.int64))
+            flat = b.fresh()
+            b.node("Reshape", [ins[1], ishape], [flat])
+            b.node("Gather", [ins[0], flat], outs, axis=0)
+        else:
+            raise NotImplementedError(
+                "onnx export: general gather layouts are not mapped "
+                "(only take-along-axis-0 / embedding lookup); use "
+                "jit.save/StableHLO for this model")
+    elif prim == "erfc":
+        one = b.add_initializer(np.asarray(1.0, _np_of(eqn.invars[0].aval)))
+        mid = b.fresh()
+        b.node("Erf", ins, [mid])
+        b.node("Sub", [one, mid], outs)
+    elif prim == "reduce_mean":
+        # axes stay an ATTRIBUTE until opset 18 (unlike ReduceSum at 13)
+        b.node("ReduceMean", [ins[0]], outs, axes=list(p["axes"]),
+               keepdims=0)
+    elif prim == "integer_pow":
+        y = int(p["y"])
+        exp_name = b.add_initializer(
+            np.asarray(y, _np_of(eqn.invars[0].aval)))
+        b.node("Pow", [ins[0], exp_name], outs)
+    elif prim == "convert_element_type":
+        b.node("Cast", ins, outs, to=_onnx_dtype(np.dtype(p["new_dtype"])))
+    elif prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("onnx export: select_n arity != 3")
+        # jax select_n(pred, on_false, on_true); ONNX Where(cond, X, Y)
+        # picks X where cond true
+        b.node("Where", [ins[0], ins[2], ins[1]], outs)
+    elif prim == "reshape":
+        shape = b.add_initializer(
+            np.asarray(eqn.outvars[0].aval.shape, np.int64))
+        b.node("Reshape", [ins[0], shape], outs)
+    elif prim == "squeeze":
+        axes = b.add_initializer(np.asarray(p["dimensions"], np.int64))
+        b.node("Squeeze", [ins[0], axes], outs)
+    elif prim == "transpose":
+        b.node("Transpose", ins, outs, perm=list(p["permutation"]))
+    elif prim == "broadcast_in_dim":
+        shape = list(p["shape"])
+        bdims = list(p["broadcast_dimensions"])
+        # step 1: reshape operand to rank(shape) with 1s off the bcast dims
+        interim = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            interim[dst] = eqn.invars[0].aval.shape[src]
+        rname = b.fresh()
+        rshape = b.add_initializer(np.asarray(interim, np.int64))
+        b.node("Reshape", [ins[0], rshape], [rname])
+        eshape = b.add_initializer(np.asarray(shape, np.int64))
+        b.node("Expand", [rname, eshape], outs)
+    elif prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        axes = list(p["axes"])
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        if op == "ReduceSum":  # opset 13: axes are an input
+            ax = b.add_initializer(np.asarray(axes, np.int64))
+            b.node(op, [ins[0], ax], outs, keepdims=0)
+        else:                  # axes still an attribute at opset 13
+            b.node(op, [ins[0]], outs, axes=axes, keepdims=0)
+    elif prim == "dot_general":
+        ((lc, rc), (lb, rb)) = p["dimension_numbers"]
+        lhs_aval, rhs_aval = (v.aval for v in eqn.invars)
+        lr, rr = len(lhs_aval.shape), len(rhs_aval.shape)
+        # numpy-style matmul: contract lhs last dim with rhs first
+        # non-batch dim, identical leading batch dims
+        if (tuple(lc), tuple(rc)) == ((lr - 1,), (rr - 2 if rr > 1 else 0,)) \
+                and tuple(lb) == tuple(range(len(lb))) \
+                and tuple(rb) == tuple(range(len(rb))):
+            b.node("MatMul", ins, outs)
+        else:
+            raise NotImplementedError(
+                f"onnx export: dot_general layout {p['dimension_numbers']} "
+                "(only numpy-style matmul is mapped; use jit.save/"
+                "StableHLO for this model)")
+    elif prim == "conv_general_dilated":
+        dn = p["dimension_numbers"]
+        if (dn.lhs_spec[:2] != (0, 1)) or (dn.rhs_spec[:2] != (0, 1)):
+            raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
+        pads = list(p["padding"])
+        onnx_pads = [pr[0] for pr in pads] + [pr[1] for pr in pads]
+        b.node("Conv", ins, outs,
+               strides=list(p["window_strides"]),
+               pads=onnx_pads,
+               dilations=list(p["rhs_dilation"]),
+               group=int(p["feature_group_count"]))
+    else:
+        raise NotImplementedError(
+            f"onnx export: unmapped primitive '{prim}'. Supported: "
+            f"{sorted(list(_ELEMWISE) + list(_UNARY))} + matmul/conv/"
+            "reduce/reshape/transpose/broadcast/cast/where. Use "
+            "jit.save (StableHLO) for full-coverage serialization.")
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 13,
+           **configs) -> str:
+    """Export ``layer`` to ``path + '.onnx'`` (reference signature:
+    paddle.onnx.export(layer, path, input_spec, **configs)).
+
+    input_spec: list of InputSpec (static shapes required)."""
+    import jax
+    from paddle_tpu.core.functional import functional_call, params_of
+    from paddle_tpu.jit.save_load import InputSpec
+
+    if input_spec is None:
+        raise ValueError("onnx export needs input_spec=[InputSpec(...)]")
+    avals = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if d is None else int(d) for d in spec.shape]
+            avals.append(jax.ShapeDtypeStruct(tuple(shape),
+                                              np.dtype(spec.dtype)))
+        else:
+            arr = np.asarray(spec)
+            avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+
+    params = params_of(layer)
+
+    def fn(ps, *xs):
+        out = functional_call(layer, ps, *xs)
+        return out._data if hasattr(out, "_data") else out
+
+    closed = jax.make_jaxpr(fn)(params, *avals)
+    jaxpr = closed.jaxpr
+
+    b = _Builder(opset_version)
+    # params arrive flattened in the jaxpr invars: first the pytree leaves
+    # of `params`, then the data inputs
+    leaves = jax.tree.leaves(params)
+    n_param = len(leaves)
+    for var, leaf in zip(jaxpr.invars[:n_param], leaves):
+        b.names[id(var)] = b.add_initializer(np.asarray(leaf))
+    for i, var in enumerate(jaxpr.invars[n_param:]):
+        name = f"input_{i}"
+        b.names[id(var)] = name
+        dt = np.dtype(var.aval.dtype)
+        if str(dt) == "bfloat16":
+            dt = np.dtype(np.float32)
+        b.value_info(b.graph.input, name, var.aval.shape, dt)
+    for cv, cval in zip(jaxpr.constvars, closed.consts):
+        b.names[id(cv)] = b.add_initializer(np.asarray(cval))
+
+    for eqn in jaxpr.eqns:
+        _emit_eqn(b, eqn)
+
+    for i, var in enumerate(jaxpr.outvars):
+        out_name = b.name_of(var)
+        public = f"output_{i}"
+        b.node("Identity", [out_name], [public])
+        dt = np.dtype(var.aval.dtype)
+        if str(dt) == "bfloat16":
+            dt = np.dtype(np.float32)
+        b.value_info(b.graph.output, public, var.aval.shape, dt)
+
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(b.model.SerializeToString())
+    return out_path

@@ -18,7 +18,9 @@ import numpy as np
 
 from paddle_tpu.io.dataset import Dataset
 
-__all__ = ["Vocab", "Imdb", "Imikolov", "UCIHousing", "LMDataset",
+from paddle_tpu.text.tokenizer import FasterTokenizer  # noqa: F401
+
+__all__ = ["Vocab", "FasterTokenizer", "Imdb", "Imikolov", "UCIHousing", "LMDataset",
            "viterbi_decode"]
 
 

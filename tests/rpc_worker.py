@@ -1,0 +1,38 @@
+"""2-process RPC worker (launched by test_rpc.py via the launch CLI).
+NOT a pytest file."""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed import rpc  # noqa: E402
+
+out_dir = sys.argv[1]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+rpc.init_rpc(f"worker{rank}",
+             master_endpoint="127.0.0.1:"
+             + os.environ["PADDLE_STORE_PORT"])
+
+if rank == 0:
+    # sync call computing remotely on worker1
+    got = rpc.rpc_sync("worker1", pow, args=(2, 10))
+    assert got == 1024, got
+    # async fan-out
+    futs = [rpc.rpc_async("worker1", len, args=([0] * n,))
+            for n in (1, 2, 3)]
+    assert [f.wait() for f in futs] == [1, 2, 3]
+    # remote exception surfaces locally with the original type
+    try:
+        rpc.rpc_sync("worker1", int, args=("nope",))
+        raise SystemExit("expected ValueError")
+    except ValueError:
+        pass
+    infos = rpc.get_all_worker_infos()
+    with open(os.path.join(out_dir, "result.json"), "w") as f:
+        json.dump({"got": got, "workers": [w.name for w in infos],
+                   "self": rpc.get_current_worker_info().name}, f)
+rpc.shutdown()

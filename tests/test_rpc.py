@@ -1,0 +1,93 @@
+"""paddle.distributed.rpc parity (VERDICT r3 Missing #7).
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc, rpc_sync,
+rpc_async, shutdown, worker infos over a C++ brpc agent).  Here the
+agent is a threaded TCP server + native-TCPStore discovery
+(distributed/rpc.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.elastic import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "rpc_worker.py")
+
+
+@pytest.fixture
+def world1():
+    rpc.init_rpc("solo", rank=0, world_size=1,
+                 master_endpoint=f"127.0.0.1:{free_port()}")
+    yield
+    rpc.shutdown()
+
+
+def _double(x):
+    return 2 * x
+
+
+class TestRpcSingleWorld:
+    def test_sync_self_call(self, world1):
+        assert rpc.rpc_sync("solo", _double, args=(21,)) == 42
+
+    def test_async_future(self, world1):
+        fut = rpc.rpc_async("solo", _double, args=(5,))
+        assert fut.wait() == 10
+        assert fut.done()
+
+    def test_kwargs_and_exception(self, world1):
+        assert rpc.rpc_sync("solo", int, args=("ff",),
+                            kwargs={"base": 16}) == 255
+        with pytest.raises(ZeroDivisionError):
+            rpc.rpc_sync("solo", divmod, args=(1, 0))
+
+    def test_worker_infos(self, world1):
+        wi = rpc.get_worker_info("solo")
+        assert wi.rank == 0 and wi.port > 0
+        assert rpc.get_current_worker_info().name == "solo"
+        assert [w.name for w in rpc.get_all_worker_infos()] == ["solo"]
+
+    def test_unknown_worker_rejected(self, world1):
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc.rpc_sync("nobody", _double, args=(1,))
+
+    def test_double_init_rejected(self, world1):
+        with pytest.raises(RuntimeError, match="twice"):
+            rpc.init_rpc("again", rank=0, world_size=1,
+                         master_endpoint="127.0.0.1:1")
+
+    def test_uninitialized_rejected(self):
+        with pytest.raises(RuntimeError, match="not initialized"):
+            rpc.rpc_sync("solo", _double, args=(1,))
+
+
+def test_two_process_rpc(tmp_path):
+    """Real 2-process RPC through the launch CLI: cross-process sync,
+    async fan-out, and remote-exception propagation."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PADDLE_STORE_PORT"] = str(free_port())
+    for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_MASTER"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--master", f"127.0.0.1:{free_port()}",
+         "--log_dir", str(tmp_path / "logs"), WORKER, str(tmp_path)],
+        env=env, timeout=180, capture_output=True, text=True)
+    logs = ""
+    if (tmp_path / "logs").exists():
+        for f in sorted((tmp_path / "logs").iterdir()):
+            logs += f"\n--- {f.name} ---\n" + f.read_text()[-3000:]
+    assert proc.returncode == 0, f"rc={proc.returncode}\n{logs}"
+    with open(tmp_path / "result.json") as f:
+        result = json.load(f)
+    assert result["got"] == 1024
+    assert result["workers"] == ["worker0", "worker1"]
+    assert result["self"] == "worker0"

@@ -1,0 +1,92 @@
+"""Native WordPiece tokenizer (VERDICT r3 Missing #6; reference
+faster_tokenizer_op.cc + phi/kernels/strings/).  Parity-tested against a
+pure-python reference WordPiece implementation."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text import FasterTokenizer
+
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick", "brown",
+         "fox", "jump", "##s", "##ed", "over", "lazy", "dog", ",", ".",
+         "un", "##believ", "##able"]
+
+
+def py_wordpiece(word, vocab):
+    """Reference algorithm (greedy longest-match-first)."""
+    if len(word) > 100:
+        return [vocab.index("[UNK]")]
+    out, start = [], 0
+    while start < len(word):
+        end, cur = len(word), None
+        while start < end:
+            sub = word[start:end]
+            if start > 0:
+                sub = "##" + sub
+            if sub in vocab:
+                cur = vocab.index(sub)
+                break
+            end -= 1
+        if cur is None:
+            return [vocab.index("[UNK]")]
+        out.append(cur)
+        start = end
+    return out
+
+
+def py_tokenize(text, vocab):
+    import re
+    words = re.findall(r"\w+|[^\w\s]", text.lower())
+    ids = []
+    for w in words:
+        ids.extend(py_wordpiece(w, vocab))
+    return ids
+
+
+@pytest.fixture(scope="module")
+def tok():
+    return FasterTokenizer(VOCAB)
+
+
+class TestFasterTokenizer:
+    def test_basic_parity_with_python_reference(self, tok):
+        for text in ("the quick brown fox", "The quick, brown fox.",
+                     "jumps jumped", "unbelievable", "xyzzy the fox"):
+            got = tok.tokenize_ids(text)
+            want = py_tokenize(text, VOCAB)
+            assert got == want, (text, got, want)
+
+    def test_wordpiece_continuation(self, tok):
+        # "jumps" -> jump + ##s ; "unbelievable" -> un + ##believ + ##able
+        assert tok.tokenize_ids("jumps") == [8, 9]
+        assert tok.tokenize_ids("unbelievable") == [16, 17, 18]
+
+    def test_unknown_word_is_unk(self, tok):
+        assert tok.tokenize_ids("zzzz") == [1]
+
+    def test_call_adds_specials_and_pads(self, tok):
+        enc = tok(["the fox", "the quick brown fox jumps"], max_seq_len=8)
+        ids = enc["input_ids"]
+        assert ids.shape == (2, 8) and ids.dtype == np.int64
+        assert list(ids[0][:4]) == [2, 4, 7, 3]     # CLS the fox SEP
+        assert list(ids[0][4:]) == [0, 0, 0, 0]     # PAD
+        assert ids[1][0] == 2 and ids[1][-1] != 0
+        assert enc["token_type_ids"].shape == (2, 8)
+
+    def test_truncation(self, tok):
+        enc = tok("the quick brown fox jumps over the lazy dog",
+                  max_seq_len=6)
+        ids = enc["input_ids"][0]
+        assert len(ids) == 6 and ids[0] == 2 and ids[-1] == 3
+
+    def test_vocab_from_dict_and_token_to_id(self):
+        t = FasterTokenizer({tok: i for i, tok in enumerate(VOCAB)})
+        assert t.vocab_size == len(VOCAB)
+        assert t.token_to_id("fox") == 7
+        assert t.token_to_id("nope") == -1
+        t.close()
+
+    def test_case_sensitivity_flag(self):
+        t = FasterTokenizer(VOCAB, do_lower_case=False)
+        assert t.tokenize_ids("THE") == [1]  # no folding -> UNK
+        t.close()
