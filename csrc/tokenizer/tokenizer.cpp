@@ -8,6 +8,11 @@
 // punctuation split, optional lowercasing with ASCII + Latin-1 folding),
 // then greedy longest-match-first WordPiece with "##" continuations.
 //
+// Lowercase folding is ASCII-only (std::tolower, C locale): non-ASCII
+// UTF-8 bytes pass through unfolded, so accented vocab entries must be
+// stored in their cased form.  Duplicate vocab lines keep the FIRST id
+// (idx still advances, so later lines stay aligned with their row).
+//
 // C API (ctypes-friendly, no C++ types across the boundary):
 //   tok_create(vocab_path, do_lower) -> handle
 //   tok_encode(handle, text, out_ids, max_len) -> n_tokens (ids include

@@ -129,7 +129,9 @@ class PlanReport:
                 f"{self.params_total / 1e9:.2f}B params, per-device "
                 f"{self.peak_bytes_per_device / gb:.2f} GiB "
                 f"(resident {self.resident_bytes / gb:.2f} + transient "
-                f"{self.transient_bytes / gb:.2f})")
+                f"{self.transient_bytes / gb:.2f}) "
+                "[ESTIMATE: CPU-backend buffer assignment + analytic "
+                "working set — re-verify against the real TPU compiler]")
 
 
 # -- functional Llama pipeline spec ------------------------------------------

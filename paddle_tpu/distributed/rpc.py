@@ -71,6 +71,8 @@ class _Server:
         self._pool = ThreadPoolExecutor(max_workers=max_workers,
                                         thread_name_prefix="pt_rpc")
         self._running = True
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -81,6 +83,8 @@ class _Server:
                 conn, _ = self._sock.accept()
             except OSError:
                 break
+            with self._conn_lock:
+                self._conns.add(conn)
             self._pool.submit(self._serve, conn)
 
     def _serve(self, conn):
@@ -105,6 +109,9 @@ class _Server:
                                 f"rpc result not picklable: {result!r}"))))
         except Exception:
             pass  # connection torn down mid-serve
+        finally:
+            with self._conn_lock:
+                self._conns.discard(conn)
 
     def stop(self):
         self._running = False
@@ -112,6 +119,16 @@ class _Server:
             self._sock.close()
         except OSError:
             pass
+        # unblock serve threads parked in recv on live connections —
+        # ThreadPoolExecutor threads are non-daemon and joined at
+        # interpreter exit, so a hung peer must not hang OUR exit
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._pool.shutdown(wait=False)
 
 
@@ -209,7 +226,10 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
 
 def shutdown():
     """Barrier with every worker, then stop the agent (reference
-    rpc.py:270 — graceful by default so in-flight serves finish)."""
+    rpc.py:270).  The barrier is what makes this graceful — every
+    worker's issued calls have returned before anyone stops; after it,
+    stop() force-closes any connection a crashed/hung peer left open so
+    local interpreter exit can never hang on a serve thread."""
     store = _state["store"]
     if store is not None:
         try:

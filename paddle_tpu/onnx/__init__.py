@@ -224,7 +224,12 @@ def _emit_eqn(b: _Builder, eqn):
             np.asarray(y, _np_of(eqn.invars[0].aval)))
         b.node("Pow", [ins[0], exp_name], outs)
     elif prim == "convert_element_type":
-        b.node("Cast", ins, outs, to=_onnx_dtype(np.dtype(p["new_dtype"])))
+        # bf16 graphs are folded to fp32 throughout (initializers + IO),
+        # so a bf16 cast target must fold too or the graph type-checks
+        # inconsistently in real ONNX consumers
+        tgt = np.dtype(np.float32) if str(p["new_dtype"]) == "bfloat16" \
+            else np.dtype(p["new_dtype"])
+        b.node("Cast", ins, outs, to=_onnx_dtype(tgt))
     elif prim == "select_n":
         if len(ins) != 3:
             raise NotImplementedError("onnx export: select_n arity != 3")
@@ -280,6 +285,12 @@ def _emit_eqn(b: _Builder, eqn):
         dn = p["dimension_numbers"]
         if (dn.lhs_spec[:2] != (0, 1)) or (dn.rhs_spec[:2] != (0, 1)):
             raise NotImplementedError("onnx export: conv layout != NCHW/OIHW")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            # transposed conv lowers via lhs_dilation — a plain ONNX Conv
+            # would silently compute the wrong thing
+            raise NotImplementedError(
+                "onnx export: lhs-dilated conv (Conv2DTranspose) is not "
+                "mapped; use jit.save/StableHLO for this model")
         pads = list(p["padding"])
         onnx_pads = [pr[0] for pr in pads] + [pr[1] for pr in pads]
         b.node("Conv", ins, outs,

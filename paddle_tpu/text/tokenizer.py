@@ -43,6 +43,10 @@ class FasterTokenizer:
     vocab: path to a one-token-per-line vocab file, OR a {token: id} dict
     / list of tokens (written to a temp file for the native side — ids
     must then be dense 0..n-1).
+
+    Case folding is ASCII-only (the native side uses the C locale):
+    non-ASCII text passes through unfolded — matching vocab entries must
+    be cased as they appear, unlike BERT's full-unicode BasicTokenizer.
     """
 
     def __init__(self, vocab: Union[str, Dict[str, int], Sequence[str]],
