@@ -26,6 +26,9 @@ from paddle_tpu.ops.creation import to_tensor  # noqa: F401
 from paddle_tpu.ops.math import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.array_ops import (  # noqa: F401
+    array_length, array_read, array_write, create_array,
+)
 from paddle_tpu.ops.logic import *  # noqa: F401,F403
 from paddle_tpu.ops.search import *  # noqa: F401,F403
 from paddle_tpu.ops.stat import *  # noqa: F401,F403

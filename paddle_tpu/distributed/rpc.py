@@ -84,8 +84,24 @@ class _Server:
             except OSError:
                 break
             with self._conn_lock:
+                # registration and the stop() drain both run under the
+                # lock: a connection accepted while stop() is in flight
+                # must be closed here, never submitted to a shut pool
+                if not self._running:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    break
                 self._conns.add(conn)
-            self._pool.submit(self._serve, conn)
+            try:
+                self._pool.submit(self._serve, conn)
+            except RuntimeError:  # pool already shut down
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                break
 
     def _serve(self, conn):
         try:
@@ -121,7 +137,9 @@ class _Server:
             pass
         # unblock serve threads parked in recv on live connections —
         # ThreadPoolExecutor threads are non-daemon and joined at
-        # interpreter exit, so a hung peer must not hang OUR exit
+        # interpreter exit, so a hung peer must not hang OUR exit.
+        # _running is already False, so under the lock the accept loop
+        # can no longer register new connections behind this drain.
         with self._conn_lock:
             conns = list(self._conns)
         for c in conns:

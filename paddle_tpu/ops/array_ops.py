@@ -1,0 +1,70 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py —
+create_array/array_write/array_read/array_length over the C++
+TensorArray type, phi/core/tensor_array.h).
+
+TPU-native stance: in eager mode a TensorArray is a plain python list of
+Tensors (the reference's dygraph branch does exactly this); inside jit,
+loop-carried accumulation belongs to ``lax.scan``'s stacked outputs —
+there is no dynamic-length device container under XLA's static shapes,
+so traced writes at traced indices raise with that guidance instead of
+miscompiling.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["create_array", "array_write", "array_read", "array_length"]
+
+
+def _index(i) -> int:
+    import jax
+    raw = i._data if hasattr(i, "_data") else i
+    if isinstance(raw, jax.core.Tracer):
+        raise TypeError(
+            "TensorArray indices must be concrete: under jit, accumulate "
+            "with lax.scan (stacked outputs) instead of array_write at a "
+            "traced index — XLA has no dynamic-length containers")
+    idx = int(raw)
+    if idx < 0:
+        raise IndexError(f"TensorArray indices are non-negative positions, "
+                         f"got {idx}")
+    return idx
+
+
+def create_array(dtype: str = "float32",
+                 initialized_list: Optional[List] = None) -> List:
+    """New TensorArray, optionally seeded (reference array.py:222)."""
+    from paddle_tpu.core.tensor import Tensor
+    out: List = []
+    for v in (initialized_list or []):
+        out.append(v if isinstance(v, Tensor) else Tensor(v))
+    return out
+
+
+def array_write(x, i, array: Optional[List] = None) -> List:
+    """Write x at index i, growing the array as needed
+    (reference array.py:141: i == len appends, i < len overwrites)."""
+    from paddle_tpu.core.tensor import Tensor
+    if array is None:
+        array = []
+    idx = _index(i)
+    if idx > len(array):
+        raise IndexError(f"array_write index {idx} beyond length "
+                         f"{len(array)} (only append or overwrite)")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array: List, i):
+    """Read array[i] (reference array.py:73)."""
+    return array[_index(i)]
+
+
+def array_length(array: List) -> int:
+    """Length (reference array.py:24)."""
+    return len(array)

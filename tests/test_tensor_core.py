@@ -185,3 +185,52 @@ def test_parameter():
     assert p.trainable
     (p.sum() * 3).backward()
     np.testing.assert_allclose(p.grad.numpy(), 3 * np.ones((2, 2)))
+
+
+class TestTensorArray:
+    """TensorArray ops (reference python/paddle/tensor/array.py over
+    phi/core/tensor_array.h; eager list semantics, scan guidance in jit)."""
+
+    def test_write_read_length(self):
+        arr = pt.create_array("float32")
+        arr = pt.array_write(pt.to_tensor([1.0, 2.0]), 0, arr)
+        arr = pt.array_write(pt.to_tensor([3.0, 4.0]), 1, arr)
+        assert pt.array_length(arr) == 2
+        np.testing.assert_allclose(np.asarray(pt.array_read(arr, 1)._data),
+                                   [3.0, 4.0])
+        # overwrite
+        arr = pt.array_write(pt.to_tensor([9.0, 9.0]), 0, arr)
+        np.testing.assert_allclose(np.asarray(pt.array_read(arr, 0)._data),
+                                   [9.0, 9.0])
+
+    def test_initialized_list_and_gap_rejected(self):
+        arr = pt.create_array(initialized_list=[pt.to_tensor([1.0])])
+        assert pt.array_length(arr) == 1
+        import pytest as _pt
+        with _pt.raises(IndexError, match="beyond length"):
+            pt.array_write(pt.to_tensor([1.0]), 5, arr)
+
+    def test_traced_index_guidance(self):
+        import jax
+        import pytest as _pt
+
+        def f(i):
+            return pt.array_write(pt.to_tensor([1.0]), i, [])
+
+        with _pt.raises(TypeError, match="lax.scan"):
+            jax.jit(f)(np.asarray(0))
+
+    def test_grad_flows_through_array(self):
+        x = pt.to_tensor([2.0, 3.0], stop_gradient=False)
+        arr = pt.array_write(x * 2, 0)
+        y = pt.array_read(arr, 0).sum()
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad), [2.0, 2.0])
+
+    def test_negative_index_rejected(self):
+        import pytest as _pt
+        arr = pt.create_array(initialized_list=[pt.to_tensor([1.0])])
+        with _pt.raises(IndexError, match="non-negative"):
+            pt.array_write(pt.to_tensor([2.0]), -1, arr)
+        with _pt.raises(IndexError, match="non-negative"):
+            pt.array_read(arr, -1)
