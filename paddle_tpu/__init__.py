@@ -23,6 +23,7 @@ from paddle_tpu.core.tensor import (  # noqa: F401
 # op surface → top level (paddle parity)
 from paddle_tpu.ops.creation import *  # noqa: F401,F403
 from paddle_tpu.ops.creation import to_tensor  # noqa: F401
+from paddle_tpu.ops import linalg  # noqa: F401  (paddle.linalg namespace)
 from paddle_tpu.ops.math import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.manipulation import *  # noqa: F401,F403

@@ -5,11 +5,13 @@ from paddle_tpu.io.dataset import (  # noqa: F401
     DistributedBatchSampler, IterableDataset, RandomSampler, Sampler,
     SequenceSampler, Subset, TensorDataset, WeightedRandomSampler,
     random_split)
-from paddle_tpu.io.dataloader import DataLoader, default_collate_fn  # noqa: F401
+from paddle_tpu.io.dataloader import (  # noqa: F401
+    DataLoader, default_collate_fn, get_worker_info)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "default_collate_fn",
+    "get_worker_info",
 ]

@@ -24,7 +24,8 @@ import numpy as np
 from paddle_tpu.io.dataset import (BatchSampler, Dataset, IterableDataset,
                                    SequenceSampler)
 
-__all__ = ["DataLoader", "default_collate_fn"]
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info",
+           "WorkerInfo"]
 
 
 def default_collate_fn(batch):
@@ -124,37 +125,38 @@ class DataLoader:
         return self.collate_fn(samples)
 
     def _gen_map_style(self):
-        if self.num_workers > 0:
+        if self.num_workers > 0 and self.batch_sampler is not None:
             # process pool maps index batches; order preserved
+            import multiprocessing
             from concurrent.futures import ProcessPoolExecutor
             if self._pool is None:
-                self._pool = ProcessPoolExecutor(self.num_workers)
-            futures = []
+                counter = multiprocessing.Value("i", 0)
+                base_seed = int(np.random.default_rng().integers(2 ** 31))
+                self._pool = ProcessPoolExecutor(
+                    self.num_workers, initializer=_worker_init,
+                    initargs=(counter, self.num_workers, base_seed))
             inflight = self.num_workers * self.prefetch_factor
             it = iter(self.batch_sampler)
             import collections
             dq = collections.deque()
-            try:
-                for _ in range(inflight):
-                    try:
-                        dq.append(self._pool.submit(_fetch_worker,
-                                                    self.dataset,
-                                                    self.collate_fn,
-                                                    next(it)))
-                    except StopIteration:
-                        break
-                while dq:
-                    fut = dq.popleft()
-                    yield fut.result()
-                    try:
-                        dq.append(self._pool.submit(_fetch_worker,
-                                                    self.dataset,
-                                                    self.collate_fn,
-                                                    next(it)))
-                    except StopIteration:
-                        pass
-            finally:
-                pass
+            for _ in range(inflight):
+                try:
+                    dq.append(self._pool.submit(_fetch_worker,
+                                                self.dataset,
+                                                self.collate_fn,
+                                                next(it)))
+                except StopIteration:
+                    break
+            while dq:
+                fut = dq.popleft()
+                yield fut.result()
+                try:
+                    dq.append(self._pool.submit(_fetch_worker,
+                                                self.dataset,
+                                                self.collate_fn,
+                                                next(it)))
+                except StopIteration:
+                    pass
         else:
             if self.batch_sampler is None:
                 for i in range(len(self.dataset)):
@@ -186,6 +188,34 @@ class DataLoader:
     def __del__(self):
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+from collections import namedtuple
+
+WorkerInfo = namedtuple("WorkerInfo", ["id", "num_workers", "seed",
+                                       "dataset"])
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a map-style DataLoader WORKER PROCESS: that worker's
+    stable info (id assigned once per process, seed = base_seed + id);
+    in the main process: None (reference io/dataloader/worker.py:81).
+    Iterable datasets iterate in the main process here, so sharding by
+    worker id is a map-style concern only."""
+    return _worker_info
+
+
+def _worker_init(counter, num_workers, base_seed):
+    """Pool initializer: runs ONCE per worker process — the id is the
+    process's identity, not a per-task round-robin (a dataset keying
+    per-worker resources or RNG on it needs it stable)."""
+    global _worker_info
+    with counter.get_lock():
+        wid = counter.value
+        counter.value += 1
+    _worker_info = WorkerInfo(id=wid, num_workers=num_workers,
+                              seed=base_seed + wid, dataset=None)
 
 
 def _fetch_worker(dataset, collate_fn, indices):

@@ -234,6 +234,44 @@ def tensordot(x, y, axes=2):
     return jnp.tensordot(x, y, axes=axes)
 
 
+@eager_op
+def vecdot(x, y, axis=-1):
+    """Vector dot along `axis` with broadcasting; conjugates x for
+    complex inputs (reference python/paddle/tensor/linalg.py vecdot,
+    array-API semantics)."""
+    if jnp.iscomplexobj(x):
+        x = jnp.conj(x)
+    return jnp.sum(x * y, axis=axis)
+
+
+@eager_op
+def cartesian_prod(*tensors):
+    """Cartesian product of 1-D tensors → [prod(n_i), len(tensors)]
+    (reference tensor/math.py cartesian_prod)."""
+    if len(tensors) == 1 and isinstance(tensors[0], (list, tuple)):
+        tensors = tuple(tensors[0])
+    if len(tensors) == 1:
+        return jnp.reshape(tensors[0], (-1,))  # paddle: 1-D stays 1-D
+    grids = jnp.meshgrid(*tensors, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+
+@eager_op
+def combinations(x, r=2, with_replacement=False):
+    """r-length combinations of a 1-D tensor's elements (reference
+    tensor/math.py combinations).  Indices are computed host-side
+    (itertools) — the input length is static under tracing anyway."""
+    import itertools
+    import numpy as np
+    n = x.shape[0]
+    picker = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(picker(range(n), r)), dtype=np.int32)
+    if idx.size == 0:
+        return jnp.zeros((0, r), x.dtype)
+    return jnp.take(x, jnp.asarray(idx), axis=0)
+
+
 # Public surface: only ops defined in this module (tape-aware wrappers carry
 # __wrapped_pure__; plain helpers must be defined here, not imported).
 __all__ = [_n for _n, _v in list(globals().items())

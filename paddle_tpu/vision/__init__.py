@@ -6,4 +6,41 @@ from paddle_tpu.vision import models  # noqa: F401
 from paddle_tpu.vision import ops  # noqa: F401
 from paddle_tpu.vision import transforms  # noqa: F401
 
-__all__ = ["datasets", "models", "ops", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms",
+           "set_image_backend", "get_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """Reference paddle.vision.set_image_backend: select the decode
+    backend for image datasets ('pil' or 'cv2'; both decode to the same
+    numpy HWC arrays the transforms consume)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"image backend must be 'pil' or 'cv2', "
+                         f"got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str):
+    """Load an image file to a numpy HWC array using the selected
+    backend (reference paddle.vision.image_load)."""
+    import numpy as np
+    if _image_backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise RuntimeError("cv2 backend selected but OpenCV is not "
+                               "installed") from e
+        img = cv2.imread(path)
+        if img is None:
+            raise FileNotFoundError(
+                f"cv2 could not read image file {path!r}")
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+    from PIL import Image
+    return np.asarray(Image.open(path).convert("RGB"))

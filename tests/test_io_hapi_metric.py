@@ -189,3 +189,59 @@ class TestHapiModel:
         model = self._make()
         info = model.summary()
         assert info["total_params"] == 4 * 16 + 16 + 16 * 3 + 3
+
+
+class TestRound4Surface:
+    def test_get_worker_info_main_process_none(self):
+        from paddle_tpu.io import get_worker_info
+        assert get_worker_info() is None
+
+    def test_get_worker_info_inside_worker(self):
+        from paddle_tpu import io
+
+        dl = io.DataLoader(_WorkerProbeDataset(), batch_size=4,
+                           num_workers=2)
+        batches = list(dl)
+        assert len(batches) == 2
+        ids = np.concatenate([b[:, 1] for b in batches])
+        assert set(ids.tolist()) <= {0, 1}
+
+    def test_vecdot_cartesian_combinations(self):
+        import paddle_tpu as pp
+        a = pp.to_tensor([1.0, 2.0, 3.0])
+        b = pp.to_tensor([4.0, 5.0, 6.0])
+        assert float(pp.linalg.vecdot(a, b)) == 32.0
+        cp = pp.cartesian_prod(pp.to_tensor([1, 2]), pp.to_tensor([3, 4]))
+        np.testing.assert_array_equal(np.asarray(cp._data),
+                                      [[1, 3], [1, 4], [2, 3], [2, 4]])
+        cb = pp.combinations(pp.to_tensor([1.0, 2.0, 3.0]), r=2)
+        assert tuple(cb.shape) == (3, 2)
+        cbr = pp.combinations(pp.to_tensor([1.0, 2.0]), r=2,
+                              with_replacement=True)
+        assert tuple(cbr.shape) == (3, 2)
+
+    def test_image_backend(self):
+        from paddle_tpu import vision
+        assert vision.get_image_backend() == "pil"
+        vision.set_image_backend("cv2")
+        assert vision.get_image_backend() == "cv2"
+        vision.set_image_backend("pil")
+        import pytest as _pt
+        with _pt.raises(ValueError):
+            vision.set_image_backend("magick")
+
+
+from paddle_tpu.io import Dataset as _IoDataset
+
+
+class _WorkerProbeDataset(_IoDataset):
+    """Module-level (picklable) dataset asserting worker-side info."""
+
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        from paddle_tpu.io import get_worker_info
+        wi = get_worker_info()
+        assert wi is not None and wi.num_workers == 2
+        return np.asarray([i, wi.id])
