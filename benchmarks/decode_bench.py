@@ -1,0 +1,72 @@
+"""Compiled KV-cache decode throughput on the chip — the serving-side
+number (reference role: the fused_multi_transformer inference path that
+ERNIE serving runs on; here generation/__init__.py's compiled per-token
+step over StaticCache).
+
+Measures greedy decode tokens/sec at a Llama-proportioned single-chip
+model (b=8, prompt 128, 512 new tokens, bf16).  Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import paddle_tpu as pp
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=7168,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=1024,
+            rope_theta=500000.0, dtype="bfloat16")
+        batch, prompt_len, new_tokens = 8, 128, 512
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt_len, new_tokens = 2, 8, 16
+
+    pp.seed(0)
+    model = LlamaForCausalLM(cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size,
+                       (batch, prompt_len)).astype(np.int32)
+
+    def run(n):
+        out = model.generate(ids, max_new_tokens=n, do_sample=False)
+        np.asarray(out)
+
+    half = new_tokens // 2
+    run(new_tokens)           # compile + warm (both shapes)
+    run(half)
+    # prefill time cancels in the delta: pure per-token decode rate
+    t0 = time.perf_counter()
+    run(new_tokens)
+    t_full = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run(half)
+    t_half = time.perf_counter() - t0
+    decode_dt = max(t_full - t_half, 1e-9)
+    tok_s = batch * (new_tokens - half) / decode_dt
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": round(tok_s, 1), "unit": "tok/s",
+        "detail": {"batch": batch, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens,
+                   "per_seq_tok_s": round(tok_s / batch, 1),
+                   "params": n_params,
+                   "device": getattr(dev, "device_kind", dev.platform),
+                   "wall_full_s": round(t_full, 3),
+                   "wall_half_s": round(t_half, 3)}}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -194,17 +194,18 @@ class MoEForCausalLM(Layer):
         return self.lm_head(self.model(input_ids))
 
     def loss(self, input_ids, labels):
-        """CE + alpha * load-balance aux (reference: gate loss added in
-        moe/utils, alpha from config)."""
-        logits = self(input_ids)
-        v = logits.shape[-1]
-        ce = F.cross_entropy(M.reshape(logits, [-1, v]),
-                             M.reshape(labels, [-1]))
+        """Fused chunked lm-head CE (the [T, V] fp32 logits are never
+        materialized — same objective path as Llama) + alpha *
+        load-balance aux (reference: gate loss added in moe/utils)."""
+        h = self.model(input_ids)
+        d = h.shape[-1]
+        ce = F.fused_linear_cross_entropy(
+            M.reshape(h, [-1, d]), self.lm_head.weight,
+            M.reshape(labels, [-1]))
         aux = self.model.aux_loss()
         if aux is not None:
-            from paddle_tpu.core.dispatch import unwrap
+            from paddle_tpu.core.dispatch import unwrap, wrap_like
             ce_raw = unwrap(ce) + self.config.aux_loss_alpha * unwrap(aux)
-            from paddle_tpu.core.dispatch import wrap_like
             return wrap_like(ce_raw) if hasattr(ce, "_data") else ce_raw
         return ce
 
