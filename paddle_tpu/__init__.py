@@ -68,6 +68,7 @@ from paddle_tpu import optimizer  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
 from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import text  # noqa: F401,E402
+from paddle_tpu import hub  # noqa: F401,E402
 from paddle_tpu import onnx  # noqa: F401,E402
 from paddle_tpu import static  # noqa: F401,E402
 from paddle_tpu import utils  # noqa: F401,E402

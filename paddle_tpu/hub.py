@@ -1,0 +1,91 @@
+"""paddle_tpu.hub — hubconf-based model loading.
+
+Reference parity: ``paddle.hub`` (python/paddle/hapi/hub.py —
+list/help/load over a repo's ``hubconf.py``; entrypoints are callables
+whose docstrings are the help text).  Source scope here: ``'local'``
+(a directory containing hubconf.py).  The github/gitee download sources
+require network egress this environment does not have — they raise with
+that explanation rather than half-working.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str, source: str):
+    if source != "local":
+        raise NotImplementedError(
+            f"hub source {source!r}: only 'local' is supported (this "
+            "environment has no network egress for github/gitee clones); "
+            "clone the repo yourself and pass its path with source='local'")
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(path)))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)  # hubconf may import repo-local modules
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        try:
+            sys.path.remove(repo_dir)
+        except ValueError:
+            pass
+    return mod
+
+
+def _find_spec(name: str):
+    try:
+        return importlib.util.find_spec(name)
+    except ModuleNotFoundError:  # dotted name with an absent parent
+        return None
+
+
+def _entrypoints(mod) -> List[str]:
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps if _find_spec(d) is None]
+    if missing:
+        raise RuntimeError(f"hubconf dependencies not installed: {missing}")
+    return sorted(
+        n for n, v in vars(mod).items()
+        if callable(v) and not n.startswith("_")
+        # only functions DEFINED in hubconf are entrypoints — helpers it
+        # imports from repo-local modules are not part of the contract
+        and getattr(v, "__module__", mod.__name__) == mod.__name__)
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf
+    (reference hub.py:175)."""
+    return _entrypoints(_load_hubconf(repo_dir, source))
+
+
+def _resolve(repo_dir: str, model: str, source: str):
+    mod = _load_hubconf(repo_dir, source)
+    eps = _entrypoints(mod)
+    if model not in eps:
+        raise ValueError(f"no entrypoint {model!r} in {repo_dir!r}; "
+                         f"available: {eps}")
+    return getattr(mod, model)
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    """The entrypoint's docstring (reference hub.py:223)."""
+    return _resolve(repo_dir, model, source).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Call the entrypoint with **kwargs and return its result
+    (reference hub.py:268)."""
+    return _resolve(repo_dir, model, source)(**kwargs)

@@ -443,3 +443,44 @@ class TestFusedLinearCrossEntropy:
         assert float(jnp.abs(gh[2]).sum()) == 0.0
         assert float(jnp.abs(gh[5]).sum()) == 0.0
         assert float(jnp.abs(gh[0]).sum()) > 0.0
+
+
+class TestHub:
+    """paddle.hub parity (reference hapi/hub.py), local source scope."""
+
+    @pytest.fixture
+    def repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            'dependencies = ["numpy"]\n\n'
+            "def tiny_mlp(hidden=8):\n"
+            '    """A tiny MLP. Args: hidden (int)."""\n'
+            "    import paddle_tpu as pp\n"
+            "    return pp.nn.Sequential(pp.nn.Linear(4, hidden),\n"
+            "                            pp.nn.ReLU(),\n"
+            "                            pp.nn.Linear(hidden, 2))\n\n"
+            "def _private():\n"
+            "    pass\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, repo):
+        import paddle_tpu as pp
+        assert pp.hub.list(repo) == ["tiny_mlp"]
+        assert "tiny MLP" in pp.hub.help(repo, "tiny_mlp")
+        net = pp.hub.load(repo, "tiny_mlp", hidden=16)
+        out = net(pp.randn([2, 4]))
+        assert tuple(out.shape) == (2, 2)
+
+    def test_unknown_entrypoint_and_source(self, repo):
+        import paddle_tpu as pp
+        with pytest.raises(ValueError, match="available"):
+            pp.hub.load(repo, "nope")
+        with pytest.raises(NotImplementedError, match="local"):
+            pp.hub.list(repo, source="github")
+
+    def test_missing_dependency_reported(self, tmp_path):
+        import paddle_tpu as pp
+        (tmp_path / "hubconf.py").write_text(
+            'dependencies = ["definitely_not_installed_xyz"]\n'
+            "def m():\n    pass\n")
+        with pytest.raises(RuntimeError, match="dependencies"):
+            pp.hub.list(str(tmp_path))
