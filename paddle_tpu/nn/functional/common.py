@@ -278,6 +278,92 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
 
 
 # Public surface
+
+
+
+# -- round-4 vision/common additions ----------------------------------------
+
+@eager_op
+def zeropad2d(x, padding, data_format="NCHW"):
+    """Zero-pad H/W (reference zeropad2d; padding = int or
+    [left, right, top, bottom])."""
+    if isinstance(padding, int):
+        padding = (padding,) * 4
+    left, right, top, bottom = padding
+    if data_format == "NCHW":
+        cfg = [(0, 0), (0, 0), (top, bottom), (left, right)]
+    else:
+        cfg = [(0, 0), (top, bottom), (left, right), (0, 0)]
+    return jnp.pad(x, cfg)
+
+
+@eager_op
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """TSM temporal shift (reference temporal_shift: fold_div channels
+    shift to t-1, the next fold to t+1, rest stay)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("temporal_shift: NCHW only")
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    fold = int(c * shift_ratio)
+    xr = x.reshape(n, seg_num, c, h, w)
+    back = jnp.concatenate(
+        [xr[:, 1:, :fold], jnp.zeros_like(xr[:, :1, :fold])], axis=1)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+         xr[:, :-1, fold:2 * fold]], axis=1)
+    out = jnp.concatenate([back, fwd, xr[:, :, 2 * fold:]], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+@eager_op
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Spatial sampling at normalized grid locations (reference
+    grid_sample: x NCHW, grid [N, Hg, Wg, 2] with (x, y) in [-1, 1]).
+    bilinear/nearest; zeros/border padding."""
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode {mode}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(f"grid_sample padding {padding_mode}")
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1.0) * 0.5 * (size - 1)
+        return ((g + 1.0) * size - 1.0) * 0.5
+
+    fx, fy = unnorm(gx, w), unnorm(gy, h)            # [N, Hg, Wg]
+
+    def fetch(ix, iy):
+        """x[n, :, iy, ix] with padding handling → [N, Hg, Wg, C]."""
+        inside = (ix >= 0) & (ix < w) & (iy >= 0) & (iy < h)
+        cx = jnp.clip(ix, 0, w - 1)
+        cy = jnp.clip(iy, 0, h - 1)
+        batch = jnp.arange(n)[:, None, None]
+        vals = x.transpose(0, 2, 3, 1)[batch, cy, cx]  # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            vals = jnp.where(inside[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = fetch(jnp.round(fx).astype(jnp.int32),
+                    jnp.round(fy).astype(jnp.int32))
+        return out.transpose(0, 3, 1, 2).astype(x.dtype)
+
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (fx - x0)[..., None]
+    wy = (fy - y0)[..., None]
+    out = (fetch(x0, y0) * (1 - wx) * (1 - wy)
+           + fetch(x1, y0) * wx * (1 - wy)
+           + fetch(x0, y1) * (1 - wx) * wy
+           + fetch(x1, y1) * wx * wy)
+    return out.transpose(0, 3, 1, 2).astype(x.dtype)
+
+
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")

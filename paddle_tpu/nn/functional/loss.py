@@ -410,6 +410,134 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
 # recompute the public surface to include the fused loss above
+
+
+
+# -- round-4 loss additions (reference python/paddle/nn/functional/loss.py) --
+
+@eager_op
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    """Reference huber_loss: quadratic inside |d|<=delta, linear outside
+    (smooth_l1 without the 1/delta normalization)."""
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d,
+                     delta * (ad - 0.5 * delta))
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    """Poisson negative log likelihood (reference poisson_nll_loss)."""
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label) - label + \
+            0.5 * jnp.log(2.0 * jnp.pi * label)
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    """Gaussian negative log likelihood with predicted variance
+    (reference gaussian_nll_loss)."""
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * jnp.log(2.0 * jnp.pi)
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    """Multi-class margin loss (reference multi_margin_loss):
+    mean_j!=y max(0, margin - x_y + x_j)^p."""
+    n, c = input.shape
+    x_y = jnp.take_along_axis(input, label[:, None], axis=1)   # [N, 1]
+    viol = jnp.maximum(0.0, margin - x_y + input) ** p         # [N, C]
+    if weight is not None:
+        viol = viol * jnp.take(weight, label)[:, None]
+    mask = jnp.arange(c)[None, :] != label[:, None]
+    loss = jnp.sum(jnp.where(mask, viol, 0.0), axis=1) / c
+    return _reduce(loss, reduction)
+
+
+@eager_op
+def log_loss(input, label, epsilon=1e-4):
+    """Binary log loss on probabilities (reference log_loss)."""
+    return -label * jnp.log(input + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+@eager_op
+def dice_loss(input, label, epsilon=1e-5):
+    """Dice loss over softmax probabilities (reference dice_loss:
+    input [N, ..., C] probs, label [N, ..., 1] int)."""
+    lbl = jnp.squeeze(label, axis=-1)
+    onehot = jax.nn.one_hot(lbl, input.shape[-1], dtype=input.dtype)
+    reduce_axes = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * onehot, axis=reduce_axes)
+    union = jnp.sum(input, axis=reduce_axes) + \
+        jnp.sum(onehot, axis=reduce_axes)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@eager_op
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference npair_loss): CE over anchor@positive.T
+    similarities + L2 on the embeddings."""
+    sim = anchor @ positive.T                              # [N, N]
+    n = sim.shape[0]
+    logp = jax.nn.log_softmax(sim, axis=1)
+    same = labels[:, None] == labels[None, :]
+    w = same.astype(sim.dtype)
+    w = w / jnp.sum(w, axis=1, keepdims=True)
+    ce = -jnp.mean(jnp.sum(w * logp, axis=1))
+    # reference coefficient: Beta = 0.25 (npair_loss l2loss term)
+    reg = l2_reg * 0.25 * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+                           + jnp.mean(jnp.sum(jnp.square(positive), axis=1)))
+    return ce + reg
+
+
+@eager_op
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    """p-norm of x - y along the last axis (reference
+    nn/functional/distance.py)."""
+    import math
+    d = jnp.abs(x - y) + epsilon
+    if isinstance(p, (int, float)) and math.isinf(p):
+        out = jnp.max(d, axis=-1) if p > 0 else jnp.min(d, axis=-1)
+    else:
+        out = jnp.sum(d ** p, axis=-1) ** (1.0 / p)
+    return out[..., None] if keepdim else out
+
+
+@eager_op
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-family margin softmax (reference margin_cross_entropy:
+    target cos(theta) -> cos(margin1*theta + margin2) - margin3, scaled).
+    `logits` are cosine similarities in [-1, 1]."""
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adjusted = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(adjusted, axis=-1)
+    return loss
+
+
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")

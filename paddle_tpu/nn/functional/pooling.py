@@ -73,8 +73,78 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 @eager_op
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW"):
-    return _pool(x, kernel_size, stride, padding, 2, data_format, "max",
-                 ceil_mode)
+    if not return_mask:
+        return _pool(x, kernel_size, stride, padding, 2, data_format,
+                     "max", ceil_mode)
+    # variadic reduce_window carrying (value, flat-HW-index) pairs — the
+    # indices are what max_unpool2d consumes (reference contract: index
+    # into the flattened H*W plane per channel)
+    if data_format != "NCHW":
+        raise NotImplementedError("return_mask: NCHW only")
+    if ceil_mode:
+        raise NotImplementedError("return_mask with ceil_mode=True is "
+                                  "not supported (floor-mode shapes only)")
+    if isinstance(padding, str):
+        raise NotImplementedError("return_mask with string padding is "
+                                  "not supported; pass explicit ints")
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    n, c, h, w = x.shape
+    idx = jnp.broadcast_to(
+        (jnp.arange(h)[:, None] * w + jnp.arange(w)[None, :]),
+        (n, c, h, w)).astype(jnp.int32)
+    dims = (1, 1, *kernel_size)
+    strides = (1, 1, *stride)
+    pads = ((0, 0), (0, 0), (padding[0], padding[0]),
+            (padding[1], padding[1]))
+    neg = jnp.finfo(jnp.float32).min
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    out, mask = jax.lax.reduce_window(
+        (x.astype(jnp.float32), idx), (neg, jnp.int32(-1)), reducer,
+        dims, strides, pads)
+    return out.astype(x.dtype), mask
+
+
+@eager_op
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to their argmax positions (reference
+    max_unpool2d; `indices` are the flat H*W positions max_pool2d
+    returns with return_mask=True)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d: NCHW only")
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    n, c, ph, pw = x.shape
+    if output_size is None:
+        oh = (ph - 1) * stride[0] + kernel_size[0] - 2 * (
+            padding if isinstance(padding, int) else padding[0])
+        ow = (pw - 1) * stride[1] + kernel_size[1] - 2 * (
+            padding if isinstance(padding, int) else padding[1])
+    else:
+        oh, ow = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    # scatter-ASSIGN (reference semantics): overlapping windows sharing an
+    # argmax carry the same value, so duplicates must not sum
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        indices.reshape(n, c, -1)].set(x.reshape(n, c, -1))
+    return out.reshape(n, c, oh, ow)
 
 
 @eager_op

@@ -274,6 +274,26 @@ def combinations(x, r=2, with_replacement=False):
 
 # Public surface: only ops defined in this module (tape-aware wrappers carry
 # __wrapped_pure__; plain helpers must be defined here, not imported).
+
+
+@eager_op
+def pdist(x, p=2.0):
+    """Condensed pairwise distances of rows (reference tensor/linalg.py
+    pdist): the upper-triangle (i < j) of cdist, flattened."""
+    n = x.shape[0]
+    d = jnp.sum(jnp.abs(x[:, None, :] - x[None, :, :]) ** p,
+                axis=-1) ** (1.0 / p)
+    iu, ju = jnp.triu_indices(n, k=1)
+    return d[iu, ju]
+
+
+@eager_op
+def matrix_exp(x):
+    """Matrix exponential (reference tensor/linalg.py matrix_exp)."""
+    import jax.scipy.linalg as jsl
+    return jsl.expm(x)
+
+
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")

@@ -497,6 +497,29 @@ def rank(x):
 
 
 # recompute the public surface to include the long-tail block above
+
+
+@eager_op
+def masked_scatter(x, mask, value):
+    """Fill True positions of `mask` with `value`'s leading elements in
+    row-major order (reference tensor/manipulation.py masked_scatter).
+    `value` must carry at least mask.sum() elements; shapes are static so
+    the mapping compiles (position k of the mask takes value element
+    rank(k) = number of True positions before it)."""
+    m = jnp.broadcast_to(mask, x.shape)
+    vflat = jnp.ravel(value)
+    order = jnp.cumsum(m.ravel().astype(jnp.int32)) - 1
+    picked = vflat[jnp.clip(order, 0, vflat.shape[0] - 1)]
+    return jnp.where(m, picked.reshape(x.shape), x)
+
+
+@eager_op
+def view_as(x, other):
+    """Reshape x to other's shape (reference view_as — a view in paddle;
+    functional arrays make it a reshape)."""
+    return jnp.reshape(x, other.shape)
+
+
 __all__ = [_n for _n, _v in list(globals().items())
            if not _n.startswith("_") and callable(_v)
            and (hasattr(_v, "__wrapped_pure__")

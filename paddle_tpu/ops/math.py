@@ -43,4 +43,30 @@ def renorm(x, p, axis, max_norm):
 
 
 __all__ = [n for n in _gen_all if n != "OP_INFO"] + [
-    "mod", "floor_mod", "rsqrt_", "multiplex", "renorm"]
+    "mod", "floor_mod", "rsqrt_", "multiplex", "renorm",
+    "cumulative_trapezoid", "histogram_bin_edges"]
+
+
+@eager_op
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """Cumulative trapezoidal integral (reference tensor/math.py
+    cumulative_trapezoid): one fewer element along `axis` than y."""
+    y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+    y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+    if x is not None:
+        x0 = jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1, axis=axis)
+        x1 = jax.lax.slice_in_dim(x, 1, x.shape[axis], axis=axis)
+        seg = (x1 - x0) * (y0 + y1) / 2.0
+    else:
+        seg = (dx if dx is not None else 1.0) * (y0 + y1) / 2.0
+    return jnp.cumsum(seg, axis=axis)
+
+
+@eager_op
+def histogram_bin_edges(input, bins=100, min=0, max=0):
+    """Bin edges matching paddle.histogram's binning (reference
+    tensor/math.py histogram_bin_edges)."""
+    lo, hi = min, max
+    if lo == 0 and hi == 0:
+        lo, hi = jnp.min(input), jnp.max(input)
+    return jnp.linspace(lo, hi, bins + 1)

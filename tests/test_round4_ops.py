@@ -1,0 +1,206 @@
+"""Round-4 op additions: losses, grid_sample/temporal_shift/unpool,
+tensor extras — numpy-oracle checks (reference files noted per op)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+
+F = pp.nn.functional
+rng = np.random.default_rng(0)
+
+
+class TestNewLosses:
+    def test_huber(self):
+        x = pp.to_tensor([0.2, 2.0])
+        y = pp.to_tensor([0.0, 0.0])
+        got = float(F.huber_loss(x, y, delta=1.0))
+        want = np.mean([0.5 * 0.2 ** 2, 1.0 * (2.0 - 0.5)])
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_poisson_nll(self):
+        x = rng.normal(size=(8,)).astype(np.float32)
+        lbl = rng.poisson(3, 8).astype(np.float32)
+        got = float(F.poisson_nll_loss(pp.to_tensor(x), pp.to_tensor(lbl)))
+        want = np.mean(np.exp(x) - lbl * x)
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_gaussian_nll(self):
+        x = rng.normal(size=(8,)).astype(np.float32)
+        lbl = rng.normal(size=(8,)).astype(np.float32)
+        var = np.abs(rng.normal(size=(8,))).astype(np.float32) + 0.1
+        got = float(F.gaussian_nll_loss(pp.to_tensor(x), pp.to_tensor(lbl),
+                                        pp.to_tensor(var)))
+        want = np.mean(0.5 * (np.log(var) + (x - lbl) ** 2 / var))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_multi_margin(self):
+        x = np.array([[0.1, 0.9, 0.3]], np.float32)
+        lbl = np.array([1])
+        got = float(F.multi_margin_loss(pp.to_tensor(x),
+                                        pp.to_tensor(lbl)))
+        want = (max(0, 1 - 0.9 + 0.1) + max(0, 1 - 0.9 + 0.3)) / 3
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_log_and_dice(self):
+        p = np.array([0.9, 0.2], np.float32)
+        y = np.array([1.0, 0.0], np.float32)
+        got = np.asarray(F.log_loss(pp.to_tensor(p), pp.to_tensor(y))._data)
+        want = -(y * np.log(p + 1e-4) + (1 - y) * np.log(1 - p + 1e-4))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        probs = np.array([[[0.8, 0.2], [0.3, 0.7]]], np.float32)  # [1,2,2]
+        lbl = np.array([[[0], [1]]])
+        d = float(F.dice_loss(pp.to_tensor(probs), pp.to_tensor(lbl)))
+        inter = 0.8 + 0.7
+        union = probs.sum() + 2
+        assert d == pytest.approx(1 - (2 * inter + 1e-5) / (union + 1e-5),
+                                  rel=1e-4)
+
+    def test_pairwise_distance(self):
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        y = rng.normal(size=(4, 5)).astype(np.float32)
+        got = np.asarray(F.pairwise_distance(pp.to_tensor(x),
+                                             pp.to_tensor(y))._data)
+        want = np.linalg.norm(np.abs(x - y) + 1e-6, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_margin_cross_entropy_reduces_to_ce_at_zero_margins(self):
+        cos = np.clip(rng.normal(size=(4, 6)).astype(np.float32), -1, 1)
+        lbl = rng.integers(0, 6, 4)
+        got = float(F.margin_cross_entropy(
+            pp.to_tensor(cos), pp.to_tensor(lbl), margin1=1.0, margin2=0.0,
+            margin3=0.0, scale=1.0))
+        logp = cos - np.log(np.exp(cos).sum(-1, keepdims=True))
+        want = -np.mean(logp[np.arange(4), lbl])
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_npair_finite_and_positive(self):
+        a = rng.normal(size=(6, 8)).astype(np.float32)
+        p = rng.normal(size=(6, 8)).astype(np.float32)
+        lbl = np.array([0, 0, 1, 1, 2, 2])
+        v = float(F.npair_loss(pp.to_tensor(a), pp.to_tensor(p),
+                               pp.to_tensor(lbl)))
+        assert np.isfinite(v) and v > 0
+
+
+class TestVisionOps:
+    def test_grid_sample_identity(self):
+        """An identity grid reproduces the input (bilinear,
+        align_corners)."""
+        x = rng.normal(size=(1, 2, 5, 7)).astype(np.float32)
+        ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                             indexing="ij")
+        grid = np.stack([xs, ys], axis=-1)[None].astype(np.float32)
+        out = F.grid_sample(pp.to_tensor(x), pp.to_tensor(grid))
+        np.testing.assert_allclose(np.asarray(out._data), x, atol=1e-5)
+
+    def test_grid_sample_zeros_padding(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        grid = np.full((1, 1, 1, 2), -3.0, np.float32)  # far outside
+        out = F.grid_sample(pp.to_tensor(x), pp.to_tensor(grid))
+        assert np.asarray(out._data).item() == 0.0
+
+    def test_grid_sample_nearest(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        grid = np.array([[[[-1.0, -1.0]]]], np.float32)  # top-left
+        out = F.grid_sample(pp.to_tensor(x), pp.to_tensor(grid),
+                            mode="nearest")
+        assert np.asarray(out._data).item() == 0.0
+
+    def test_zeropad2d(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = np.asarray(F.zeropad2d(pp.to_tensor(x), [1, 0, 0, 2])._data)
+        assert out.shape == (1, 1, 4, 3)
+        assert out.sum() == 4.0
+
+    def test_temporal_shift_moves_channels(self):
+        nt, c, h, w = 4, 8, 2, 2   # n=2 videos x seg_num=2
+        x = rng.normal(size=(nt, c, h, w)).astype(np.float32)
+        out = np.asarray(F.temporal_shift(pp.to_tensor(x), seg_num=2,
+                                          shift_ratio=0.25)._data)
+        xr = x.reshape(2, 2, c, h, w)
+        # fold 0..1 shifted backward: t=0 takes t=1's values
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, :2],
+                                   xr[:, 1, :2])
+        # untouched tail channels identical
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, :, 4:],
+                                   xr[:, :, 4:])
+
+    def test_max_pool_mask_roundtrip_unpool(self):
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        out, mask = F.max_pool2d(pp.to_tensor(x), 2, return_mask=True)
+        assert tuple(out.shape) == (2, 3, 4, 4)
+        assert tuple(mask.shape) == (2, 3, 4, 4)
+        np.testing.assert_allclose(
+            np.asarray(out._data),
+            np.asarray(F.max_pool2d(pp.to_tensor(x), 2)._data))
+        rec = F.max_unpool2d(out, mask, 2)
+        rec_np = np.asarray(rec._data)
+        assert rec_np.shape == x.shape
+        # every pooled max lands back at its original position
+        np.testing.assert_allclose(rec_np.max(axis=(2, 3)),
+                                   np.asarray(out._data).max(axis=(2, 3)))
+        assert (rec_np != 0).sum() == 2 * 3 * 16
+
+
+class TestTensorExtras:
+    def test_masked_scatter(self):
+        x = pp.to_tensor(np.zeros((2, 3), np.float32))
+        mask = pp.to_tensor(np.array([[True, False, True],
+                                      [False, True, False]]))
+        vals = pp.to_tensor(np.array([1.0, 2.0, 3.0, 9.0], np.float32))
+        out = np.asarray(pp.masked_scatter(x, mask, vals)._data)
+        np.testing.assert_allclose(out, [[1, 0, 2], [0, 3, 0]])
+
+    def test_view_as(self):
+        x = pp.randn([2, 6])
+        y = pp.randn([3, 4])
+        assert tuple(pp.view_as(x, y).shape) == (3, 4)
+
+    def test_pdist_matches_scipy_form(self):
+        x = rng.normal(size=(5, 3)).astype(np.float32)
+        got = np.asarray(pp.linalg.pdist(pp.to_tensor(x))._data)
+        want = []
+        for i in range(5):
+            for j in range(i + 1, 5):
+                want.append(np.linalg.norm(x[i] - x[j]))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_matrix_exp(self):
+        a = np.diag([1.0, 2.0]).astype(np.float32)
+        got = np.asarray(pp.linalg.matrix_exp(pp.to_tensor(a))._data)
+        np.testing.assert_allclose(got, np.diag(np.exp([1.0, 2.0])),
+                                   rtol=1e-5)
+
+    def test_cumulative_trapezoid(self):
+        y = np.array([1.0, 3.0, 5.0], np.float32)
+        got = np.asarray(pp.cumulative_trapezoid(pp.to_tensor(y))._data)
+        np.testing.assert_allclose(got, [2.0, 6.0])
+
+    def test_histogram_bin_edges(self):
+        x = pp.to_tensor(np.array([0.0, 10.0], np.float32))
+        edges = np.asarray(pp.histogram_bin_edges(x, bins=5)._data)
+        np.testing.assert_allclose(edges, np.linspace(0, 10, 6))
+
+    def test_unpool_overlapping_windows_assign_not_sum(self):
+        x = np.array([[[[1.0, 5.0, 3.0]]]], np.float32)
+        out, mask = F.max_pool2d(pp.to_tensor(x), (1, 2), stride=(1, 1),
+                                 return_mask=True)
+        rec = np.asarray(F.max_unpool2d(out, mask, (1, 2), stride=(1, 1),
+                                        output_size=(1, 3))._data)
+        assert rec.max() == 5.0  # assign, not 10.0 from double-count
+
+    def test_zeropad2d_int(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        out = np.asarray(F.zeropad2d(pp.to_tensor(x), 2)._data)
+        assert out.shape == (1, 1, 6, 6)
+
+    def test_pairwise_distance_inf_norm(self):
+        x = np.array([[1.0, -4.0]], np.float32)
+        y = np.array([[0.0, 0.0]], np.float32)
+        got = np.asarray(F.pairwise_distance(
+            pp.to_tensor(x), pp.to_tensor(y), p=float("inf"))._data)
+        np.testing.assert_allclose(got, [4.0 + 1e-6], rtol=1e-5)
