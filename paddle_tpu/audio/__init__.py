@@ -1,6 +1,7 @@
 """paddle.audio parity: functional mel/window math + feature layers."""
 
+from paddle_tpu.audio import datasets  # noqa: F401
 from paddle_tpu.audio import features  # noqa: F401
 from paddle_tpu.audio import functional  # noqa: F401
 
-__all__ = ["features", "functional"]
+__all__ = ["datasets", "features", "functional"]
