@@ -500,17 +500,20 @@ class AutoCheckpoint:
                 from jax.experimental import multihost_utils
                 multihost_utils.sync_global_devices("ckpt_prev_complete")
         step_dir = self._step_dir(step)
-        if os.path.exists(step_dir):
-            # leftover from a crashed save at this step (possibly under a
-            # different sharding) — the async writer skips the stale-file
-            # purge, so guarantee its fresh-dir invariant here, on the
-            # main thread where a cross-process barrier is legal
-            import shutil
-            if jax.process_index() == 0:
-                shutil.rmtree(step_dir, ignore_errors=True)
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils
-                multihost_utils.sync_global_devices(f"ckpt_fresh:{step}")
+        # A crash-leftover dir at this step (possibly under a different
+        # sharding) must be purged before the async writer starts.  The
+        # purge+barrier sequence runs UNCONDITIONALLY: gating it on a
+        # per-process os.path.exists over shared storage is racy (process 0
+        # could rmtree and enter the barrier before a slower peer stats the
+        # dir, which then skips the barrier and strands process 0).  rmtree
+        # on a missing dir is a no-op, so the deterministic form costs one
+        # barrier per save and can never deadlock.
+        import shutil
+        if jax.process_index() == 0:
+            shutil.rmtree(step_dir, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_fresh:{step}")
         self._pending = async_save_state_dict(state_dict, step_dir)
         self._gc(step)
         return self._pending

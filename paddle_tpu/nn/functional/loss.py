@@ -510,7 +510,9 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
     """p-norm of x - y along the last axis (reference
     nn/functional/distance.py)."""
     import math
-    d = jnp.abs(x - y) + epsilon
+    # epsilon is added to the SIGNED difference before |.| (reference adds
+    # it to sub = x - y + eps), so negative components match bit-for-bit
+    d = jnp.abs((x - y) + epsilon)
     if isinstance(p, (int, float)) and math.isinf(p):
         out = jnp.max(d, axis=-1) if p > 0 else jnp.min(d, axis=-1)
     else:

@@ -508,6 +508,23 @@ def masked_scatter(x, mask, value):
     rank(k) = number of True positions before it)."""
     m = jnp.broadcast_to(mask, x.shape)
     vflat = jnp.ravel(value)
+    if (vflat.shape[0] < m.size
+            and not any(isinstance(a, jax.core.Tracer) for a in (m, vflat))):
+        # shape-only pre-check keeps the common value.size >= mask.size
+        # case free of a device->host sync; only possibly-deficient calls
+        # pay for materializing the count
+        need = int(m.sum())
+        if vflat.shape[0] < need:
+            raise ValueError(
+                f"masked_scatter: mask selects {need} elements but value "
+                f"supplies only {vflat.shape[0]} "
+                f"({need - vflat.shape[0]} short)")
+    if vflat.shape[0] == 0:
+        # a size-0 value is only legal with an all-False mask (checked
+        # above in eager); the gather below cannot index a 0-size array
+        return x
+    # under tracing mask.sum() is dynamic: clamp (duplicating the last
+    # element) rather than fail compilation — eager callers got the check
     order = jnp.cumsum(m.ravel().astype(jnp.int32)) - 1
     picked = vflat[jnp.clip(order, 0, vflat.shape[0] - 1)]
     return jnp.where(m, picked.reshape(x.shape), x)

@@ -77,7 +77,21 @@ class FasterTokenizer:
         self.vocab_size = int(self._lib.tok_id_count(self._h))
         self.cls_id = self.token_to_id(cls_token)
         self.sep_id = self.token_to_id(sep_token)
-        self.pad_id = max(self.token_to_id(pad_token), 0)
+        if self.cls_id < 0 or self.sep_id < 0:
+            import warnings
+            missing = [t for t, i in ((cls_token, self.cls_id),
+                                      (sep_token, self.sep_id)) if i < 0]
+            warnings.warn(
+                f"special token(s) {missing} not in the vocab; sequences "
+                "will be encoded WITHOUT [CLS]/[SEP] markers", stacklevel=2)
+        _pad = self.token_to_id(pad_token)
+        if _pad < 0:
+            import warnings
+            warnings.warn(
+                f"pad token {pad_token!r} is not in the vocab; padding "
+                "will use id 0, which is a REAL vocab token — pass the "
+                "correct pad_token for this vocab", stacklevel=2)
+        self.pad_id = max(_pad, 0)
 
     def token_to_id(self, token: str) -> int:
         return int(self._lib.tok_token_to_id(self._h, token.encode()))
