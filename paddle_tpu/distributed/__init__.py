@@ -32,7 +32,8 @@ from paddle_tpu.distributed.pipeline import (  # noqa: F401
     pipeline_1f1b, pipeline_interleaved, spmd_pipeline, stack_stage_params)
 from paddle_tpu.distributed.moe import (  # noqa: F401
     ExpertFFN, GShardGate, MoELayer, NaiveGate, SwitchGate,
-    moe_forward_a2a, moe_shard_a2a, top_k_gating)
+    moe_forward_a2a, moe_forward_index, moe_forward_ragged,
+    moe_shard_a2a, moe_shard_index_a2a, top_k_gating)
 from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
     make_ring_attention, make_ulysses_attention, ring_attention,
     ulysses_attention)

@@ -30,7 +30,8 @@ from paddle_tpu.distributed.mpu import constrain
 
 __all__ = ["top_k_gating", "NaiveGate", "SwitchGate", "GShardGate",
            "MoELayer", "ExpertFFN", "moe_shard_a2a", "moe_forward_a2a",
-           "top_k_gating_indices", "moe_forward_index"]
+           "top_k_gating_indices", "moe_forward_index",
+           "moe_shard_index_a2a", "moe_forward_ragged"]
 
 
 def top_k_gating(gate_logits, k: int, capacity: int,
@@ -62,6 +63,16 @@ def top_k_gating(gate_logits, k: int, capacity: int,
                           onehot * keep[..., None].astype(w.dtype),
                           cap_onehot) > 0
     return combine, dispatch, aux_loss
+
+
+def _gshard_aux(probs, topi, E: int, k: int):
+    """GShard load-balance loss: E * mean_e(frac_tokens_e * mean_prob_e)
+    (single home — shared by the capacity bookkeeping and the ragged
+    dropless path so the formula cannot drift)."""
+    onehot = jax.nn.one_hot(topi, E, dtype=probs.dtype)   # [T, k, E]
+    me = probs.mean(axis=0)
+    ce = (onehot.sum(1) > 0).astype(probs.dtype).mean(axis=0) / k
+    return (me * ce).sum() * E
 
 
 def top_k_gating_indices(gate_logits, k: int, capacity: int):
@@ -103,11 +114,7 @@ def top_k_gating_indices(gate_logits, k: int, capacity: int):
     w = topv * keep.astype(probs.dtype)
     denom = w.sum(axis=1, keepdims=True)
     w = jnp.where(denom > 0, w / jnp.maximum(denom, 1e-9), w)
-    # GShard load-balance loss: E * mean_e(frac_tokens_e * mean_prob_e)
-    me = probs.mean(axis=0)
-    ce = (onehot.sum(1) > 0).astype(probs.dtype).mean(axis=0) / k
-    aux_loss = (me * ce).sum() * E
-    return topi, slot, w, keep, aux_loss
+    return topi, slot, w, keep, _gshard_aux(probs, topi, E, k)
 
 
 def moe_forward_index(x2d, logits, experts_fn, *, E: int, top_k: int,
@@ -128,14 +135,48 @@ def moe_forward_index(x2d, logits, experts_fn, *, E: int, top_k: int,
                                topi.shape)
     tok_for = jnp.zeros((E, capacity), jnp.int32).at[safe_e, slot].set(
         tok_ids, mode="drop")
-    filled = jnp.zeros((E, capacity), x2d.dtype).at[safe_e, slot].set(
-        1.0, mode="drop")
-    expert_in = x2d[tok_for] * filled[..., None]          # [E, C, d]
+    # pad slots point at token 0 — harmless garbage: the combine gather
+    # reads only (topi, slot) pairs and dropped pairs carry w == 0, so
+    # no mask multiply (saves one [E, C, d] HBM pass)
+    expert_in = x2d[tok_for]                              # [E, C, d]
     expert_out = experts_fn(expert_in)                    # [E, C, d]
-    picked = expert_out[topi, slot]                       # [T, k, d]
+    picked = expert_out[topi, jnp.clip(slot, 0, capacity - 1)]  # [T, k, d]
     out = jnp.einsum("tkd,tk->td", picked, w.astype(x2d.dtype))
     dropped = 1.0 - keep.astype(jnp.float32).mean()
     return out, aux, dropped
+
+
+def moe_forward_ragged(x2d, logits, w1, b1, w2, b2, *, E: int, top_k: int,
+                       activation=None):
+    """Dropless sort + ``lax.ragged_dot`` expert dispatch (single-program).
+
+    The zero-padding path: the (T, k) assignments are flattened, argsorted
+    by expert id, and the expert GEMMs run as ONE grouped matmul over
+    exactly T*k rows (``lax.ragged_dot`` with per-expert group sizes) — no
+    [E, C] capacity buffers, no padding FLOPs, nothing dropped.  This is
+    the TPU-native analog of the reference's pure computation under
+    ``global_scatter``/``global_gather`` (global_scatter_op.cu.cc sends
+    exactly count rows; here the "send" is an in-chip gather).
+
+    Returns (out [T, d], aux_loss, dropped_frac=0.0).
+    """
+    act = activation or jax.nn.gelu
+    T, d = x2d.shape
+    k = min(top_k, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(probs, k)                  # [T, k]
+    w = topv / jnp.maximum(topv.sum(-1, keepdims=True), 1e-9)
+    flat_e = topi.reshape(-1)                             # [T*k] token-major
+    order = jnp.argsort(flat_e)                           # stable
+    tok = (order // k).astype(jnp.int32)                  # source token/row
+    xs = x2d[tok]                                         # [T*k, d]
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+    es = flat_e[order]                                    # sorted expert ids
+    h = jax.lax.ragged_dot(xs, w1, group_sizes) + b1[es]
+    ys = jax.lax.ragged_dot(act(h), w2, group_sizes) + b2[es]
+    wf = w.reshape(-1)[order].astype(x2d.dtype)
+    out = jnp.zeros((T, d), x2d.dtype).at[tok].add(ys * wf[:, None])
+    return out, _gshard_aux(probs, topi, E, k), jnp.zeros((), jnp.float32)
 
 
 class NaiveGate(Layer):
@@ -253,10 +294,57 @@ def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
     return out, jax.lax.pmean(aux, ep_axis), dropped_frac
 
 
+def moe_shard_index_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
+                        capacity: int, activation=None, ep_axis: str = "ep"):
+    """Index-dispatch all_to_all expert exchange — runs INSIDE shard_map.
+
+    The cross-rank ``global_scatter``/``global_gather`` analog (reference
+    operators/collective/global_scatter_op.cu.cc) built the TPU way: the
+    [E, C, d] send buffer is assembled with an O(T·k·d) scatter/gather
+    (cumsum slots front-pack each expert bucket, exactly the send layout
+    global_scatter produces) instead of the O(T·E·C·d) one-hot contraction
+    of :func:`moe_shard_a2a`; the exchange itself stays the deterministic
+    tiled all_to_all so shapes are static for XLA.  A true
+    ``lax.ragged_all_to_all`` (variable counts, zero padding on the wire)
+    is the natural next step but has no XLA:CPU lowering, which would
+    leave the path untestable off-chip — capacity buckets bound the wire
+    overhead at (capacity_factor - 1) instead.
+
+    Same contract as :func:`moe_shard_a2a`: local x2d [T_loc, d],
+    replicated gate_w [d, E], LOCAL expert slices [E_loc, ...]; returns
+    (out [T_loc, d], aux, dropped_frac).
+    """
+    act = activation or jax.nn.gelu
+    logits = x2d @ gate_w                                     # [T_loc, E]
+    T = x2d.shape[0]
+    E = gate_w.shape[-1]
+    topi, slot, w, keep, aux = top_k_gating_indices(logits, k=top_k,
+                                                    capacity=capacity)
+    dropped_frac = jax.lax.pmean(
+        1.0 - keep.astype(jnp.float32).mean(), ep_axis)
+    safe_e = jnp.where(keep, topi, E)        # OOB row -> dropped by scatter
+    tok_ids = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[:, None],
+                               topi.shape)
+    tok_for = jnp.zeros((E, capacity), jnp.int32).at[safe_e, slot].set(
+        tok_ids, mode="drop")
+    # pad slots point at token 0 — harmless garbage: the combine gather
+    # below reads only (topi, slot) pairs, and dropped pairs carry w == 0,
+    # so no `filled` mask multiply (saves one [E, C, d] HBM pass)
+    buf = x2d[tok_for]                                        # [E, C, d]
+    recv = jax.lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
+                              tiled=True)                     # [E_loc, n*C, d]
+    out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
+    back = jax.lax.all_to_all(out_loc, ep_axis, split_axis=1, concat_axis=0,
+                              tiled=True)                     # [E, C, d]
+    picked = back[topi, jnp.clip(slot, 0, capacity - 1)]      # [T, k, d]
+    out = jnp.einsum("tkd,tk->td", picked, w.astype(x2d.dtype))
+    return out, jax.lax.pmean(aux, ep_axis), dropped_frac
+
+
 def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
                     capacity_factor: float = 1.25, dropless: bool = False,
                     activation=None, ep_axis: str = "ep",
-                    with_stats: bool = False):
+                    with_stats: bool = False, dispatch: str = "einsum"):
     """Jit-callable wrapper: shard_maps :func:`moe_shard_a2a` over the ep
     axis of ``mesh``.
 
@@ -265,10 +353,14 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
     [E, ...] sharded on ep (E divisible by ep size); gate replicated.
     ``with_stats=True`` additionally returns the dropped-assignment
     fraction (always 0.0 under dropless) so capacity pressure is never
-    silent."""
+    silent.  ``dispatch`` picks the shard body: "einsum" (one-hot
+    contraction, :func:`moe_shard_a2a`) or "index" (O(T·k·d)
+    scatter/gather build, :func:`moe_shard_index_a2a`)."""
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if dispatch not in ("einsum", "index"):
+        raise ValueError(f"unknown a2a dispatch {dispatch!r}")
     shape = x.shape
     d = shape[-1]
     x2d = x.reshape(-1, d)  # shard the flat token axis, not the batch axis
@@ -285,10 +377,12 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
     else:
         capacity = max(1, int(capacity_factor * top_k * t_loc / E))
 
+    body = moe_shard_a2a if dispatch == "einsum" else moe_shard_index_a2a
+
     def fn(xs, gw, a1, c1, a2, c2):
-        return moe_shard_a2a(xs, gw, a1, c1, a2, c2, top_k=top_k,
-                             capacity=capacity, activation=activation,
-                             ep_axis=ep_axis)
+        return body(xs, gw, a1, c1, a2, c2, top_k=top_k,
+                    capacity=capacity, activation=activation,
+                    ep_axis=ep_axis)
 
     mapped = shard_map(
         fn, mesh=mesh,
@@ -317,10 +411,12 @@ class MoELayer(Layer):
                  dispatch_mode: str = "einsum", dropless: bool = False,
                  mesh=None):
         super().__init__()
-        if dispatch_mode not in ("einsum", "all_to_all", "index"):
+        if dispatch_mode not in ("einsum", "all_to_all", "index", "ragged",
+                                 "all_to_all_index"):
             raise ValueError(f"unknown dispatch_mode {dispatch_mode}")
-        if dispatch_mode == "all_to_all" and mesh is None:
-            raise ValueError("dispatch_mode='all_to_all' needs mesh=")
+        if dispatch_mode in ("all_to_all", "all_to_all_index") \
+                and mesh is None:
+            raise ValueError(f"dispatch_mode={dispatch_mode!r} needs mesh=")
         self.d_model = d_model
         self.num_experts = num_experts
         self.capacity_factor = capacity_factor
@@ -355,7 +451,7 @@ class MoELayer(Layer):
         B, S, d = data.shape
         T = B * S
 
-        if self.dispatch_mode == "all_to_all":
+        if self.dispatch_mode in ("all_to_all", "all_to_all_index"):
             if not isinstance(self.experts, ExpertFFN):
                 raise ValueError("all_to_all dispatch requires the stacked "
                                  "ExpertFFN experts")
@@ -367,7 +463,9 @@ class MoELayer(Layer):
                 capacity_factor=self.capacity_factor,
                 dropless=self.dropless, ep_axis=self.ep_axis,
                 activation=lambda v: unwrap(self.experts.activation(v)),
-                with_stats=True)
+                with_stats=True,
+                dispatch=("index" if self.dispatch_mode == "all_to_all_index"
+                          else "einsum"))
             self.aux_loss = aux
             self.router_stats = {"dropped_frac": dropped}
             return self._wrap_out(x, out)
@@ -386,6 +484,20 @@ class MoELayer(Layer):
             capacity = max(1, int(self.capacity_factor * self.gate.top_k
                                   * T / E))
         logits = unwrap(self.gate.logits(x2d))
+        if self.dispatch_mode == "ragged":
+            # dropless sort + grouped-matmul dispatch: no capacity buffers,
+            # FLOPs over exactly T*k rows; the single-program fast path
+            if not isinstance(self.experts, ExpertFFN):
+                raise ValueError("ragged dispatch requires the stacked "
+                                 "ExpertFFN experts")
+            out, aux, dropped = moe_forward_ragged(
+                x2d, logits, unwrap(self.experts.w1),
+                unwrap(self.experts.b1), unwrap(self.experts.w2),
+                unwrap(self.experts.b2), E=E, top_k=self.gate.top_k,
+                activation=lambda v: unwrap(self.experts.activation(v)))
+            self.aux_loss = aux
+            self.router_stats = {"dropped_frac": dropped}
+            return self._wrap_out(x, out.reshape(B, S, d))
         if self.dispatch_mode == "index":
             # gather/scatter dispatch: O(T·k·d) — the single-program fast
             # path (under ep sharding keep "einsum": GSPMD lowers that

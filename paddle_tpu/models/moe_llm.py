@@ -49,8 +49,13 @@ class MoEConfig:
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
     # "einsum" (GSPMD lowers to a2a under ep sharding), "index"
-    # (gather/scatter fast path for single-program / dp-only runs)
+    # (gather/scatter fast path for single-program / dp-only runs),
+    # "ragged" (dropless sort + lax.ragged_dot grouped matmul, zero
+    # padding — single-program), "all_to_all"/"all_to_all_index"
+    # (explicit shard_map exchange over mesh's ep axis; _index builds the
+    # send buffers with the O(T·k·d) scatter instead of the one-hot einsum)
     dispatch_mode: str = "einsum"
+    mesh: object = None                  # required by the all_to_all modes
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -134,7 +139,7 @@ class MoEDecoderLayer(Layer):
                 d_hidden=config.moe_intermediate_size,
                 gate="naive", top_k=config.num_experts_per_tok,
                 capacity_factor=config.capacity_factor,
-                dispatch_mode=config.dispatch_mode)
+                dispatch_mode=config.dispatch_mode, mesh=config.mesh)
 
     def forward(self, x, rope_cos, rope_sin):
         x = x + self.self_attn(self.input_layernorm(x), rope_cos, rope_sin)

@@ -319,6 +319,104 @@ class TestMoELayer:
         expert_g = next(v for k, v in g.items() if k.endswith("w1"))
         assert float(jnp.abs(expert_g).sum()) > 0
 
+    def test_a2a_index_matches_einsum_body(self):
+        """Index-dispatch shard body == one-hot einsum shard body over the
+        8-way ep mesh, with AND without capacity drops (both bodies share
+        the top_k_gating_indices bookkeeping, so their drop sets are
+        identical)."""
+        pp.seed(7)
+        d, E = 8, 8
+        B, S = 4, 16
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=16)
+        x = pp.randn([B, S, d])
+        from paddle_tpu.core.dispatch import unwrap
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("ep",))
+        args = (x._data, unwrap(moe.gate.gate), unwrap(moe.experts.w1),
+                unwrap(moe.experts.b1), unwrap(moe.experts.w2),
+                unwrap(moe.experts.b2))
+        act = lambda v: unwrap(moe.experts.activation(v))
+        for kw in (dict(dropless=True),
+                   dict(dropless=False, capacity_factor=0.5)):
+            ein, aux_e, drop_e = dist.moe_forward_a2a(
+                *args, mesh=mesh, top_k=2, activation=act,
+                with_stats=True, dispatch="einsum", **kw)
+            idx, aux_i, drop_i = dist.moe_forward_a2a(
+                *args, mesh=mesh, top_k=2, activation=act,
+                with_stats=True, dispatch="index", **kw)
+            np.testing.assert_allclose(np.asarray(idx), np.asarray(ein),
+                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(float(aux_i), float(aux_e), rtol=1e-5)
+            np.testing.assert_allclose(float(drop_i), float(drop_e),
+                                       atol=1e-6)
+            if not kw.get("dropless"):
+                assert float(drop_i) > 0  # the capacity bound actually bit
+
+    def test_a2a_index_layer_mode_and_grads(self):
+        """MoELayer(dispatch_mode='all_to_all_index') trains on the ep
+        mesh: grads reach router and experts."""
+        pp.seed(8)
+        d, E = 4, 8
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("ep",))
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=8,
+                            dispatch_mode="all_to_all_index", mesh=mesh,
+                            dropless=True)
+        from paddle_tpu.core.functional import functional_call, params_of
+        params = params_of(moe)
+
+        def loss(ps, xd):
+            out = functional_call(moe, ps, pp.Tensor(xd))
+            return (out._data ** 2).sum()
+
+        x = np.random.default_rng(0).normal(size=(2, 8, d)).astype("float32")
+        val, g = jax.value_and_grad(loss)(params, jnp.asarray(x))
+        assert np.isfinite(float(val))
+        assert float(jnp.abs(next(v for k, v in g.items()
+                                  if "gate" in k)).sum()) > 0
+        assert float(jnp.abs(next(v for k, v in g.items()
+                                  if k.endswith("w1"))).sum()) > 0
+
+    def test_ragged_matches_einsum_dropless(self):
+        """Sort + ragged_dot dropless dispatch == dense einsum dispatch
+        with dropless capacity (same weights, same tokens)."""
+        pp.seed(9)
+        d, E = 8, 4
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=16,
+                            dropless=True)
+        x = pp.randn([2, 16, d])
+        serial = moe(x).numpy()
+        aux_serial = float(moe.aux_loss)
+
+        from paddle_tpu.core.dispatch import unwrap
+        x2d = unwrap(x).reshape(-1, d)
+        logits = x2d @ unwrap(moe.gate.gate)
+        out, aux, dropped = dist.moe_forward_ragged(
+            x2d, logits, unwrap(moe.experts.w1), unwrap(moe.experts.b1),
+            unwrap(moe.experts.w2), unwrap(moe.experts.b2), E=E, top_k=2,
+            activation=lambda v: unwrap(moe.experts.activation(v)))
+        np.testing.assert_allclose(np.asarray(out).reshape(2, 16, d),
+                                   serial, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(float(aux), aux_serial, rtol=1e-5)
+        assert float(dropped) == 0.0
+
+    def test_ragged_layer_mode_and_grads(self):
+        """MoELayer(dispatch_mode='ragged') under jit: grads reach router
+        and experts (ragged_dot + scatter-add transposes)."""
+        pp.seed(10)
+        moe = dist.MoELayer(d_model=4, num_experts=4, d_hidden=8,
+                            dispatch_mode="ragged")
+        from paddle_tpu.core.functional import functional_call, params_of
+        params = params_of(moe)
+
+        def loss(ps, xd):
+            out = functional_call(moe, ps, pp.Tensor(xd))
+            return (out._data ** 2).sum()
+
+        x = np.random.default_rng(1).normal(size=(2, 8, 4)).astype("float32")
+        val, g = jax.value_and_grad(jax.jit(loss))(params, jnp.asarray(x))
+        assert np.isfinite(float(val))
+        assert float(jnp.abs(g["gate.gate"]).sum()) > 0
+        assert float(jnp.abs(g["experts.w1"]).sum()) > 0
+
     def test_grads_flow_through_router_in_jit(self):
         pp.seed(3)
         moe = dist.MoELayer(d_model=4, num_experts=2, d_hidden=8,
