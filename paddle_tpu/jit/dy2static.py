@@ -10,12 +10,24 @@ when it is traced (inside jit), so ONE source serves both modes.
 
 Supported: If / While / for-over-range including tuple/aug assignments,
 ``break`` / ``continue`` inside converted loops (rewritten to guarded
-flags — reference break_continue_transformer.py), and early ``return``
+flags — reference break_continue_transformer.py), early ``return``
 anywhere (rewritten to a flag + return-value slot — reference
-return_transformer.py).  Genuinely dynamic structure (data-dependent
-shapes, `return` of differently-typed values per branch, iteration over
-traced non-range iterables) still raises a clear error at trace time,
-like the reference's transformer diagnostics.
+return_transformer.py), and container state inside compound statements
+(reference list_transformer.py / dict assignment handling):
+``lst.append(x)`` and ``d[k] = v`` / ``d[k] += v`` are rewritten to
+functional re-assignments (``lst = lst + [x]``, ``d = {**d, k: v}``) so
+the container rides the carry/branch tuples like any other local.  A
+loop with a concrete trip count that grows a list therefore UNROLLS
+under trace (each iteration changes the carry's pytree structure, which
+``lax.while_loop`` cannot carry — same restriction the reference works
+around with LoDTensorArray); a loop whose continuation is TRACED may
+not grow containers and says so.  Caveat shared with the reference's
+transformers: the functional rewrite breaks aliasing — mutations are
+visible through the rewritten NAME, not through other references to the
+same container.  Genuinely dynamic structure (data-dependent shapes,
+`return` of differently-typed values per branch, iteration over traced
+non-range iterables) still raises a clear error at trace time, like the
+reference's transformer diagnostics.
 """
 
 from __future__ import annotations
@@ -133,7 +145,9 @@ def cond_call(pred, true_fn, false_fn, operands, needed):
         raise TypeError(
             "dy2static: the branches of a TRACED `if` must bind the same "
             "variables with matching shapes/dtypes (early returns under a "
-            "traced condition must be type-stable across paths)") from e
+            "traced condition must be type-stable across paths; branches "
+            "must add the same dict keys / append the same number of list "
+            "elements)") from e
 
 
 def bool_not(x):
@@ -163,6 +177,45 @@ def bool_or(a, b):
     return ar or br
 
 
+def list_append(x, y):
+    """Functional ``x.append(y)`` — the rewrite target for appends inside
+    converted compound statements.  Lists/tuples get a NEW container (so
+    the name can ride a carry/branch tuple); anything else with a real
+    .append (e.g. a TensorArray) keeps its own mutating semantics."""
+    if isinstance(x, list):
+        return x + [y]
+    if isinstance(x, tuple):
+        return x + (y,)
+    if x is UNDEF:
+        raise TypeError(
+            "dy2static: .append() on a variable with no prior value in "
+            "this path; initialise the list before the loop/branch")
+    x.append(y)
+    return x
+
+
+def container_setitem(x, k, v):
+    """Functional ``x[k] = v`` — dicts/lists become new containers;
+    tensors/arrays go through their own setitem (Tensor mutates in place,
+    raw jax arrays use the functional .at update)."""
+    if isinstance(x, dict):
+        out = dict(x)
+        out[k] = v
+        return out
+    if isinstance(x, list):
+        out = list(x)
+        out[k] = v
+        return out
+    if x is UNDEF:
+        raise TypeError(
+            "dy2static: item assignment on a variable with no prior value "
+            "in this path; initialise the container before the loop/branch")
+    if hasattr(x, "__setitem__"):
+        x[k] = v
+        return x
+    return x.at[k].set(v)  # immutable jax array
+
+
 def range_cont(i, stop, step):
     """Continuation test for a rewritten for-range: sign-aware."""
     import jax.numpy as jnp
@@ -183,13 +236,14 @@ def while_call(cond_fn, body_fn, carry, seedable=None):
     promoted the same way."""
     first = cond_fn(carry)
     raw = first._data if hasattr(first, "_data") else first
-    if not _is_traced(raw) and not any(
-            _is_traced(v._data if hasattr(v, "_data") else v)
-            for v in jax.tree.leaves(carry)):
-        # python path while everything is concrete; a traced `if` inside
+    if not _is_traced(raw):
+        # python path while the test stays concrete; a traced `if` inside
         # the body (e.g. an early return on traced data) can inject
         # tracers into the carry mid-loop — hand the REMAINING iterations
-        # to lax.while_loop then instead of crashing on bool(tracer)
+        # to lax.while_loop then instead of crashing on bool(tracer).
+        # Exception: a body that GROWS the carry's pytree structure
+        # (functionalized list.append, new dict keys) must keep
+        # unrolling — lax.while_loop cannot carry a changing structure
         while True:
             c = cond_fn(carry)
             craw = c._data if hasattr(c, "_data") else c
@@ -197,9 +251,14 @@ def while_call(cond_fn, body_fn, carry, seedable=None):
                 break
             if not bool(craw):
                 return carry
-            carry = body_fn(carry)
-            if any(_is_traced(v._data if hasattr(v, "_data") else v)
-                   for v in jax.tree.leaves(carry)):
+            new = body_fn(carry)
+            grew = (jax.tree.structure(new, is_leaf=lambda v: v is UNDEF)
+                    != jax.tree.structure(carry,
+                                          is_leaf=lambda v: v is UNDEF))
+            carry = new
+            if not grew and any(
+                    _is_traced(v._data if hasattr(v, "_data") else v)
+                    for v in jax.tree.leaves(carry)):
                 break
 
     if seedable is None:
@@ -230,7 +289,19 @@ def while_call(cond_fn, body_fn, carry, seedable=None):
         out = cond_fn(c)
         return out._data if hasattr(out, "_data") else out
 
-    return jax.lax.while_loop(cond_raw, body_fn, carry)
+    try:
+        return jax.lax.while_loop(cond_raw, body_fn, carry)
+    except TypeError as e:
+        if "structure" in str(e) or "pytree" in str(e):
+            raise TypeError(
+                "dy2static: the body of a loop with a TRACED continuation "
+                "changes the carried pytree structure (list.append / new "
+                "dict keys per iteration). lax.while_loop cannot grow its "
+                "carry; make the trip count concrete (the loop then "
+                "unrolls) or preallocate a fixed-size buffer "
+                "(jnp.zeros + index update, or TensorArray under lax.scan)"
+            ) from e
+        raise
 
 
 # ------------------------------------------------------------ the rewrite
@@ -507,6 +578,95 @@ def _guard_tail(stmts, flag_names):
                 out.append(ast.If(test=_not(cond), body=rest, orelse=[]))
             return out
     return out
+
+
+class _ContainerRewriter(ast.NodeTransformer):
+    """Functionalize container mutation INSIDE compound statements
+    (reference list_transformer.py / the dict-assignment handling in
+    basic_api_transformer.py): ``x.append(v)`` →
+    ``x = __jst_list_append(x, v)``; ``x[k] = v`` →
+    ``x = __jst_setitem(x, k, v)``; ``x[k] op= v`` →
+    ``x = __jst_setitem(x, k, x[k] op v)``.  Top-level statements keep
+    true Python mutation semantics (they never ride a carry), which also
+    bounds the aliasing caveat to converted control flow.  Slice stores
+    (``x[a:b] = v``) are left alone."""
+
+    def __init__(self):
+        self._depth = 0
+        self._key_uid = 0
+
+    def _compound(self, node):
+        self._depth += 1
+        self.generic_visit(node)
+        self._depth -= 1
+        return node
+
+    visit_If = visit_While = visit_For = _compound
+
+    def visit_FunctionDef(self, node):
+        return node  # nested defs own their scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Expr(self, node):
+        c = node.value
+        if (self._depth and isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "append"
+                and isinstance(c.func.value, ast.Name)
+                and len(c.args) == 1 and not c.keywords):
+            n = c.func.value.id
+            return ast.copy_location(
+                _assign(n, _call("__jst_list_append", _name(n), c.args[0])),
+                node)
+        return node
+
+    def visit_Assign(self, node):
+        if (self._depth and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].value, ast.Name)
+                and not isinstance(node.targets[0].slice, ast.Slice)):
+            t = node.targets[0]
+            return ast.copy_location(
+                _assign(t.value.id,
+                        _call("__jst_setitem", _name(t.value.id), t.slice,
+                              node.value)), node)
+        return node
+
+    def visit_AugAssign(self, node):
+        if (self._depth and isinstance(node.target, ast.Subscript)
+                and isinstance(node.target.value, ast.Name)
+                and not isinstance(node.target.slice, ast.Slice)):
+            t = node.target
+            n = t.value.id
+            # python evaluates the subscript of an augmented assignment
+            # ONCE — `d[next(it)] += 1` must not consume two iterator
+            # elements.  Constants and bare names are re-evaluation-safe
+            # (and binding them to a temp would push a possibly-str key
+            # into the loop carry, which lax.while_loop rejects); any
+            # other key expression is bound to a temp first.
+            if isinstance(t.slice, (ast.Constant, ast.Name)):
+                import copy as _copy
+                key_load = _copy.deepcopy(t.slice)
+                key_store = t.slice
+                bind = []
+            else:
+                self._key_uid += 1
+                key = f"__jst_key_{self._key_uid}"
+                bind = [ast.copy_location(_assign(key, t.slice), node)]
+                key_load = _name(key)
+                key_store = _name(key)
+            load = ast.Subscript(value=_name(n), slice=key_load,
+                                 ctx=ast.Load())
+            newv = ast.BinOp(left=load, op=node.op, right=node.value)
+            setit = ast.copy_location(
+                _assign(n, _call("__jst_setitem", _name(n), key_store,
+                                 newv)), node)
+            return bind + [setit]
+        return node
 
 
 class _ReturnRewriter(ast.NodeTransformer):
@@ -818,6 +978,13 @@ def convert_to_static(fn):
     # (reference return_transformer.py) BEFORE control-flow conversion, so
     # the introduced guards convert like user ifs
     _rewrite_returns(fdef)
+    # container mutations inside compounds become functional re-assigns
+    # BEFORE control-flow conversion, so containers join branch/loop
+    # carries like any assigned name (applied to the BODY — the
+    # transformer's visit_FunctionDef guard is for nested defs)
+    _crw = _ContainerRewriter()
+    fdef.body = [_crw.visit(st) for st in fdef.body]
+    ast.fix_missing_locations(fdef)
     new = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new)
 
@@ -830,6 +997,8 @@ def convert_to_static(fn):
     glb["__jst_not"] = bool_not
     glb["__jst_and"] = bool_and
     glb["__jst_or"] = bool_or
+    glb["__jst_list_append"] = list_append
+    glb["__jst_setitem"] = container_setitem
     # snapshot closure cells (the recompiled fn has no closure)
     if fn.__closure__:
         for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
