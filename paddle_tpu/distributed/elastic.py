@@ -12,15 +12,22 @@ relaunch + resume: workers heartbeat into the native TCPStore
 any failure it kills the generation, bumps the generation counter, and
 relaunches; workers resume from the latest AutoCheckpoint step.
 
-Scope decision (recorded, VERDICT r3 Weak #5): the manager orchestrates
-ONE node.  Multi-host TPU jobs are gang-scheduled by the cluster manager
-(GKE/Borg/Ray), which already detects node loss and reschedules the whole
-slice — re-implementing the reference's etcd-lease multi-node
-ElasticManager (fleet/elastic/manager.py:124,252-299) would duplicate the
-platform layer TPU deployments always run under.  Run one elastic
-launcher per host under the cluster manager; cross-host resume
-consistency comes from AutoCheckpoint's validated per-shard checkpoints
-(every process restores the same validator-approved step).
+Two tiers:
+
+* :class:`ElasticManager` — single node: spawn + watch + relaunch.
+* :class:`MultiNodeElasticAgent` — the reference's etcd-lease multi-node
+  ElasticManager (fleet/elastic/manager.py:124,252-299) rebuilt over the
+  native TCPStore (csrc/store), which plays the etcd role: an atomic
+  ``elastic/gen`` counter is the epoch, per-generation registration
+  counters + member manifests are the lease registry, and periodic
+  ``nodehb`` keys are the TTL heartbeats.  Node death/scale-up both
+  resolve to "bump the generation": every agent kills its local workers,
+  re-registers, recomputes ranks from the new member manifest, and
+  relaunches; workers resume from the latest validated AutoCheckpoint
+  step (the per-shard format reshards across changed world sizes).
+  Store availability is the etcd-availability analog: run the hosting
+  process somewhere stable (or behind a VIP), exactly as the reference
+  assumes a live etcd.
 """
 
 from __future__ import annotations
@@ -35,7 +42,8 @@ from typing import Dict, List, Optional, Sequence
 
 from paddle_tpu.distributed.tcp_store import TCPStore
 
-__all__ = ["ElasticAgent", "ElasticManager", "free_port"]
+__all__ = ["ElasticAgent", "ElasticManager", "MultiNodeElasticAgent",
+           "free_port"]
 
 
 def free_port() -> int:
@@ -239,3 +247,332 @@ class ElasticManager:
 
     def close(self):
         self._store.close()
+
+
+class MultiNodeElasticAgent:
+    """Multi-node elastic orchestration over the shared TCPStore.
+
+    Reference parity: ``ElasticManager`` + its etcd watcher
+    (fleet/elastic/manager.py:124 registration with TTL leases,
+    :252-299 scale/death watch + relaunch with recomputed ranks).
+
+    One agent runs per node.  Protocol, all on the shared store:
+
+    1. **Epoch**: ``elastic/gen`` (atomic counter) names the current
+       generation ``g``.  Any agent observing a failure (or joining late)
+       bumps it; every agent polls it and treats a bump as "kill local
+       workers, re-rendezvous".
+    2. **Rendezvous** (per ``g``): registrants take an index from the
+       ``elastic/nreg/<g>`` counter and publish a payload under
+       ``elastic/member/<g>/<idx>``.  Registrant 0 is the leader: it
+       waits until the count covers ``min_nodes`` and is either at
+       ``max_nodes`` or stable for ``rendezvous_window`` seconds, then
+       publishes the ``elastic/members/<g>`` manifest.  Node rank =
+       manifest index; global worker ranks are the prefix sums of each
+       node's ``nproc``.  A registrant excluded from the manifest (it
+       arrived after finalization) bumps the generation — that IS the
+       scale-up path.
+    3. **Leases**: each agent refreshes ``elastic/nodehb/<g>/<rank>``;
+       a peer stale past ``heartbeat_timeout`` fails the generation.
+       Local worker process exits / stale worker heartbeats (the
+       single-node watcher's checks) fail it too.
+    4. **Completion**: a node whose workers all exit 0 increments
+       ``elastic/ndone/<g>`` and waits for it to reach the member count.
+
+    Workers resume from :class:`~paddle_tpu.distributed.checkpoint.
+    AutoCheckpoint` — its per-shard format restores under a different
+    process count, so scale-down resumes are exact, not best-effort.
+    """
+
+    _RESTART = object()
+
+    def __init__(self, cmd: Sequence[str], *, store_addr: str,
+                 host_store: bool = False, nproc: int = 1,
+                 min_nodes: int = 1, max_nodes: Optional[int] = None,
+                 max_restarts: int = 3, heartbeat_timeout: float = 10.0,
+                 rendezvous_window: float = 2.0,
+                 rendezvous_timeout: float = 120.0,
+                 node_host: str = "127.0.0.1",
+                 poll_interval: float = 0.2,
+                 env: Optional[Dict[str, str]] = None,
+                 log_dir: Optional[str] = None,
+                 node_id: Optional[str] = None):
+        self.cmd = list(cmd)
+        self.nproc = nproc
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes
+        self.max_restarts = max_restarts
+        self.heartbeat_timeout = heartbeat_timeout
+        self.rendezvous_window = rendezvous_window
+        self.rendezvous_timeout = rendezvous_timeout
+        self.node_host = node_host
+        self.poll_interval = poll_interval
+        self.extra_env = dict(env or {})
+        self.log_dir = log_dir
+        self.node_id = node_id or f"{socket.gethostname()}:{os.getpid()}"
+        host, port = store_addr.rsplit(":", 1)
+        self.store_addr = store_addr
+        if host_store:
+            self._store = TCPStore(host, int(port), is_master=True)
+        else:
+            # the hosting agent may still be starting up — retry the
+            # connect (the etcd client's dial-retry analog)
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    self._store = TCPStore(host, int(port), is_master=False)
+                    break
+                except RuntimeError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.3)
+        self._log_files: List = []
+
+    # -- store helpers -------------------------------------------------------
+    def _gen_now(self) -> int:
+        return self._store.add("elastic/gen", 0)
+
+    def _bump(self, g: int, reason: str = "fail"):
+        """Fail generation g exactly once per observer: benign if two
+        agents race (both saw g; the counter moves past g either way and
+        every agent re-reads the CURRENT value at re-rendezvous).  The
+        recorded reason lets survivors keep scale-up rescales off the
+        failure budget."""
+        if self._gen_now() == g:
+            self._store.set(f"elastic/why/{g}", reason)
+            self._store.add("elastic/gen", 1)
+
+    def _bump_reason(self, g: int) -> str:
+        try:
+            return self._store.get(f"elastic/why/{g}",
+                                   wait=False).decode()
+        except Exception:
+            return "fail"
+
+    # -- rendezvous ----------------------------------------------------------
+    def _rendezvous(self, g: int):
+        """Returns (node_rank, members, timed_out): (None, None, False)
+        when generation g was abandoned benignly (bump observed / this
+        node excluded), (None, None, True) when the rendezvous DEADLINE
+        forced the abandonment — the caller counts consecutive timeouts
+        so permanent peer loss terminates instead of spinning forever."""
+        import json
+        deadline = time.monotonic() + self.rendezvous_timeout
+        idx = self._store.add(f"elastic/nreg/{g}", 1) - 1
+        payload = {"node": self.node_id, "host": self.node_host,
+                   "port": free_port(), "nproc": self.nproc}
+        self._store.set(f"elastic/member/{g}/{idx}", json.dumps(payload))
+        if idx == 0:
+            last_c, last_t = 1, time.monotonic()
+            while True:
+                c = self._store.add(f"elastic/nreg/{g}", 0)
+                if c != last_c:
+                    last_c, last_t = c, time.monotonic()
+                if c >= self.min_nodes and (
+                        (self.max_nodes and c >= self.max_nodes)
+                        or time.monotonic() - last_t
+                        >= self.rendezvous_window):
+                    break
+                if self._gen_now() != g:
+                    return None, None, False
+                if time.monotonic() > deadline:
+                    # not enough peers arrived: abandon g so every waiter
+                    # (including us) retries a fresh generation
+                    self._bump(g, "rendezvous")
+                    return None, None, True
+                time.sleep(0.05)
+            members = []
+            for i in range(last_c):
+                # a registrant may have taken an index and died before
+                # publishing its payload — poll without blocking, bounded
+                # by the rendezvous deadline, then abandon the generation
+                while not self._store.check(f"elastic/member/{g}/{i}"):
+                    if time.monotonic() > deadline:
+                        self._bump(g, "rendezvous")
+                        return None, None, True
+                    time.sleep(0.05)
+                members.append(json.loads(self._store.get(
+                    f"elastic/member/{g}/{i}").decode()))
+            self._store.set(f"elastic/members/{g}", json.dumps(members))
+        else:
+            while not self._store.check(f"elastic/members/{g}"):
+                if self._gen_now() != g:
+                    return None, None, False
+                if time.monotonic() > deadline:
+                    self._bump(g, "rendezvous")
+                    return None, None, True
+                time.sleep(0.05)
+            members = json.loads(self._store.get(
+                f"elastic/members/{g}").decode())
+        mine = [i for i, m in enumerate(members)
+                if m["node"] == self.node_id]
+        if not mine:
+            # registered after finalization: force a rescale that
+            # includes us (the reference's scale-up watch)
+            self._bump(g, "scale")
+            return None, None, False
+        return mine[0], members, False
+
+    # -- generation ----------------------------------------------------------
+    def _spawn(self, g: int, node_rank: int, members) -> List:
+        total = sum(m["nproc"] for m in members)
+        base = sum(m["nproc"] for m in members[:node_rank])
+        master = f"{members[0]['host']}:{members[0]['port']}"
+        procs = []
+        self._log_files = []
+        for local_rank in range(self.nproc):
+            rank = base + local_rank
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update({
+                "PADDLE_MASTER": master,
+                "COORDINATOR_ADDRESS": master,
+                "PADDLE_TRAINERS_NUM": str(total),
+                "NUM_PROCESSES": str(total),
+                "PADDLE_TRAINER_ID": str(rank),
+                "PROCESS_ID": str(rank),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_NODE_RANK": str(node_rank),
+                "PADDLE_ELASTIC_STORE": self.store_addr,
+                "PADDLE_ELASTIC_GEN": str(g),
+            })
+            stdout = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                stdout = open(os.path.join(
+                    self.log_dir, f"workerlog.g{g}.n{node_rank}.{rank}"),
+                    "w")
+                self._log_files.append(stdout)
+            procs.append(subprocess.Popen(
+                self.cmd, env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+        return procs
+
+    def _run_generation(self, g: int, node_rank: int, members):
+        """0 on global success, _RESTART to re-rendezvous."""
+        n_nodes = len(members)
+        base = sum(m["nproc"] for m in members[:node_rank])
+        started = time.monotonic()
+        peer_seen: Dict[int, tuple] = {}   # rank -> (last bytes, seen at)
+        done_marked = False
+        procs = self._spawn(g, node_rank, members)
+        try:
+            while True:
+                now = time.monotonic()
+                self._store.set(f"elastic/nodehb/{g}/{node_rank}",
+                                repr(time.time()).encode())
+                if self._gen_now() != g:
+                    return self._RESTART
+                # local worker exits
+                codes = [p.poll() for p in procs]
+                if any(rc not in (None, 0) for rc in codes):
+                    # fast death with no heartbeat ever = infrastructure
+                    # (the free_port TOCTOU class) — same classification
+                    # as ElasticManager.run(); recorded so peers don't
+                    # charge their restart budget either
+                    fast = (now - started
+                            < min(self.heartbeat_timeout, 10.0))
+                    any_hb = any(
+                        self._store.check(f"hb/{g}/{base + lr}")
+                        for lr in range(self.nproc))
+                    self._bump(g, "infra" if fast and not any_hb
+                               else "fail")
+                    return self._RESTART
+                # local worker heartbeat staleness (only once seen)
+                for lr in range(self.nproc):
+                    if codes[lr] is not None:
+                        continue
+                    key = f"hb/{g}/{base + lr}"
+                    if self._store.check(key):
+                        last = float(self._store.get(key,
+                                                     wait=False).decode())
+                        if time.time() - last > self.heartbeat_timeout:
+                            self._bump(g)
+                            return self._RESTART
+                # peer node leases — staleness is judged by when WE last
+                # observed the value CHANGE (local monotonic clock), never
+                # by comparing the peer's embedded wall-clock to ours:
+                # cross-host clock skew must not fail healthy generations
+                for r in range(n_nodes):
+                    if r == node_rank:
+                        continue
+                    key = f"elastic/nodehb/{g}/{r}"
+                    if self._store.check(key):
+                        val = self._store.get(key, wait=False)
+                        prev = peer_seen.get(r)
+                        if prev is None or prev[0] != val:
+                            peer_seen[r] = (val, now)
+                    entry = peer_seen.get(r)
+                    stale = ((now - entry[1] > self.heartbeat_timeout)
+                             if entry is not None else
+                             (now - started > 2 * self.heartbeat_timeout))
+                    if stale:
+                        self._bump(g)
+                        return self._RESTART
+                if all(rc == 0 for rc in codes):
+                    if not done_marked:
+                        done_marked = True
+                        ndone = self._store.add(f"elastic/ndone/{g}", 1)
+                    else:
+                        ndone = self._store.add(f"elastic/ndone/{g}", 0)
+                    if ndone >= n_nodes:
+                        return 0
+                time.sleep(self.poll_interval)
+        finally:
+            _kill_procs(procs)
+            for f in self._log_files:
+                f.close()
+
+    def run(self) -> int:
+        """Budget accounting: only generations that this agent actually
+        RAN and that ended for a "fail" reason consume ``max_restarts`` —
+        scale-up rescales and abandoned rendezvous (both recorded in
+        ``elastic/why/<g>``) are free, so a 4-node job where 3 survivors
+        race to report one death still burns exactly one restart each."""
+        failures = 0
+        infra = 0    # free infra relaunches (bounded; never re-arms)
+        barren = 0   # consecutive DEADLINE-forced rendezvous abandonments
+        while True:
+            g = self._gen_now()
+            if failures > self.max_restarts:
+                return 1
+            node_rank, members, timed_out = self._rendezvous(g)
+            if node_rank is None:
+                # benign abandonments (peer bumped / scale-up) are free
+                # and fast; deadline timeouts mean peers are GONE — after
+                # max_restarts+1 consecutive barren rendezvous (each
+                # rendezvous_timeout long) give up instead of spinning
+                # forever on a permanently-lost quorum
+                if timed_out:
+                    barren += 1
+                    if barren > self.max_restarts:
+                        return 1
+                time.sleep(self.poll_interval)
+                continue
+            barren = 0
+            rc = self._run_generation(g, node_rank, members)
+            if rc == 0:
+                return 0
+            reason = self._bump_reason(g)
+            if reason == "infra":
+                infra += 1
+                if infra > 3:   # insta-crashing workload, not infra
+                    failures += 1
+            elif reason == "fail":
+                failures += 1
+
+    def close(self):
+        self._store.close()
+
+
+def _kill_procs(procs: List[subprocess.Popen]):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 5.0
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()

@@ -46,6 +46,16 @@ def _parse():
                    help="elastic: generations to retry before giving up")
     p.add_argument("--elastic_timeout", type=float, default=30.0,
                    help="elastic: heartbeat staleness limit in seconds")
+    p.add_argument("--elastic_store", default=None,
+                   help="multi-node elastic: shared TCPStore host:port "
+                        "(the etcd analog; one agent passes --host_store)")
+    p.add_argument("--host_store", action="store_true",
+                   help="this agent hosts the shared elastic store")
+    p.add_argument("--elastic_nnodes", default=None,
+                   help="multi-node elastic node count: N or MIN:MAX "
+                        "(e.g. '2' or '1:4')")
+    p.add_argument("--node_host", default="127.0.0.1",
+                   help="address peers can reach this node at")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -56,10 +66,31 @@ def main():
     nproc = args.nproc_per_node
 
     if args.elastic:
+        if args.elastic_store or args.elastic_nnodes or args.host_store:
+            # multi-node: one agent per host against the shared store
+            if not args.elastic_store:
+                sys.exit("--elastic_nnodes needs --elastic_store host:port")
+            spec = args.elastic_nnodes or "1"
+            lo, _, hi = spec.partition(":")
+            min_nodes = int(lo)
+            max_nodes = int(hi) if hi else min_nodes
+            from paddle_tpu.distributed.elastic import MultiNodeElasticAgent
+            agent = MultiNodeElasticAgent(
+                [sys.executable, args.script, *args.script_args],
+                store_addr=args.elastic_store, host_store=args.host_store,
+                nproc=max(1, nproc), min_nodes=min_nodes,
+                max_nodes=max_nodes, max_restarts=args.max_restarts,
+                heartbeat_timeout=args.elastic_timeout,
+                node_host=args.node_host, log_dir=args.log_dir)
+            try:
+                sys.exit(agent.run())
+            finally:
+                agent.close()
         if args.nnodes > 1 or args.master:
-            sys.exit("--elastic currently orchestrates a single node; "
-                     "run one elastic launcher per host (multi-host "
-                     "rendezvous via --master is not supported with it)")
+            sys.exit("single-node --elastic cannot rendezvous via "
+                     "--master; for multi-host elasticity pass "
+                     "--elastic_store/--elastic_nnodes (shared-store "
+                     "agents), or run one launcher per host")
         from paddle_tpu.distributed.elastic import ElasticManager
         mgr = ElasticManager(
             [sys.executable, args.script, *args.script_args],

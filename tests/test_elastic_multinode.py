@@ -1,0 +1,169 @@
+"""Multi-node elastic: two launcher "nodes" on localhost, one dies, the
+job rescales and resumes from the latest complete checkpoint (VERDICT r4
+Missing #1 / Next #4; reference fleet/elastic/manager.py:124,252-299).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.distributed.elastic import ElasticAgent
+    from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+
+    ckpt_dir, result_file, n_steps = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    gen = int(os.environ["PADDLE_ELASTIC_GEN"])
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+    agent = ElasticAgent()
+
+    start = 0
+    ac = None
+    if rank == 0:
+        ac = AutoCheckpoint(ckpt_dir, save_interval_steps=1)
+        latest = ac.latest_step()
+        start = (latest or 0)
+        with open(result_file, "a") as f:
+            f.write(json.dumps({"event": "start", "gen": gen,
+                                "world": world, "resume_from": start}) + "\\n")
+    for step in range(start + 1, n_steps + 1):
+        time.sleep(0.15)
+        if ac is not None:
+            p = ac.maybe_save(step, {"step": np.full((2,), step, np.int64)})
+            if p is not None:
+                p.wait()
+    if ac is not None:
+        with open(result_file, "a") as f:
+            f.write(json.dumps({"event": "done", "gen": gen,
+                                "world": world}) + "\\n")
+    agent.stop()
+""")
+
+
+@pytest.mark.timeout(120)
+def test_two_nodes_one_dies_job_resumes(tmp_path):
+    from paddle_tpu.distributed.elastic import free_port
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    ckpt_dir = str(tmp_path / "ckpt")
+    result_file = str(tmp_path / "result.jsonl")
+    store_port = free_port()
+    n_steps = 20
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def launcher(host_store: bool):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--elastic", "--elastic_store", f"127.0.0.1:{store_port}",
+               "--elastic_nnodes", "1:2", "--elastic_timeout", "2.0",
+               "--max_restarts", "4",
+               "--log_dir", str(tmp_path / "logs")]
+        if host_store:
+            cmd.append("--host_store")
+        cmd += [str(worker), ckpt_dir, result_file, str(n_steps)]
+        return subprocess.Popen(cmd, env=env, start_new_session=True,
+                                cwd=REPO)
+
+    node_a = launcher(host_store=True)
+    time.sleep(1.0)          # node A registers first -> leader / rank 0
+    node_b = launcher(host_store=False)
+
+    try:
+        # let generation 0 run long enough to checkpoint a few steps
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if os.path.exists(result_file) and os.path.exists(ckpt_dir) \
+                    and any(n.startswith("step_")
+                            for n in os.listdir(ckpt_dir)):
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("generation 0 never checkpointed")
+        time.sleep(0.8)      # a few more steps land
+
+        # node B dies (whole process group, workers included)
+        os.killpg(os.getpgid(node_b.pid), signal.SIGKILL)
+
+        rc = node_a.wait(timeout=80)
+        assert rc == 0, f"surviving node exited {rc}"
+    finally:
+        for p in (node_a, node_b):
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    import json
+    events = [json.loads(line) for line in open(result_file)]
+    starts = [e for e in events if e["event"] == "start"]
+    dones = [e for e in events if e["event"] == "done"]
+    # generation 0 started at step 0 with 2 nodes
+    assert starts[0]["resume_from"] == 0
+    assert starts[0]["world"] == 2
+    # after the kill: a later generation RESUMED from a checkpointed step
+    resumed = [e for e in starts if e["gen"] > 0]
+    assert resumed, f"no post-failure generation in {events}"
+    assert resumed[-1]["resume_from"] > 0, \
+        f"rescaled generation did not resume from a checkpoint: {events}"
+    assert resumed[-1]["world"] == 1      # scale-down happened
+    assert dones and dones[-1]["world"] == 1
+
+
+@pytest.mark.timeout(60)
+def test_two_nodes_clean_completion(tmp_path):
+    """Both nodes run to completion: agents exit 0, one generation."""
+    from paddle_tpu.distributed.elastic import free_port
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    ckpt_dir = str(tmp_path / "ckpt")
+    result_file = str(tmp_path / "result.jsonl")
+    store_port = free_port()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def launcher(host_store: bool):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--elastic", "--elastic_store", f"127.0.0.1:{store_port}",
+               "--elastic_nnodes", "2", "--elastic_timeout", "5.0"]
+        if host_store:
+            cmd.append("--host_store")
+        cmd += [str(worker), ckpt_dir, result_file, "3"]
+        return subprocess.Popen(cmd, env=env, start_new_session=True,
+                                cwd=REPO)
+
+    node_a = launcher(True)
+    node_b = launcher(False)
+    try:
+        assert node_a.wait(timeout=50) == 0
+        assert node_b.wait(timeout=20) == 0
+    finally:
+        for p in (node_a, node_b):
+            try:
+                os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    import json
+    events = [json.loads(line) for line in open(result_file)]
+    assert any(e["event"] == "done" and e["world"] == 2 for e in events)
