@@ -1,0 +1,92 @@
+"""Multi-controller fsdp+tp worker: launched (2 processes) by the launch
+CLI from ``test_multicontroller.py``.  NOT a pytest file.
+
+Each process drives 2 virtual CPU devices; the global mesh is
+(fsdp=2, tp=2).  One full TrainStep (fwd+bwd+AdamW) of a tiny Llama runs
+jitted over the mesh with real fsdp/tp PartitionSpecs; rank 0 dumps the
+loss and two representative (all-gathered) parameter tensors after the
+update, for parity against the identical single-process 4-device run.
+Then the fsdp+tp-sharded params are saved per-shard; the parent restores
+them in ONE process and compares (the save@N/restore@M story).
+
+Reference pattern: test/collective/fleet/ hybrid-parallel matrix
+(mp/pp/sharding parity tests against serial runs).
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+out_dir = sys.argv[1]
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+from paddle_tpu.distributed.tcp_store import TCPStore  # noqa: E402
+
+host = os.environ["PADDLE_MASTER"].rsplit(":", 1)[0]
+store_port = int(os.environ["PADDLE_STORE_PORT"])
+store = TCPStore(host, store_port, is_master=(rank == 0),
+                 world_size=world, timeout=60.0)
+store.barrier("preinit")
+
+import paddle_tpu.distributed as dist  # noqa: E402
+
+env = dist.init_parallel_env()
+assert jax.device_count() == 2 * world
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import paddle_tpu as pp  # noqa: E402
+from paddle_tpu.jit import TrainStep  # noqa: E402
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM  # noqa: E402
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("fsdp", "tp"))
+
+pp.seed(0)
+cfg = LlamaConfig.tiny(vocab_size=128, hidden_size=32,
+                       intermediate_size=64, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=2)
+model = LlamaForCausalLM(cfg)
+opt = pp.optimizer.AdamW(learning_rate=1e-2,
+                         parameters=model.parameters())
+rules = LlamaForCausalLM.partition_specs(cfg, fsdp_axis="fsdp")
+specs = {n: LlamaForCausalLM.spec_for(n, rules)
+         for n in model.state_dict(keep_vars=True)}
+step = TrainStep(model, opt, mesh=mesh, param_specs=specs,
+                 batch_spec=P("fsdp"))
+
+rs = np.random.RandomState(0)
+ids = rs.randint(0, cfg.vocab_size, size=(4, 17))
+loss = step({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+
+# representative updated params, fully gathered for the parity check
+emb_name = next(n for n in step.params if "embed" in n)
+proj_name = next(n for n in step.params if n.endswith("q_proj.weight"))
+repl = NamedSharding(mesh, P())
+gathered = {
+    "emb": np.asarray(jax.device_put(step.params[emb_name], repl)),
+    "proj": np.asarray(jax.device_put(step.params[proj_name], repl)),
+}
+
+# per-shard save of the fsdp+tp-sharded state (each process writes only
+# its addressable shards)
+ckpt_dir = os.path.join(out_dir, "ckpt")
+dist.save_state_dict({emb_name: step.params[emb_name],
+                      proj_name: step.params[proj_name],
+                      "step": 1}, ckpt_dir)
+
+if rank == 0:
+    np.savez(os.path.join(out_dir, "params.npz"), **gathered)
+    with open(os.path.join(out_dir, "result.json"), "w") as f:
+        json.dump({"loss": float(loss), "world": env.world_size,
+                   "emb_name": emb_name, "proj_name": proj_name,
+                   "devices": jax.device_count()}, f)
+store.barrier("done")
+store.close()

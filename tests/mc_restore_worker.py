@@ -1,0 +1,63 @@
+"""Multi-controller restore worker: 2 processes restore a checkpoint that
+was SAVED BY ONE process, through load_state_dict's make_array_from_callback
+path onto a (fsdp=2, tp=2) global mesh.  NOT a pytest file.
+
+Each process checks its addressable shards against the expected full
+tensors (rank 0 wrote them to expected.npz before launching us); rank 0
+writes restore_ok.json on success.
+"""
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+out_dir = sys.argv[1]
+
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+from paddle_tpu.distributed.tcp_store import TCPStore  # noqa: E402
+
+host = os.environ["PADDLE_MASTER"].rsplit(":", 1)[0]
+store_port = int(os.environ["PADDLE_STORE_PORT"])
+store = TCPStore(host, store_port, is_master=(rank == 0),
+                 world_size=world, timeout=60.0)
+store.barrier("preinit")
+
+import paddle_tpu.distributed as dist  # noqa: E402
+
+dist.init_parallel_env()
+assert jax.device_count() == 2 * world
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+mesh = Mesh(np.array(jax.devices()).reshape(2, 2), ("fsdp", "tp"))
+expected = np.load(os.path.join(out_dir, "expected.npz"))
+
+specs = {"a": P("fsdp", "tp"), "b": P("tp", None)}
+loaded = dist.load_state_dict(os.path.join(out_dir, "ckpt_1proc"),
+                              mesh=mesh, specs=specs)
+ok = True
+for name in ("a", "b"):
+    arr = loaded[name]
+    # check only this process's addressable shards (the point of the
+    # per-shard format: no host materializes the global tensor)
+    for shard in arr.addressable_shards:
+        want = expected[name][shard.index]
+        if not np.allclose(np.asarray(shard.data), want):
+            ok = False
+assert int(loaded["step"]) == 7
+
+store.barrier("checked")
+if rank == 0:
+    with open(os.path.join(out_dir, "restore_ok.json"), "w") as f:
+        json.dump({"ok": ok, "world": world}, f)
+store.barrier("done")
+store.close()
+assert ok
