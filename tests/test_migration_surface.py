@@ -1,0 +1,213 @@
+"""MIGRATION.md drift guard (VERDICT r4 Missing #4 / Next #9).
+
+Every row of MIGRATION.md's "Same surface (drop-in)" table names a Paddle
+surface this package claims to provide.  This test walks the claims and
+exercises each one — import + a minimal call where cheap — so the table
+cannot drift from the package: deleting or renaming a claimed surface
+fails CI, and a new drop-in row must come with the code that backs it.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+
+_MIGRATION = os.path.join(os.path.dirname(__file__), os.pardir,
+                          "MIGRATION.md")
+
+
+def _dropin_rows():
+    """Parse the 'Same surface (drop-in)' table rows out of MIGRATION.md."""
+    with open(_MIGRATION) as f:
+        text = f.read()
+    section = text.split("## Same surface (drop-in)")[1].split("## ")[0]
+    rows = []
+    for line in section.splitlines():
+        if line.startswith("|") and not set(line) <= set("|- "):
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if cells and cells[0] != "Paddle":
+                rows.append(cells)
+    return rows
+
+
+def test_migration_table_parses():
+    rows = _dropin_rows()
+    assert len(rows) >= 18, f"drop-in table shrank to {len(rows)} rows"
+
+
+# One executable probe per drop-in row.  Keys are regexes matched against
+# the row's first (Paddle) cell; every row MUST match exactly one probe —
+# adding a row without a probe fails test_every_dropin_row_has_a_probe.
+def _probe_tensor_ctors():
+    t = pp.to_tensor(np.ones((2, 2), np.float32))
+    assert tuple(pp.randn([2, 3]).shape) == (2, 3)
+    assert tuple(pp.arange(5).shape) == (5,)
+    return t
+
+
+def _probe_tensor_methods():
+    t = pp.to_tensor(np.ones((2, 3), np.float32))
+    t.stop_gradient = False
+    (t * t).sum().backward()
+    assert t.grad is not None
+    assert tuple(t.T.shape) == (3, 2)
+    assert tuple(t.reshape([3, 2]).shape) == (3, 2)
+    return t
+
+
+def _probe_nn():
+    layer = pp.nn.Linear(4, 2)
+    out = layer(pp.randn([3, 4]))
+    assert tuple(out.shape) == (3, 2)
+    assert callable(pp.nn.functional.relu)
+    assert callable(pp.nn.functional.cross_entropy)
+
+
+def _probe_optimizer():
+    lin = pp.nn.Linear(2, 2)
+    opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=lin.parameters())
+    sched = pp.optimizer.lr.CosineAnnealingDecay(learning_rate=0.1,
+                                                 T_max=10)
+    assert isinstance(sched.get_lr(), float)
+    assert hasattr(opt, "step") and hasattr(opt, "clear_grad")
+
+
+def _probe_amp():
+    assert callable(pp.amp.auto_cast)
+    scaler = pp.amp.GradScaler()
+    assert hasattr(scaler, "scale")
+
+
+def _probe_io():
+    class DS(pp.io.Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    dl = pp.io.DataLoader(DS(), batch_size=2)
+    assert len(list(dl)) == 2
+    assert callable(pp.io.get_worker_info)
+
+
+def _probe_metric_hapi():
+    m = pp.metric.Accuracy()
+    assert hasattr(m, "update") and hasattr(m, "accumulate")
+    assert hasattr(pp.Model, "fit")
+
+
+def _probe_vision():
+    assert callable(pp.vision.models.resnet18)
+    assert hasattr(pp.vision.transforms, "Resize")
+    assert callable(pp.vision.ops.nms)
+
+
+def _probe_text_audio():
+    import paddle_tpu.text as text
+    import paddle_tpu.audio as audio
+    assert hasattr(text, "Vocab")
+    assert hasattr(audio, "datasets")
+
+
+def _probe_distribution():
+    d = pp.distribution.Normal(0.0, 1.0)
+    s = d.sample([2])
+    arr = s.numpy() if hasattr(s, "numpy") else np.asarray(s)
+    assert np.isfinite(arr).all()
+
+
+def _probe_sparse_geometric():
+    import paddle_tpu.sparse as sparse
+    assert hasattr(sparse, "SparseCooTensor")
+    assert hasattr(sparse, "SparseCsrTensor")
+    assert callable(sparse.matmul)
+    import paddle_tpu.geometric as geo
+    assert callable(geo.segment_sum)
+
+
+def _probe_linalg_fft():
+    x = pp.to_tensor(np.eye(3, dtype=np.float32))
+    assert pp.linalg.norm(x) is not None
+    assert pp.fft.fft(pp.to_tensor(np.ones(4, np.float32))) is not None
+
+
+def _probe_rpc():
+    import paddle_tpu.distributed.rpc as rpc
+    assert hasattr(rpc, "init_rpc") and hasattr(rpc, "rpc_sync")
+
+
+def _probe_onnx():
+    import paddle_tpu.onnx as onnx
+    assert callable(onnx.export)
+
+
+def _probe_hub():
+    assert callable(pp.hub.load) and callable(pp.hub.list)
+
+
+def _probe_quantization():
+    import paddle_tpu.quantization as q
+    assert hasattr(q, "QAT") or hasattr(q, "QuantConfig")
+
+
+def _probe_static():
+    import paddle_tpu.static as st
+    assert hasattr(st, "InputSpec")
+    assert hasattr(st, "save_inference_model")
+    assert hasattr(st, "nn")
+
+
+def _probe_tensorarray():
+    arr = pp.tensor_array_to_tensor if hasattr(
+        pp, "tensor_array_to_tensor") else None
+    from paddle_tpu.ops import array_ops
+    a = array_ops.create_array("float32")
+    array_ops.array_write(pp.to_tensor(np.ones(2, np.float32)), 0, a)
+    assert array_ops.array_length(a) == 1
+
+
+_PROBES = [
+    (r"to_tensor / randn", _probe_tensor_ctors),
+    (r"`Tensor` methods", _probe_tensor_methods),
+    (r"paddle\.nn", _probe_nn),
+    (r"paddle\.optimizer", _probe_optimizer),
+    (r"paddle\.amp", _probe_amp),
+    (r"paddle\.io", _probe_io),
+    (r"paddle\.metric", _probe_metric_hapi),
+    (r"paddle\.vision", _probe_vision),
+    (r"paddle\.text", _probe_text_audio),
+    (r"paddle\.distribution", _probe_distribution),
+    (r"paddle\.sparse", _probe_sparse_geometric),
+    (r"paddle\.linalg", _probe_linalg_fft),
+    (r"distributed\.rpc", _probe_rpc),
+    (r"paddle\.onnx", _probe_onnx),
+    (r"paddle\.hub", _probe_hub),
+    (r"paddle\.quantization", _probe_quantization),
+    (r"paddle\.static", _probe_static),
+    (r"TensorArray", _probe_tensorarray),
+]
+
+
+def test_every_dropin_row_has_a_probe():
+    rows = _dropin_rows()
+    unmatched = []
+    for cells in rows:
+        if not any(re.search(pat, cells[0]) for pat, _ in _PROBES):
+            unmatched.append(cells[0])
+    assert not unmatched, (
+        f"MIGRATION.md drop-in rows with no executable probe: {unmatched} "
+        "— add a probe to tests/test_migration_surface.py for each")
+
+
+@pytest.mark.parametrize("pat,probe", _PROBES,
+                         ids=[p[0].replace("\\", "") for p in _PROBES])
+def test_dropin_surface(pat, probe):
+    """The claimed surface exists and minimally works."""
+    probe()
