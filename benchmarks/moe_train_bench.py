@@ -19,6 +19,7 @@ import numpy as np
 
 
 def main():
+    import os
     import jax
     import paddle_tpu as pp
     from paddle_tpu.jit import TrainStep
@@ -27,18 +28,51 @@ def main():
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
+    # PT_MOE_DISPATCH picks the dispatch path (einsum | index | ragged |
+    # all_to_all | all_to_all_index); the a2a modes run through shard_map
+    # on a 1-device (ep,) mesh — the same program the multichip dryrun
+    # compiles at ep=8
+    mode = os.environ.get("PT_MOE_DISPATCH", "ragged")
+    mesh = None
+    if mode.startswith("all_to_all"):
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:1]), ("ep",))
+    # ablation knobs: PT_MOE_BATCH sizes the batch; PT_MOE_DENSE=1 makes
+    # every layer dense (isolates the non-MoE cost of the same trunk)
+    dense_all = os.environ.get("PT_MOE_DENSE", "") == "1"
+    which = os.environ.get("PT_MOE_CFG", "large")
     if on_tpu:
-        cfg = MoEConfig(
-            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            moe_intermediate_size=1024, num_hidden_layers=6,
-            num_attention_heads=8, num_key_value_heads=8, num_experts=16,
-            num_experts_per_tok=2, num_shared_experts=1,
-            first_k_dense_replace=1, max_position_embeddings=2048,
-            capacity_factor=1.25, dispatch_mode="index", dtype="bfloat16")
-        batch, seq, iters, warmup = 4, 2048, 8, 2
+        if which == "large":
+            # DeepSeekMoE-family dims (deepseek_moe_16b: d=2048, expert
+            # width 1408) scaled to one 16G chip by depth/expert count
+            cfg = MoEConfig(
+                vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, moe_intermediate_size=1408,
+                num_hidden_layers=4, num_attention_heads=16,
+                num_key_value_heads=16, num_experts=16,
+                num_experts_per_tok=2, num_shared_experts=1,
+                first_k_dense_replace=1, max_position_embeddings=2048,
+                capacity_factor=1.25, dispatch_mode=mode, mesh=mesh,
+                dtype="bfloat16")
+        else:  # "small": round-4-comparable config
+            cfg = MoEConfig(
+                vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                moe_intermediate_size=1024, num_hidden_layers=6,
+                num_attention_heads=8, num_key_value_heads=8,
+                num_experts=16, num_experts_per_tok=2,
+                num_shared_experts=1, first_k_dense_replace=1,
+                max_position_embeddings=2048, capacity_factor=1.25,
+                dispatch_mode=mode, mesh=mesh, dtype="bfloat16")
+        if dense_all:
+            cfg.first_k_dense_replace = cfg.num_hidden_layers
+        batch = int(os.environ.get("PT_MOE_BATCH", "4"))
+        seq, iters, warmup = 2048, 8, 2
     else:
-        cfg = MoEConfig.tiny()
-        batch, seq, iters, warmup = 2, 64, 2, 1
+        cfg = MoEConfig.tiny(dispatch_mode=mode, mesh=mesh)
+        if dense_all:  # the ablation knobs apply off-chip too
+            cfg.first_k_dense_replace = cfg.num_hidden_layers
+        batch = int(os.environ.get("PT_MOE_BATCH", "2"))
+        seq, iters, warmup = 64, 2, 1
 
     pp.seed(0)
     model = MoEForCausalLM(cfg)
@@ -47,10 +81,18 @@ def main():
                              multi_precision=True)
     step = TrainStep(model, opt)
     n_params = sum(int(np.prod(a.shape)) for a in step.params.values())
-    # activated = total minus the (E - top_k) routed experts idle per token
-    n_moe_layers = cfg.num_hidden_layers - cfg.first_k_dense_replace
-    idle = n_moe_layers * (cfg.num_experts - cfg.num_experts_per_tok) \
-        * 3 * cfg.hidden_size * cfg.moe_intermediate_size
+    # activated = total minus the idle fraction of the ROUTED expert
+    # params, measured from the actual [E, ...] expert arrays (a per-token
+    # forward touches top_k of num_experts of them) — never from an
+    # assumed expert architecture (an earlier 3-matrix SwiGLU assumption
+    # overcounted idle by 1.5x against the 2-matrix ExpertFFN and
+    # UNDER-reported MFU)
+    expert_params = sum(int(np.prod(a.shape))
+                        for name, a in step.params.items()
+                        if ".experts." in name)
+    idle = int(expert_params
+               * (cfg.num_experts - cfg.num_experts_per_tok)
+               / cfg.num_experts)
     activated = n_params - idle
 
     rng = np.random.default_rng(0)
@@ -77,6 +119,7 @@ def main():
         "metric": "moe_pretrain_mfu", "value": round(mfu, 4),
         "unit": "fraction_of_peak_activated_flops",
         "detail": {"params_total": n_params, "params_activated": activated,
+                   "dispatch_mode": mode,
                    "experts": cfg.num_experts,
                    "top_k": cfg.num_experts_per_tok,
                    "tokens_per_sec_per_chip": round(tokens / dt, 1),
