@@ -239,9 +239,16 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         a_shift2 = jnp.where(allow_skip, a_shift2, neg_inf)
         m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
         m_safe = jnp.maximum(m, -1e29)
-        tot = m_safe + jnp.log(
-            jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe) +
-            jnp.exp(a_shift2 - m_safe))
+        # states with NO live incoming path have sum_exp == 0; log(0)
+        # is -inf and its 1/0 cotangent turns the whole backward pass
+        # NaN, so floor the sum and re-mask the result to the finite
+        # sentinel (the floor keeps the log's gradient finite even for
+        # the branch jnp.where does not select)
+        sum_exp = (jnp.exp(a_prev - m_safe) + jnp.exp(a_shift1 - m_safe)
+                   + jnp.exp(a_shift2 - m_safe))
+        tot = jnp.where(
+            m <= -1e29, neg_inf,
+            m_safe + jnp.log(jnp.maximum(sum_exp, 1e-30)))
         new_alpha = tot + emit(t_lp, ext)
         return new_alpha, new_alpha
 
@@ -257,7 +264,10 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     a1 = jnp.take_along_axis(per_b, s1[:, None], axis=1)[:, 0]
     a2 = jnp.take_along_axis(per_b, s2[:, None], axis=1)[:, 0]
     m = jnp.maximum(a1, a2)
-    ll = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m))
+    m_safe = jnp.maximum(m, -1e29)
+    sum_exp = jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe)
+    ll = jnp.where(m <= -1e29, neg_inf,
+                   m_safe + jnp.log(jnp.maximum(sum_exp, 1e-30)))
     loss = -ll
     if reduction == "mean":
         return jnp.mean(loss / jnp.maximum(label_lengths, 1))

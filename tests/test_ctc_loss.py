@@ -1,0 +1,75 @@
+"""CTC loss vs the torch oracle + gradient finiteness (regression for
+the log-space alpha recursion's unreachable-state NaN: log(0) states
+poisoned the backward pass; reference nn/functional/loss.py ctc_loss
+over warpctc)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import functional as F
+from paddle_tpu.core.dispatch import unwrap
+
+
+CASES = [(16, 2, 97, 4), (25, 3, 40, 10), (12, 4, 30, 6),
+         (8, 2, 12, 3)]
+
+
+@pytest.mark.parametrize("T,b,K,L", CASES)
+def test_ctc_matches_torch(T, b, K, L):
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(T * 31 + L)
+    raw = rng.normal(size=(T, b, K)).astype(np.float32)
+    logp = jax.nn.log_softmax(jnp.asarray(raw), -1)
+    labels = rng.integers(1, K - 1, (b, L)).astype(np.int32)
+    il = np.full((b,), T, np.int32)
+    ll = np.full((b,), L, np.int32)
+    ours = float(unwrap(F.ctc_loss(
+        logp, jnp.asarray(labels), jnp.asarray(il), jnp.asarray(ll),
+        blank=0, reduction="mean")))
+    want = float(torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.from_numpy(raw), -1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(il.astype(np.int64)),
+        torch.from_numpy(ll.astype(np.int64)),
+        blank=0, reduction="mean"))
+    np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_grad_finite():
+    """The gradient must be finite even with unreachable lattice states
+    (short labels, long T — most of the alpha band starts dead)."""
+    rng = np.random.default_rng(7)
+    T, b, K, L = 20, 3, 50, 2
+    logp = jax.nn.log_softmax(
+        jnp.asarray(rng.normal(size=(T, b, K)), jnp.float32), -1)
+    labels = jnp.asarray(rng.integers(1, K - 1, (b, L)), jnp.int32)
+    il = jnp.full((b,), T, jnp.int32)
+    ll = jnp.full((b,), L, jnp.int32)
+
+    g = jax.grad(lambda lp: unwrap(F.ctc_loss(
+        lp, labels, il, ll, blank=0, reduction="mean")))(logp)
+    assert bool(jnp.isfinite(g).all())
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_ctc_repeated_labels():
+    """Repeats force blank transitions (allow_skip=False rows)."""
+    torch = pytest.importorskip("torch")
+    T, b, K = 12, 1, 10
+    raw = np.random.default_rng(3).normal(size=(T, b, K)).astype(np.float32)
+    labels = np.array([[2, 2, 3, 3]], np.int32)
+    il = np.array([T], np.int32)
+    ll = np.array([4], np.int32)
+    ours = float(unwrap(F.ctc_loss(
+        jax.nn.log_softmax(jnp.asarray(raw), -1), jnp.asarray(labels),
+        jnp.asarray(il), jnp.asarray(ll), blank=0, reduction="mean")))
+    want = float(torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.from_numpy(raw), -1),
+        torch.from_numpy(labels.astype(np.int64)),
+        torch.from_numpy(il.astype(np.int64)),
+        torch.from_numpy(ll.astype(np.int64)),
+        blank=0, reduction="mean"))
+    np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-5)
