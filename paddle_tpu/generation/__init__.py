@@ -69,16 +69,34 @@ def static_cache_attention(q, k, v, cache: StaticCache, position_offset,
         scaled_dot_product_attention
 
     s = q.shape[1]
-    kb = jax.lax.dynamic_update_slice(
-        unwrap(cache.k), unwrap(k).astype(cache.k.dtype),
-        (0, position_offset, 0, 0))
-    vb = jax.lax.dynamic_update_slice(
-        unwrap(cache.v), unwrap(v).astype(cache.v.dtype),
-        (0, position_offset, 0, 0))
-    max_len = kb.shape[1]
-    kpos = jnp.arange(max_len)[None, None, None, :]
-    qpos = position_offset + jnp.arange(s)[None, None, :, None]
-    mask = kpos <= qpos  # valid-prefix causal bound over the buffer
+    if getattr(position_offset, "ndim", 0) == 1:
+        # per-row positions [B] (continuous batching: every slot decodes
+        # at its own offset).  Single-token steps only: the write is a
+        # per-row scatter, the causal bound is per-row.
+        if s != 1:
+            raise ValueError("per-row position_offset requires seq==1 "
+                             f"(got {s})")
+        B = q.shape[0]
+        rows = jnp.arange(B)
+        kb = unwrap(cache.k).at[rows, position_offset].set(
+            unwrap(k)[:, 0].astype(cache.k.dtype))
+        vb = unwrap(cache.v).at[rows, position_offset].set(
+            unwrap(v)[:, 0].astype(cache.v.dtype))
+        max_len = kb.shape[1]
+        kpos = jnp.arange(max_len)[None, None, None, :]
+        qpos = position_offset[:, None, None, None]
+        mask = kpos <= qpos                     # [B,1,1,max_len]
+    else:
+        kb = jax.lax.dynamic_update_slice(
+            unwrap(cache.k), unwrap(k).astype(cache.k.dtype),
+            (0, position_offset, 0, 0))
+        vb = jax.lax.dynamic_update_slice(
+            unwrap(cache.v), unwrap(v).astype(cache.v.dtype),
+            (0, position_offset, 0, 0))
+        max_len = kb.shape[1]
+        kpos = jnp.arange(max_len)[None, None, None, :]
+        qpos = position_offset + jnp.arange(s)[None, None, :, None]
+        mask = kpos <= qpos  # valid-prefix causal bound over the buffer
     if attn_mask is not None:
         am = reject_scalar_mask(attn_mask)
         if am.dtype == jnp.bool_:

@@ -1,0 +1,330 @@
+"""Continuous-batching serving engine over the compiled KV-cache step.
+
+Reference role: the AnalysisPredictor serving loop
+(inference/api/analysis_predictor.cc) + the fused_multi_transformer
+decode path — rebuilt TPU-style: ONE compiled per-token decode step over
+a fixed pool of batch slots, plus one compiled prefill executable per
+prompt-length bucket.  New requests join as running sequences finish
+(slot reuse); every slot decodes at its own position (per-row KV write +
+causal bound + RoPE gather — ``static_cache_attention``'s vector-offset
+path).
+
+Prefill bucketing: a prompt is right-padded to the smallest bucket.
+Causality makes the padding invisible — pad positions sit to the RIGHT
+of every real token, so no real query attends to them; the first
+generated token reads the logits at the TRUE last prompt position, and
+decode then overwrites the pad rows one per step (the causal bound
+``kpos <= pos`` keeps not-yet-overwritten pads masked).
+
+Weight-only int8: ``int8_weights=True`` stores every 2-D matmul weight
+as int8 with a per-output-channel fp32 scale and dequantizes INSIDE the
+compiled step (XLA fuses the convert+scale into the matmul prologue), so
+decode — a bandwidth-bound workload — reads half the bytes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ContinuousBatchingEngine", "quantize_weights_int8"]
+
+
+def quantize_weights_int8(params: Dict[str, jnp.ndarray],
+                          min_size: int = 1 << 16):
+    """Split params into (passthrough, {name: (w8, scale)}) — every
+    float 2-D weight with >= min_size elements becomes symmetric
+    per-output-channel int8 (the weight-only quantization serving
+    engines use; reference quantization/ptq int8 path)."""
+    keep, quant = {}, {}
+    for name, a in params.items():
+        if (a.ndim == 2 and jnp.issubdtype(a.dtype, jnp.floating)
+                and a.size >= min_size):
+            scale = (jnp.max(jnp.abs(a.astype(jnp.float32)), axis=0,
+                             keepdims=True) / 127.0).astype(jnp.float32)
+            w8 = jnp.clip(jnp.round(a.astype(jnp.float32)
+                                    / jnp.maximum(scale, 1e-12)),
+                          -127, 127).astype(jnp.int8)
+            quant[name] = (w8, scale)
+        else:
+            keep[name] = a
+    return keep, quant
+
+
+def _dequant(keep, quant, dtype):
+    out = dict(keep)
+    for name, (w8, scale) in quant.items():
+        out[name] = (w8.astype(jnp.float32) * scale).astype(dtype)
+    return out
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray              # [Lp] int32
+    max_new_tokens: int
+    out: List[int] = field(default_factory=list)
+
+
+class ContinuousBatchingEngine:
+    """Greedy decode over ``slots`` concurrent sequences with slot reuse.
+
+    add_request() enqueues; step() either admits a queued request into a
+    free slot (bucketed prefill) or advances every active slot by one
+    token (single compiled decode step).  finished() yields completed
+    (rid, prompt, tokens) triples.
+    """
+
+    def __init__(self, model, slots: int = 8, max_len: int = 1024,
+                 prefill_buckets: Sequence[int] = (32, 64, 128, 256),
+                 eos_token_id: Optional[int] = None,
+                 int8_weights: bool = False,
+                 steps_per_sync: int = 1):
+        from paddle_tpu.core.functional import functional_call, params_of
+
+        self.model = model
+        self.slots = slots
+        self.max_len = max_len
+        self.buckets = sorted(prefill_buckets)
+        self.eos = eos_token_id
+        # decode steps fused into ONE device program per host interaction
+        # (lax.scan): amortizes host/dispatch latency K-fold — the thing
+        # that matters when the host sits far from the chip.  Sequences
+        # finishing mid-chunk over-generate < K tokens (truncated by the
+        # host; the wasted rows are unreachable for successors, see step())
+        self.steps_per_sync = max(1, int(steps_per_sync))
+        table = getattr(model.config, "max_position_embeddings", None)
+        if table is not None and max_len > table:
+            # the per-row RoPE gather CLAMPS out-of-range positions
+            # (silent wrong rotations) — reject up front where the scalar
+            # path would have raised at trace time
+            raise ValueError(
+                f"max_len {max_len} exceeds the model's RoPE table "
+                f"(max_position_embeddings={table})")
+        if self.buckets[-1] >= max_len:
+            raise ValueError(
+                f"largest prefill bucket {self.buckets[-1]} must be < "
+                f"max_len {max_len} (prefill writes bucket rows into the "
+                "per-slot cache)")
+        params = params_of(model)
+        self._dtype = next(iter(params.values())).dtype
+        if int8_weights:
+            self._keep, self._quant = quantize_weights_int8(params)
+        else:
+            self._keep, self._quant = params, {}
+        self.int8 = int8_weights
+
+        cfgm = model.config
+        kv_shape = (slots, max_len, cfgm.num_key_value_heads, cfgm.head_dim)
+        self._caches = [
+            (jnp.zeros(kv_shape, self._dtype), jnp.zeros(kv_shape,
+                                                         self._dtype))
+            for _ in range(cfgm.num_hidden_layers)]
+        self._pos = np.zeros((slots,), np.int32)       # next write row
+        self._active: List[Optional[_Request]] = [None] * slots
+        self._budget = np.zeros((slots,), np.int32)    # tokens remaining
+        self._last_tok = np.zeros((slots,), np.int32)
+        self._queue: deque = deque()
+        self._done: deque = deque()
+        self._next_rid = 0
+
+        # serving traces must see eval-mode (dropout off); remembered so
+        # close() / context exit can hand the model back for training
+        self._was_training = getattr(model, "training", False)
+        if self._was_training:
+            model.eval()
+
+        from paddle_tpu.core.dispatch import unwrap
+        from paddle_tpu.generation import StaticCache
+
+        def fwd(ps, ids, caches, pos):
+            cc = [StaticCache(k, v) for k, v in caches]
+            logits, new_caches = functional_call(model, ps, ids, None,
+                                                 cc, pos)
+            raw = unwrap(logits).astype(jnp.float32)
+            flat = [(unwrap(c.k), unwrap(c.v)) for c in new_caches]
+            return raw, flat
+
+        dtype = self._dtype
+
+        import functools as _ft
+
+        @_ft.partial(jax.jit, donate_argnums=(3,))
+        def prefill(keep, quant, ids, caches1, true_len):
+            ps = _dequant(keep, quant, dtype)
+            logits, new_caches = fwd(ps, ids, caches1, 0)
+            first = jnp.argmax(logits[0, true_len - 1], axis=-1)
+            return first.astype(jnp.int32), new_caches
+
+        @_ft.partial(jax.jit, donate_argnums=(0, 1))
+        def insert(cachesB, caches1, slot):
+            out = []
+            for (kb, vb), (k1, v1) in zip(cachesB, caches1):
+                kb = jax.lax.dynamic_update_slice(
+                    kb, k1.astype(kb.dtype), (slot, 0, 0, 0))
+                vb = jax.lax.dynamic_update_slice(
+                    vb, v1.astype(vb.dtype), (slot, 0, 0, 0))
+                out.append((kb, vb))
+            return out
+
+        K = self.steps_per_sync
+
+        @_ft.partial(jax.jit, donate_argnums=(2,))
+        def decode(keep, quant, caches, toks, pos, active):
+            ps = _dequant(keep, quant, dtype)
+
+            def one(carry, _):
+                caches, toks, pos = carry
+                logits, caches = fwd(ps, toks[:, None], caches, pos)
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                # inactive slots run with pos pinned to the scratch row
+                # max_len-1 (set by the host) and a frozen token; their
+                # pos must NOT advance inside the chunk
+                nxt = jnp.where(active, nxt, toks)
+                pos = jnp.where(active, pos + 1, pos)
+                return (caches, nxt, pos), nxt
+
+            (caches, _, _), seq = jax.lax.scan(
+                one, (caches, toks, pos), None, length=K)
+            return jnp.swapaxes(seq, 0, 1), caches   # [B, K]
+
+        self._prefill, self._insert, self._decode = prefill, insert, decode
+        self._fwd = fwd
+
+    # -- public API ----------------------------------------------------------
+    def add_request(self, prompt_ids, max_new_tokens: int = 64) -> int:
+        p = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1 (the prefill "
+                             f"already emits one token); got "
+                             f"{max_new_tokens}")
+        # strict bound: row max_len-1 is the inactive-slot scratch row and
+        # must stay unreachable; chunked decode over-writes up to the next
+        # steps_per_sync boundary, so budget in whole chunks
+        K = self.steps_per_sync
+        chunks = -(-max_new_tokens // K) * K
+        if len(p) + chunks > self.max_len - 1:
+            raise ValueError(
+                f"prompt {len(p)} + max_new {max_new_tokens} (rounded to "
+                f"{chunks} by steps_per_sync={K}) exceeds max_len-1 = "
+                f"{self.max_len - 1} (last row is reserved)")
+        if len(p) > self.buckets[-1]:
+            raise ValueError(f"prompt {len(p)} exceeds largest prefill "
+                             f"bucket {self.buckets[-1]}")
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, p, max_new_tokens))
+        return rid
+
+    def finished(self):
+        while self._done:
+            yield self._done.popleft()
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(r is not None for r in self._active)
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(n)
+
+    def _admit(self, slot: int, req: _Request):
+        from paddle_tpu.generation import StaticCache  # noqa: F401
+        Lp = len(req.prompt)
+        Lb = self._bucket(Lp)
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, :Lp] = req.prompt
+        cfgm = self.model.config
+        shape1 = (1, self.max_len, cfgm.num_key_value_heads, cfgm.head_dim)
+        # k and v must be DISTINCT buffers (the prefill donates its cache
+        # argument; an aliased pair would be donated twice)
+        kv1 = [(jnp.zeros(shape1, self._dtype), jnp.zeros(shape1,
+                                                          self._dtype))
+               for _ in range(cfgm.num_hidden_layers)]
+        first, caches1 = self._prefill(self._keep, self._quant,
+                                       jnp.asarray(ids), kv1,
+                                       jnp.asarray(Lp, jnp.int32))
+        self._caches = self._insert(self._caches, caches1,
+                                    jnp.asarray(slot, jnp.int32))
+        first = int(first)
+        req.out.append(first)
+        self._active[slot] = req
+        self._pos[slot] = Lp          # decode writes OVER the pad rows
+        self._budget[slot] = req.max_new_tokens - 1
+        self._last_tok[slot] = first
+        if (self.eos is not None and first == self.eos) \
+                or self._budget[slot] <= 0:
+            self._retire(slot)
+
+    def _retire(self, slot: int):
+        req = self._active[slot]
+        self._active[slot] = None
+        self._done.append((req.rid, req.prompt, list(req.out)))
+
+    def step(self) -> bool:
+        """One scheduling step.  Returns False when nothing is left."""
+        free = [i for i, r in enumerate(self._active) if r is None]
+        if free and self._queue:
+            self._admit(free[0], self._queue.popleft())
+            return True
+        if all(r is None for r in self._active):
+            return bool(self._queue)
+        active = np.array([r is not None for r in self._active])
+        # inactive slots decode at the last row with a discarded output —
+        # their write lands on max_len-1 which no active sequence can
+        # reach (add_request enforces prompt+new <= max_len <= row max)
+        pos = np.where(active, self._pos, self.max_len - 1).astype(np.int32)
+        toks, self._caches = self._decode(
+            self._keep, self._quant, self._caches,
+            jnp.asarray(self._last_tok), jnp.asarray(pos),
+            jnp.asarray(active))
+        toks = np.asarray(toks)                         # [B, K]
+        K = toks.shape[1]
+        for i, req in enumerate(self._active):
+            if req is None:
+                continue
+            for j in range(K):
+                t = int(toks[i, j])
+                req.out.append(t)
+                self._pos[i] += 1
+                self._budget[i] -= 1
+                self._last_tok[i] = t
+                if (self.eos is not None and t == self.eos) \
+                        or self._budget[i] <= 0:
+                    # mid-chunk finish: the device generated (and cached)
+                    # the rest of the chunk; those rows are unreachable
+                    # for any successor (reuse prefills from row 0 and
+                    # the causal bound hides rows past the write head)
+                    self._retire(i)
+                    break
+            else:
+                continue
+        return True
+
+    def run(self):
+        """Drain queue + slots; returns {rid: (prompt, tokens)}."""
+        while self.pending:
+            self.step()
+        return {rid: (p, out) for rid, p, out in self.finished()}
+
+    def close(self):
+        """Hand the model back: restores train mode if the engine
+        flipped it at construction."""
+        if self._was_training:
+            self.model.train()
+            self._was_training = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -212,10 +212,22 @@ def apply_rotary_emb(x, cos, sin, position_offset=0):
             f"RoPE table overflow: positions [{position_offset}, "
             f"{position_offset + seq}) exceed table length {cos.shape[0]} "
             f"(max_position_embeddings)")
-    cos = jax.lax.dynamic_slice_in_dim(cos, position_offset, seq, 0)
-    sin = jax.lax.dynamic_slice_in_dim(sin, position_offset, seq, 0)
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if getattr(position_offset, "ndim", 0) == 1:
+        # per-row offsets [B] (continuous-batching decode: every slot sits
+        # at its own position) — gather per-(row, step) tables.  NOTE:
+        # traced offsets can't be range-checked here; an out-of-table
+        # position CLAMPS to the last row (jax gather semantics) instead
+        # of raising like the scalar path — drivers must bound positions
+        # against the table (ContinuousBatchingEngine validates max_len
+        # at construction)
+        pos = position_offset[:, None] + jnp.arange(seq)[None]   # [B, s]
+        cos = cos[pos][:, :, None, :]                            # [B,s,1,h]
+        sin = sin[pos][:, :, None, :]
+    else:
+        cos = jax.lax.dynamic_slice_in_dim(cos, position_offset, seq, 0)
+        sin = jax.lax.dynamic_slice_in_dim(sin, position_offset, seq, 0)
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
     half = x.shape[-1] // 2
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
