@@ -277,10 +277,71 @@ def _emit_eqn(b: _Builder, eqn):
                 and tuple(rb) == tuple(range(len(rb))):
             b.node("MatMul", ins, outs)
         else:
-            raise NotImplementedError(
-                f"onnx export: dot_general layout {p['dimension_numbers']} "
-                "(only numpy-style matmul is mapped; use jit.save/"
-                "StableHLO for this model)")
+            # GENERAL layout (attention q@k^T, context@v, ...): transpose
+            # each side to (batch..., free..., contract...) /
+            # (batch..., contract..., free...), flatten the groups,
+            # batched MatMul, reshape to the jax output layout
+            # (batch..., lhs_free..., rhs_free...) — which is exactly
+            # dot_general's result order, so no output transpose
+            lshape, rshape = lhs_aval.shape, rhs_aval.shape
+            lfree = [d for d in range(lr) if d not in lc and d not in lb]
+            rfree = [d for d in range(rr) if d not in rc and d not in rb]
+            lperm = list(lb) + lfree + list(lc)
+            rperm = list(rb) + list(rc) + rfree
+            bdims = [lshape[d] for d in lb]
+            M = int(np.prod([lshape[d] for d in lfree], dtype=np.int64)) \
+                if lfree else 1
+            N = int(np.prod([rshape[d] for d in rfree], dtype=np.int64)) \
+                if rfree else 1
+            K = int(np.prod([lshape[d] for d in lc], dtype=np.int64)) \
+                if lc else 1
+            lt, rt = b.fresh(), b.fresh()
+            b.node("Transpose", [ins[0]], [lt], perm=lperm)
+            b.node("Transpose", [ins[1]], [rt], perm=rperm)
+            l2, r2 = b.fresh(), b.fresh()
+            b.node("Reshape", [lt, b.add_initializer(
+                np.asarray(bdims + [M, K], np.int64))], [l2])
+            b.node("Reshape", [rt, b.add_initializer(
+                np.asarray(bdims + [K, N], np.int64))], [r2])
+            mm = b.fresh()
+            b.node("MatMul", [l2, r2], [mm])
+            out_shape = b.add_initializer(
+                np.asarray(eqn.outvars[0].aval.shape, np.int64))
+            b.node("Reshape", [mm, out_shape], outs)
+    elif prim == "split":
+        sizes = list(p["sizes"])
+        ax = b.add_initializer(np.asarray(sizes, np.int64),
+                               b.fresh("splits"))
+        b.node("Split", [ins[0], ax], outs, axis=int(p["axis"]))
+    elif prim == "concatenate":
+        b.node("Concat", ins, outs, axis=int(p["dimension"]))
+    elif prim == "iota":
+        # static shape: materialize as an initializer (arange broadcast
+        # along `dimension`)
+        shape = tuple(p["shape"])
+        dim = int(p["dimension"])
+        dt = np.dtype(p["dtype"])
+        if str(dt) == "bfloat16":
+            dt = np.dtype(np.float32)
+        vec = np.arange(shape[dim], dtype=dt)
+        arr = np.broadcast_to(
+            vec.reshape([-1 if i == dim else 1
+                         for i in range(len(shape))]), shape).copy()
+        b.node("Identity", [b.add_initializer(arr)], outs)
+    elif prim == "slice":
+        starts = list(p["start_indices"])
+        ends = list(p["limit_indices"])
+        steps = list(p["strides"] or [1] * len(starts))
+        axes = list(range(len(starts)))
+        b.node("Slice",
+               [ins[0],
+                b.add_initializer(np.asarray(starts, np.int64)),
+                b.add_initializer(np.asarray(ends, np.int64)),
+                b.add_initializer(np.asarray(axes, np.int64)),
+                b.add_initializer(np.asarray(steps, np.int64))], outs)
+    elif prim == "expand_dims":
+        axes = b.add_initializer(np.asarray(p["dimensions"], np.int64))
+        b.node("Unsqueeze", [ins[0], axes], outs)
     elif prim == "conv_general_dilated":
         dn = p["dimension_numbers"]
         if (dn.lhs_spec[:2] != (0, 1)) or (dn.rhs_spec[:2] != (0, 1)):
