@@ -32,7 +32,7 @@ def main():
     # all_to_all | all_to_all_index); the a2a modes run through shard_map
     # on a 1-device (ep,) mesh — the same program the multichip dryrun
     # compiles at ep=8
-    mode = os.environ.get("PT_MOE_DISPATCH", "ragged")
+    mode = os.environ.get("PT_MOE_DISPATCH", "all_to_all_index")
     mesh = None
     if mode.startswith("all_to_all"):
         from jax.sharding import Mesh

@@ -313,3 +313,85 @@ class TestErnie:
         rules = ErnieForCausalLM.partition_specs(cfg)
         assert ErnieForCausalLM.spec_for(
             "model.layers_1.moe.experts.w1", rules) == P("ep", None, "tp")
+
+
+class TestConvFamilyTraining:
+    """Conv-family models train to a loss drop (the vision-zoo models the
+    conv_train_bench measures; VERDICT r4 Next #3)."""
+
+    def test_resnet18_reduces_loss(self):
+        from paddle_tpu.vision.models import resnet18
+        pp.seed(0)
+        net = resnet18(num_classes=4)
+        opt = pp.optimizer.Momentum(learning_rate=5e-3,
+                                    parameters=net.parameters())
+
+        def loss_fn(out, y):
+            return pp.nn.functional.cross_entropy(out, y)
+
+        step = TrainStep(net, opt, loss_fn=loss_fn)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 3, 32, 32)).astype("float32")
+        y = (np.arange(8) % 4).astype("int64")
+        losses = [float(step((x, y))) for _ in range(8)]
+        assert losses[-1] < losses[0], losses
+
+    def test_crnn_ctc_reduces_loss(self):
+        """conv backbone -> BiLSTM -> CTC (the PP-OCR recognizer shape)
+        trains: loss drops over a few steps on a fixed batch."""
+        import jax
+        import jax.numpy as jnp
+        import functools
+        from paddle_tpu.core.dispatch import unwrap
+        from paddle_tpu.core.functional import functional_call, params_of
+        from paddle_tpu.nn import functional as F
+        import paddle_tpu.nn as nn
+        from paddle_tpu.nn.layer import Layer
+
+        class CRNN(Layer):
+            def __init__(self):
+                super().__init__()
+                self.net = nn.Sequential(
+                    nn.Conv2D(3, 16, 3, stride=2, padding=1), nn.ReLU(),
+                    nn.Conv2D(16, 32, 3, stride=(2, 1), padding=1),
+                    nn.ReLU(),
+                    nn.Conv2D(32, 32, (8, 1), stride=1, padding=0),
+                    nn.ReLU(),
+                )
+                self.rnn = nn.LSTM(32, 24, direction="bidirectional")
+                self.head = nn.Linear(48, 11)
+
+            def forward(self, x):
+                feat = unwrap(self.net(x))                # [b, C, 1, W']
+                seq = feat[:, :, 0, :].transpose(0, 2, 1)
+                out, _ = self.rnn(pp.Tensor(seq))
+                logits = unwrap(self.head(out))
+                return jax.nn.log_softmax(
+                    logits.astype(jnp.float32), -1).transpose(1, 0, 2)
+
+        pp.seed(1)
+        model = CRNN()
+        params = params_of(model)
+        rng = np.random.default_rng(0)
+        b, L = 4, 5
+        x = jnp.asarray(rng.normal(size=(b, 3, 32, 32)), jnp.float32)
+        labels = jnp.asarray(rng.integers(1, 10, (b, L)), jnp.int32)
+
+        def loss_of(ps):
+            logp = unwrap(functional_call(model, ps, pp.Tensor(x)))
+            T = logp.shape[0]
+            return unwrap(F.ctc_loss(
+                logp, labels, jnp.full((b,), T, jnp.int32),
+                jnp.full((b,), L, jnp.int32), blank=0, reduction="mean"))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(ps):
+            l, g = jax.value_and_grad(loss_of)(ps)
+            return l, jax.tree.map(lambda p, gr: p - 0.01 * gr, ps, g)
+
+        losses = []
+        for _ in range(8):
+            l, params = step(params)
+            losses.append(float(l))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
