@@ -51,6 +51,32 @@ def pvary(x, axis_name: str):
     return x
 
 
+def pvary_like(x, ref, fallback_axes=()):
+    """Vary `x` over every manual axis `ref` is varying over — the right
+    seed for a scan accumulator that will be combined with `ref` inside a
+    shard_map spanning MULTIPLE mesh axes (e.g. ring attention on an
+    (sp, tp) mesh: the kv blocks vary over both axes, so the running
+    o/m/l must too, or the scan carry types diverge).  On jax builds
+    without ``jax.typeof`` the ref's axes can't be inspected —
+    ``fallback_axes`` (the axes the caller KNOWS are in play) keep the
+    old pvary behavior there."""
+    if not hasattr(jax, "typeof"):
+        missing = tuple(fallback_axes)
+    else:
+        want = getattr(jax.typeof(ref), "vma", None)
+        have = getattr(jax.typeof(x), "vma", None)
+        if not want:
+            return x
+        missing = tuple(a for a in want if have is None or a not in have)
+    if not missing:
+        return x
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, missing, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, missing)
+    return x
+
+
 class ReduceOp:
     SUM = "sum"
     MAX = "max"

@@ -80,10 +80,16 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
 
-    from paddle_tpu.distributed.communication import pvary
-    o0 = pvary(jnp.zeros((b, h, s, d), jnp.float32), axis_name)
-    m0 = pvary(jnp.full((b, h, s, 1), _NEG_INF, jnp.float32), axis_name)
-    l0 = pvary(jnp.zeros((b, h, s, 1), jnp.float32), axis_name)
+    from paddle_tpu.distributed.communication import pvary_like
+    # accumulators must vary over EVERY manual axis the kv blocks vary
+    # over (not just the ring axis) — on an (sp, tp) mesh the heads are
+    # tp-sharded and the carry types must agree across scan steps
+    o0 = pvary_like(jnp.zeros((b, h, s, d), jnp.float32), qf,
+                    fallback_axes=(axis_name,))
+    m0 = pvary_like(jnp.full((b, h, s, 1), _NEG_INF, jnp.float32), qf,
+                    fallback_axes=(axis_name,))
+    l0 = pvary_like(jnp.zeros((b, h, s, 1), jnp.float32), qf,
+                    fallback_axes=(axis_name,))
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
                                   jnp.arange(sp))
     safe_l = jnp.where(l > 0, l, 1.0)
