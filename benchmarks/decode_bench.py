@@ -67,6 +67,48 @@ def main():
                    "wall_full_s": round(t_full, 3),
                    "wall_half_s": round(t_half, 3)}}), flush=True)
 
+    # continuous batching + int8: a realistic request stream (mixed
+    # prompt/response lengths) through the slot-reuse engine — the thing
+    # that separates a serving engine from a fixed-batch loop
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+
+    def stream_bench(int8: bool):
+        K = 16 if on_tpu else 2
+        eng = ContinuousBatchingEngine(
+            model, slots=batch, max_len=prompt_len + new_tokens + K + 2,
+            prefill_buckets=(32, 64, 128) if on_tpu else (8, 16),
+            int8_weights=int8, steps_per_sync=K)
+        rng2 = np.random.default_rng(7)
+        n_req = 3 * batch
+        lens = rng2.integers(prompt_len // 2, prompt_len + 1, n_req)
+        news = rng2.integers(new_tokens // 2, new_tokens + 1, n_req)
+        # warm every executable (both buckets + the decode step)
+        eng.add_request(rng2.integers(0, cfg.vocab_size,
+                                      (prompt_len // 2,)), 4)
+        eng.add_request(rng2.integers(0, cfg.vocab_size,
+                                      (prompt_len,)), 4)
+        eng.run()
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            eng.add_request(
+                rng2.integers(0, cfg.vocab_size, (int(lens[i]),)),
+                int(news[i]))
+        results = eng.run()
+        dt = time.perf_counter() - t0
+        total = sum(len(v[1]) for v in results.values())
+        print(json.dumps({
+            "metric": ("decode_continuous_int8_tokens_per_sec" if int8
+                       else "decode_continuous_tokens_per_sec"),
+            "value": round(total / dt, 1), "unit": "tok/s",
+            "detail": {"slots": batch, "requests": n_req,
+                       "generated_tokens": total,
+                       "wall_s": round(dt, 3),
+                       "steps_per_sync": K,
+                       "int8_weights": int8}}), flush=True)
+
+    stream_bench(int8=False)
+    stream_bench(int8=True)
+
 
 if __name__ == "__main__":
     main()
