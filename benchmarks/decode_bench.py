@@ -73,7 +73,8 @@ def main():
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
 
     def stream_bench(int8: bool):
-        K = 16 if on_tpu else 2
+        import os as _os
+        K = int(_os.environ.get("PT_SERVE_K", "16")) if on_tpu else 2
         eng = ContinuousBatchingEngine(
             model, slots=batch, max_len=prompt_len + new_tokens + K + 2,
             prefill_buckets=(32, 64, 128) if on_tpu else (8, 16),
