@@ -27,7 +27,13 @@ visible through the rewritten NAME, not through other references to the
 same container.  Genuinely dynamic structure (data-dependent shapes,
 `return` of differently-typed values per branch, iteration over traced
 non-range iterables) still raises a clear error at trace time, like the
-reference's transformer diagnostics.
+reference's transformer diagnostics.  Nested function defs (used within
+their scope) and ``try/except`` convert fine — the try executes at
+trace time and its control-flow statements get the standard rewrites.
+A function DEF whose name must escape a converted branch is the
+documented exception (function values cannot ride a lax.cond carry):
+the name fails at its use site; define the variants before the if and
+branch on data instead.
 """
 
 from __future__ import annotations
@@ -763,7 +769,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         assigned = _assigned_names(node.body + node.orelse)
         if not assigned:
             return node  # side-effect-free on locals: keep as-is (eager
-            # semantics; traced conditions without assignment are rare)
+            # semantics; traced conditions without assignment are rare).
+            # NOTE a def/class statement in such a branch stays plain
+            # Python too: fine under a concrete condition; under a traced
+            # one the generic TracerBoolConversionError surfaces.  A def
+            # whose NAME is read after a CONVERTED if fails at the use
+            # site with NameError — function values cannot ride a
+            # lax.cond carry; define variants before the if instead.
         _check_no_flow_escape(node.body + node.orelse, "if")
         tname = self._fresh("true")
         fname = self._fresh("false")
