@@ -52,6 +52,7 @@ class ErnieConfig:
     type_vocab_size: int = 4
     layer_norm_eps: float = 1e-12
     pad_token_id: int = 0
+    initializer_range: float = 0.02   # reference init_weights normal std
     dtype: str = "float32"
 
     @staticmethod
@@ -116,6 +117,14 @@ class ErnieModel(Layer):
                                                c.hidden_size)
         self.embed_norm = LayerNorm(c.hidden_size, epsilon=c.layer_norm_eps)
         self.embed_dropout = Dropout(c.hidden_dropout_prob)
+        # reference init_weights: every embedding table is
+        # Normal(0, initializer_range).  nn.Embedding's paddle-parity
+        # default is N(0, 1) (drawn from the seeded stream) — scale it,
+        # keeping seed-reproducibility, or tied-embedding MLM logits run
+        # ~1/initializer_range too hot at init
+        for emb in (self.word_embeddings, self.position_embeddings,
+                    self.token_type_embeddings):
+            emb.weight._set_data(emb.weight._data * c.initializer_range)
         self.layers = []
         for i in range(c.num_hidden_layers):
             layer = _ErnieEncoderLayer(c)
@@ -164,21 +173,30 @@ class ErnieForMaskedLM(Layer):
         self.norm = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
+    def _features(self, input_ids, token_type_ids=None, attn_mask=None):
+        """Encoder + MLM head transform — the single home forward and
+        loss share (the head feeds either the tied-logits matmul or the
+        fused CE)."""
         h, _ = self.ernie(input_ids, token_type_ids, attn_mask)
+        return self.norm(F.gelu(self.transform(h)))
+
+    def forward(self, input_ids, token_type_ids=None, attn_mask=None):
         from paddle_tpu.ops import linalg as L
-        h = self.norm(F.gelu(self.transform(h)))
+        h = self._features(input_ids, token_type_ids, attn_mask)
         return L.matmul(h, self.ernie.word_embeddings.weight,
                         transpose_y=True)
 
     def loss(self, input_ids, labels, ignore_index: int = -100):
-        """Masked-token CE; positions with label==ignore_index are
-        excluded (the unmasked 85%)."""
-        logits = self(input_ids)
-        v = logits.shape[-1]
-        return F.cross_entropy(M.reshape(logits, [-1, v]),
-                               M.reshape(labels, [-1]),
-                               ignore_index=ignore_index)
+        """Masked-token CE via the fused chunked lm-head+CE — the
+        [T, V] fp32 logits are never materialized (same memory trick as
+        the Llama objective; positions with label==ignore_index, the
+        unmasked 85%, contribute neither loss nor gradient)."""
+        h = self._features(input_ids)
+        d = h.shape[-1]
+        return F.fused_linear_cross_entropy(
+            M.reshape(h, [-1, d]),
+            self.ernie.word_embeddings.weight.t(),
+            M.reshape(labels, [-1]), ignore_index=ignore_index)
 
 
 # -- ERNIE 4.5: heterogeneous-MoE decoder -------------------------------------
