@@ -73,7 +73,9 @@ class _Request:
 
 
 class ContinuousBatchingEngine:
-    """Greedy decode over ``slots`` concurrent sequences with slot reuse.
+    """Decode over ``slots`` concurrent sequences with slot reuse —
+    greedy by default, or sampled (``do_sample=True`` with
+    temperature / top-k / nucleus, the generation module's sampler).
 
     add_request() enqueues; step() either admits a queued request into a
     free slot (bucketed prefill) or advances every active slot by one
@@ -85,8 +87,11 @@ class ContinuousBatchingEngine:
                  prefill_buckets: Sequence[int] = (32, 64, 128, 256),
                  eos_token_id: Optional[int] = None,
                  int8_weights: bool = False,
-                 steps_per_sync: int = 1):
+                 steps_per_sync: int = 1,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
         from paddle_tpu.core.functional import functional_call, params_of
+        from paddle_tpu.generation import GenerationConfig as _GC
 
         self.model = model
         self.slots = slots
@@ -99,6 +104,14 @@ class ContinuousBatchingEngine:
         # finishing mid-chunk over-generate < K tokens (truncated by the
         # host; the wasted rows are unreachable for successors, see step())
         self.steps_per_sync = max(1, int(steps_per_sync))
+        # sampling config shared by prefill + decode (the generation
+        # module's _sample: temperature / top-k / nucleus; greedy when
+        # do_sample=False).  One key stream serves the whole pool —
+        # jax.random.categorical draws rows independently
+        self._gen_cfg = _GC(do_sample=do_sample, temperature=temperature,
+                            top_k=top_k, top_p=top_p)
+        self._key = jax.random.PRNGKey(seed)
+        self._do_sample = do_sample
         table = getattr(model.config, "max_position_embeddings", None)
         if table is not None and max_len > table:
             # the per-row RoPE gather CLAMPS out-of-range positions
@@ -155,11 +168,15 @@ class ContinuousBatchingEngine:
 
         import functools as _ft
 
+        from paddle_tpu.generation import _sample
+        gen_cfg = self._gen_cfg
+
         @_ft.partial(jax.jit, donate_argnums=(3,))
-        def prefill(keep, quant, ids, caches1, true_len):
+        def prefill(keep, quant, ids, caches1, true_len, key):
             ps = _dequant(keep, quant, dtype)
             logits, new_caches = fwd(ps, ids, caches1, 0)
-            first = jnp.argmax(logits[0, true_len - 1], axis=-1)
+            first = _sample(logits[0, true_len - 1][None], gen_cfg,
+                            key)[0]
             return first.astype(jnp.int32), new_caches
 
         @_ft.partial(jax.jit, donate_argnums=(0, 1))
@@ -176,26 +193,36 @@ class ContinuousBatchingEngine:
         K = self.steps_per_sync
 
         @_ft.partial(jax.jit, donate_argnums=(2,))
-        def decode(keep, quant, caches, toks, pos, active):
+        def decode(keep, quant, caches, toks, pos, active, key):
             ps = _dequant(keep, quant, dtype)
 
             def one(carry, _):
-                caches, toks, pos = carry
+                caches, toks, pos, key = carry
                 logits, caches = fwd(ps, toks[:, None], caches, pos)
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                key, sub = jax.random.split(key)
+                nxt = _sample(logits[:, -1], gen_cfg,
+                              sub).astype(jnp.int32)
                 # inactive slots run with pos pinned to the scratch row
                 # max_len-1 (set by the host) and a frozen token; their
                 # pos must NOT advance inside the chunk
                 nxt = jnp.where(active, nxt, toks)
                 pos = jnp.where(active, pos + 1, pos)
-                return (caches, nxt, pos), nxt
+                return (caches, nxt, pos, key), nxt
 
-            (caches, _, _), seq = jax.lax.scan(
-                one, (caches, toks, pos), None, length=K)
+            (caches, _, _, _), seq = jax.lax.scan(
+                one, (caches, toks, pos, key), None, length=K)
             return jnp.swapaxes(seq, 0, 1), caches   # [B, K]
 
         self._prefill, self._insert, self._decode = prefill, insert, decode
         self._fwd = fwd
+
+    def _next_key(self):
+        """Advance the sampling stream — greedy mode skips the split
+        (the key is dead in _sample there; no per-step dispatch)."""
+        if not self._do_sample:
+            return self._key
+        self._key, sub = jax.random.split(self._key)
+        return sub
 
     # -- public API ----------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens: int = 64) -> int:
@@ -249,9 +276,10 @@ class ContinuousBatchingEngine:
         kv1 = [(jnp.zeros(shape1, self._dtype), jnp.zeros(shape1,
                                                           self._dtype))
                for _ in range(cfgm.num_hidden_layers)]
+        sub = self._next_key()
         first, caches1 = self._prefill(self._keep, self._quant,
                                        jnp.asarray(ids), kv1,
-                                       jnp.asarray(Lp, jnp.int32))
+                                       jnp.asarray(Lp, jnp.int32), sub)
         self._caches = self._insert(self._caches, caches1,
                                     jnp.asarray(slot, jnp.int32))
         first = int(first)
@@ -282,10 +310,11 @@ class ContinuousBatchingEngine:
         # their write lands on max_len-1 which no active sequence can
         # reach (add_request enforces prompt+new <= max_len <= row max)
         pos = np.where(active, self._pos, self.max_len - 1).astype(np.int32)
+        sub = self._next_key()
         toks, self._caches = self._decode(
             self._keep, self._quant, self._caches,
             jnp.asarray(self._last_tok), jnp.asarray(pos),
-            jnp.asarray(active))
+            jnp.asarray(active), sub)
         toks = np.asarray(toks)                         # [B, K]
         K = toks.shape[1]
         for i, req in enumerate(self._active):

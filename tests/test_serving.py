@@ -166,3 +166,42 @@ class TestChunkedDecode:
             assert tiny_model.training
         finally:
             tiny_model.eval()
+
+
+class TestSampling:
+    def test_near_zero_temperature_matches_greedy(self, tiny_model):
+        """do_sample with temperature -> 0 degenerates to argmax: exact
+        parity with the greedy reference at every step."""
+        rng = np.random.default_rng(11)
+        prompt = rng.integers(0, 256, (9,))
+        eng = ContinuousBatchingEngine(tiny_model, slots=1, max_len=48,
+                                       prefill_buckets=(16,),
+                                       do_sample=True, temperature=1e-6)
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        results = eng.run()
+        assert results[rid][1] == _reference(tiny_model, prompt, 6)
+
+    def test_sampling_varies_with_seed_and_stays_in_vocab(self, tiny_model):
+        rng = np.random.default_rng(12)
+        prompt = rng.integers(0, 256, (8,))
+        outs = []
+        for seed in (0, 1):
+            eng = ContinuousBatchingEngine(
+                tiny_model, slots=1, max_len=48, prefill_buckets=(16,),
+                do_sample=True, temperature=1.0, top_k=50, seed=seed)
+            rid = eng.add_request(prompt, max_new_tokens=12)
+            outs.append(eng.run()[rid][1])
+        assert all(0 <= t < 256 for o in outs for t in o)
+        assert outs[0] != outs[1], "two seeds produced identical samples"
+
+    def test_sampled_chunked_decode(self, tiny_model):
+        """Sampling + steps_per_sync compose (key threads the scan)."""
+        rng = np.random.default_rng(13)
+        eng = ContinuousBatchingEngine(
+            tiny_model, slots=2, max_len=48, prefill_buckets=(16,),
+            do_sample=True, temperature=0.8, top_p=0.95, seed=3,
+            steps_per_sync=4)
+        rids = [eng.add_request(rng.integers(0, 256, (n,)), 8)
+                for n in (6, 10)]
+        results = eng.run()
+        assert all(len(results[r][1]) == 8 for r in rids)
