@@ -57,6 +57,7 @@ from paddle_tpu import framework  # noqa: F401,E402
 import paddle_tpu.fft  # noqa: F401,E402
 from paddle_tpu import geometric  # noqa: F401,E402
 from paddle_tpu import hapi  # noqa: F401,E402
+from paddle_tpu import analysis  # noqa: F401,E402
 from paddle_tpu import incubate  # noqa: F401,E402
 from paddle_tpu.hapi import Model  # noqa: F401,E402
 from paddle_tpu.hapi.summary import flops, summary  # noqa: F401,E402

@@ -1,0 +1,101 @@
+"""paddle_tpu.analysis — jaxpr-level program linter, cost model and
+sharding-consistency checker.
+
+The reference framework's static-graph ProgramDesc enables whole-program
+passes (validation, fusion planning, auto-parallel checks); our IR is
+the jaxpr every ``to_static`` / ``TrainStep`` / predictor path already
+produces.  This package traces any Layer / function / TrainStep
+abstractly (no FLOPs run) and drives a pluggable pass pipeline over the
+resulting ``ClosedJaxpr``, reporting structured ``Diagnostic``s.
+
+    import paddle_tpu.analysis as analysis
+    report = analysis.check(model, ids)           # runs all five passes
+    print(report)
+    report.extras["cost"].table()                 # FLOPs/bytes roll-up
+
+Opt-in hooks (``analyze="warn"|"strict"`` kwargs, or the
+``PADDLE_TPU_ANALYZE`` env var) live in ``jit.to_static``,
+``jit.TrainStep``, ``inference.NativePredictor`` and
+``inference.ContinuousBatchingEngine``; strict mode raises
+``AnalysisError`` on ERROR-severity findings.  CLI:
+``python -m paddle_tpu.analysis.lint module:symbol --spec int32[2,16]``.
+
+Writing a custom pass: see paddle_tpu/analysis/README.md.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from paddle_tpu.analysis.diagnostics import (AnalysisError, AnalysisReport,
+                                             Diagnostic, Severity, dedup)
+from paddle_tpu.analysis.recompile import (SignatureMonitor,
+                                           enable_recompile_monitoring,
+                                           monitor_recompiles,
+                                           monitoring_enabled)
+from paddle_tpu.analysis.tracing import TraceResult, trace, walk_eqns
+from paddle_tpu.analysis.passes import (DEFAULT_PASSES, PassContext,
+                                        all_passes, get_pass, register_pass)
+
+__all__ = [
+    "check", "run_passes", "trace", "walk_eqns",
+    "Diagnostic", "Severity", "AnalysisReport", "AnalysisError",
+    "PassContext", "register_pass", "all_passes", "DEFAULT_PASSES",
+    "SignatureMonitor", "enable_recompile_monitoring",
+    "monitor_recompiles", "monitoring_enabled",
+    "analysis_mode", "check_artifact",
+]
+
+
+def analysis_mode() -> Optional[str]:
+    """Global opt-in from the environment: '' (off — default), 'warn'
+    (run passes on hook points, print findings), 'strict' (raise
+    AnalysisError on ERROR findings)."""
+    v = os.environ.get("PADDLE_TPU_ANALYZE", "").strip().lower()
+    if v in ("", "0", "off", "false"):
+        return None
+    return "strict" if v == "strict" else "warn"
+
+
+def run_passes(tr: TraceResult, passes: Optional[List[str]] = None,
+               options: Optional[Dict] = None) -> AnalysisReport:
+    """Drive the pass pipeline over an existing trace."""
+    report = AnalysisReport(target=tr.target_name)
+    ctx = PassContext(trace=tr, options=dict(options or {}))
+    for pass_id in (passes or DEFAULT_PASSES):
+        fn = get_pass(pass_id)
+        report.extend(fn(ctx))
+        report.passes_run.append(pass_id)
+    report.extras.update(ctx.extras)
+    return report
+
+
+def check(fn_or_layer, *example_args, passes: Optional[List[str]] = None,
+          method: Optional[str] = None, param_specs: Optional[Dict] = None,
+          mesh=None, options: Optional[Dict] = None, strict: bool = False,
+          **example_kwargs) -> AnalysisReport:
+    """Trace ``fn_or_layer`` with ``example_args`` and run the pass
+    pipeline (all five built-ins by default).
+
+    Accepts an ``nn.Layer`` (``method=`` selects e.g. ``"loss"``), a
+    ``jit.TrainStep`` (pass one example batch), a ``to_static``-wrapped
+    callable, or a plain function.  ``param_specs`` maps parameter names
+    (or suffix patterns, as in ``LlamaForCausalLM.partition_specs``) to
+    PartitionSpecs for the sharding pass; a TrainStep's placement and
+    mpu layers' ``partition_spec`` annotations are picked up
+    automatically.  ``strict=True`` raises ``AnalysisError`` when any
+    ERROR-severity finding survives.
+    """
+    tr = trace(fn_or_layer, *example_args, method=method,
+               param_specs=param_specs, mesh=mesh, **example_kwargs)
+    report = run_passes(tr, passes=passes, options=options)
+    if strict:
+        report.raise_on_error()
+    return report
+
+
+def check_artifact(model_prefix: str, strict: bool = False):
+    """Lint a ``jit.save`` artifact (see analysis/artifact.py)."""
+    from paddle_tpu.analysis.artifact import check_artifact as _impl
+    return _impl(model_prefix, strict=strict)

@@ -1,0 +1,88 @@
+"""Lint a ``jit.save`` artifact without executing it.
+
+The native predictor (csrc/predictor) compiles the saved StableHLO
+straight through PJRT — by then a bad artifact is a runtime failure on
+the serving fleet.  This checks the ``.pdmeta`` / ``.pdstablehlo`` pair
+at load (or CI) time: fp64 anywhere in the module, fp64/dynamic input
+specs, and missing artifact pieces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List
+
+from paddle_tpu.analysis.diagnostics import (AnalysisReport, Diagnostic,
+                                             Severity)
+
+__all__ = ["check_artifact"]
+
+
+def check_artifact(model_prefix: str, strict: bool = False) -> AnalysisReport:
+    report = AnalysisReport(target=model_prefix)
+    diags: List[Diagnostic] = report.diagnostics
+    report.passes_run.append("artifact-lint")
+
+    meta_path = model_prefix + ".pdmeta"
+    hlo_path = model_prefix + ".pdstablehlo"
+    if not os.path.exists(meta_path):
+        diags.append(Diagnostic(
+            "artifact-lint", Severity.ERROR,
+            f"missing {meta_path} — not a jit.save artifact", meta_path,
+            hint="re-export with paddle_tpu.jit.save(layer, prefix, "
+                 "input_spec=[...])"))
+        if strict:
+            report.raise_on_error()
+        return report
+
+    with open(meta_path) as f:
+        meta = json.load(f)
+    for i, spec in enumerate(meta.get("inputs", [])):
+        dtype = str(spec.get("dtype", ""))
+        name = (meta.get("input_names") or [f"x{i}"] * (i + 1))[i] \
+            if i < len(meta.get("input_names", [])) else f"x{i}"
+        if dtype == "float64":
+            diags.append(Diagnostic(
+                "artifact-lint", Severity.ERROR,
+                f"input '{name}' is float64", name,
+                hint="re-save with f32/bf16 InputSpec; the predictor "
+                     "path has no fp64 fast path"))
+        if any(not isinstance(d, int) for d in spec.get("shape", [])):
+            diags.append(Diagnostic(
+                "artifact-lint", Severity.WARNING,
+                f"input '{name}' has symbolic dims "
+                f"{spec.get('shape')} — the NATIVE predictor requires "
+                f"static shapes (jax-side load still works)", name,
+                hint="save with concrete InputSpec shapes for C++ "
+                     "serving"))
+
+    if os.path.exists(hlo_path):
+        with open(hlo_path) as f:
+            hlo = f.read()
+        n_f64 = len(re.findall(r"\bf64\b", hlo))
+        if n_f64:
+            diags.append(Diagnostic(
+                "artifact-lint", Severity.ERROR,
+                f"StableHLO module uses f64 in {n_f64} place(s)",
+                hlo_path,
+                hint="a np.float64 scalar or x64-enabled trace leaked "
+                     "into the export; re-trace in f32/bf16"))
+        for coll in ("all_gather", "all_to_all"):
+            n = hlo.count(f"stablehlo.{coll}") + hlo.count(f"\"{coll}\"")
+            if n:
+                diags.append(Diagnostic(
+                    "artifact-lint", Severity.INFO,
+                    f"module contains {n} {coll} collective(s)",
+                    hlo_path,
+                    hint="expected for sharded exports; audit if this "
+                         "artifact is meant to be single-chip"))
+    else:
+        diags.append(Diagnostic(
+            "artifact-lint", Severity.INFO,
+            f"no {hlo_path} — StableHLO text checks skipped", hlo_path))
+
+    if strict:
+        report.raise_on_error()
+    return report

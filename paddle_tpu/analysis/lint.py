@@ -1,0 +1,99 @@
+"""CLI linter: ``python -m paddle_tpu.analysis.lint module:symbol``.
+
+Resolves ``symbol`` (a function, an ``nn.Layer`` instance, or a Layer
+class — classes are instantiated with the evaluated ``--init``
+expression), builds example inputs from ``--spec dtype[d0,d1,...]``
+arguments, runs the full pass pipeline, prints the report and the cost
+roll-up, and exits non-zero on ERROR findings (or on WARNINGs too with
+``--strict``).
+
+    python -m paddle_tpu.analysis.lint \\
+        paddle_tpu.models.llama:LlamaForCausalLM \\
+        --init "LlamaConfig.tiny()" --spec int32[2,16]
+
+    python -m paddle_tpu.analysis.lint mymodule:my_to_static_fn \\
+        --spec float32[8,128] --passes dtype-promotion,dead-code
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import re
+import sys
+
+
+def parse_spec(text: str):
+    """'int32[2,16]' → ShapeDtypeStruct((2, 16), int32)."""
+    import jax
+    from paddle_tpu.core.dtypes import to_jax
+    m = re.fullmatch(r"([A-Za-z0-9_]+)\[([0-9,\s]*)\]", text.strip())
+    if not m:
+        raise SystemExit(
+            f"bad --spec '{text}' (expected dtype[d0,d1,...], "
+            f"e.g. int32[2,16] or float32[])")
+    dtype = to_jax(m.group(1))
+    dims = tuple(int(d) for d in m.group(2).split(",") if d.strip())
+    return jax.ShapeDtypeStruct(dims, dtype)
+
+
+def resolve(target: str, init_expr=None):
+    if ":" not in target:
+        raise SystemExit(f"target must be module:symbol, got '{target}'")
+    mod_name, sym = target.split(":", 1)
+    sys.path.insert(0, os.getcwd())
+    mod = importlib.import_module(mod_name)
+    obj = mod
+    for part in sym.split("."):
+        obj = getattr(obj, part)
+    if inspect.isclass(obj):
+        if init_expr:
+            init = eval(init_expr, vars(mod))  # noqa: S307 — operator CLI
+            obj = obj(*init) if isinstance(init, tuple) else obj(init)
+        else:
+            obj = obj()
+    return obj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis.lint",
+        description="jaxpr-level program linter / cost model")
+    ap.add_argument("target", help="module:symbol (fn, Layer, or class)")
+    ap.add_argument("--spec", action="append", default=[],
+                    help="example input as dtype[dims], repeatable")
+    ap.add_argument("--init", default=None,
+                    help="python expr (eval'd in the module) passed to a "
+                         "class target's constructor")
+    ap.add_argument("--method", default=None,
+                    help="trace this bound method instead of forward")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass ids (default: all five)")
+    ap.add_argument("--strict", action="store_true",
+                    help="non-zero exit on WARNINGs too")
+    ap.add_argument("--no-cost-table", action="store_true")
+    args = ap.parse_args(argv)
+
+    import paddle_tpu.analysis as analysis
+
+    obj = resolve(args.target, args.init)
+    example = [parse_spec(s) for s in args.spec]
+    passes = args.passes.split(",") if args.passes else None
+    report = analysis.check(obj, *example, method=args.method,
+                            passes=passes)
+    print(report.format())
+    cost = report.extras.get("cost")
+    if cost is not None and not args.no_cost_table:
+        print()
+        print(cost.table())
+    if report.errors():
+        return 1
+    if args.strict and report.warnings():
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Pluggable pass pipeline over a traced program.
+
+Reference role: the IR pass registry (framework/ir/pass.h ``REGISTER_PASS``)
+— here a pass is any callable ``(PassContext) -> List[Diagnostic]``
+registered under a string id.  Built-in passes self-register on import;
+custom passes use the same decorator (see paddle_tpu/analysis/README.md):
+
+    from paddle_tpu.analysis import register_pass, Diagnostic, Severity
+
+    @register_pass("my-check")
+    def my_check(ctx):
+        return [Diagnostic("my-check", Severity.WARNING, "...")]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+from paddle_tpu.analysis.diagnostics import Diagnostic
+
+__all__ = ["PassContext", "register_pass", "get_pass", "all_passes",
+           "DEFAULT_PASSES"]
+
+
+@dataclasses.dataclass
+class PassContext:
+    """Everything a pass may look at.  ``trace`` is the TraceResult
+    (closed jaxpr + invar names + partition specs + mesh); ``options``
+    carries per-run tuning (e.g. the cost model's ridge point)."""
+
+    trace: Any
+    options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # passes park structured results here (cost model → extras['cost']);
+    # the runner merges it into AnalysisReport.extras
+    extras: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def closed(self):
+        return self.trace.closed
+
+    @property
+    def jaxpr(self):
+        return self.trace.closed.jaxpr
+
+    def opt(self, key: str, default=None):
+        return self.options.get(key, default)
+
+
+_REGISTRY: Dict[str, Callable[[PassContext], List[Diagnostic]]] = {}
+
+# pipeline order: cheap structural checks first, cost roll-up last so its
+# report can mention findings of earlier passes in extras
+DEFAULT_PASSES = [
+    "recompile-hazard",
+    "dtype-promotion",
+    "dead-code",
+    "sharding-consistency",
+    "cost-model",
+]
+
+
+def register_pass(pass_id: str):
+    def deco(fn):
+        _REGISTRY[pass_id] = fn
+        fn.pass_id = pass_id
+        return fn
+    return deco
+
+
+def get_pass(pass_id: str):
+    try:
+        return _REGISTRY[pass_id]
+    except KeyError:
+        raise KeyError(
+            f"unknown analysis pass '{pass_id}' "
+            f"(registered: {sorted(_REGISTRY)})") from None
+
+
+def all_passes() -> Dict[str, Callable]:
+    return dict(_REGISTRY)
+
+
+# built-ins self-register on import
+from paddle_tpu.analysis.passes import (  # noqa: E402,F401
+    cost_model, dead_code, dtype_promotion, recompile, sharding_consistency,
+)

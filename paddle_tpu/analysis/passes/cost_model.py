@@ -1,0 +1,220 @@
+"""Static cost model: per-eqn FLOPs / bytes and arithmetic intensity.
+
+The roofline coordinates of the program before XLA sees it: matmuls and
+convs get exact MAC counts from their dimension numbers, elementwise /
+reduction / transcendental prims get per-element estimates, and every
+eqn is charged the bytes of its operands + results.  Bytes are UNFUSED —
+XLA's fusion removes most intermediate traffic — so the roll-up's
+intensity is a lower bound: a program that is compute-bound here is
+compute-bound for real; one far below the ridge point is worth a look.
+
+The pass itself only emits hazard findings ("likely memory-bound"); the
+full roll-up lands in ``report.extras['cost']`` (a ``CostSummary``) and
+renders through ``profiler.format_diagnostics`` / the lint CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.tracing import walk_eqns, where_of
+
+# v5e-class defaults; override via check(..., options={'peak_flops': ...})
+DEFAULT_PEAK_FLOPS = 197e12          # bf16
+DEFAULT_HBM_BW = 819e9               # bytes/s
+
+_TRANSCENDENTAL = {
+    "exp", "log", "log1p", "expm1", "tanh", "erf", "erfc", "erf_inv",
+    "logistic", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "pow", "rsqrt", "cbrt", "digamma", "lgamma",
+}
+_DATA_MOVEMENT = {
+    "reshape", "transpose", "broadcast_in_dim", "slice", "squeeze",
+    "concatenate", "rev", "pad", "gather", "dynamic_slice",
+    "dynamic_update_slice", "convert_element_type", "bitcast_convert_type",
+    "iota", "copy", "stop_gradient", "select_n", "split",
+    "sharding_constraint", "device_put",
+}
+_REDUCTIONS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumprod", "cummax",
+    "cummin", "reduce_precision",
+}
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _nelems(aval) -> int:
+    try:
+        return int(np.prod(aval.shape))
+    except Exception:
+        return 0
+
+
+def _eqn_flops(eqn) -> int:
+    prim = eqn.primitive.name
+    outs = [v.aval for v in eqn.outvars if hasattr(v, "aval")]
+    out_elems = sum(_nelems(a) for a in outs)
+    if prim == "dot_general":
+        (lc, _rc), _batch = eqn.params["dimension_numbers"]
+        lhs = eqn.invars[0].aval
+        k = int(np.prod([lhs.shape[d] for d in lc])) if lc else 1
+        return 2 * out_elems * k
+    if prim == "conv_general_dilated":
+        rhs = eqn.invars[1].aval
+        dn = eqn.params["dimension_numbers"]
+        out_feat = rhs.shape[dn.rhs_spec[0]]
+        return 2 * out_elems * (_nelems(rhs) // max(out_feat, 1))
+    if prim in _DATA_MOVEMENT:
+        return 0
+    if prim.startswith("scatter"):
+        ups = eqn.invars[-1].aval
+        return _nelems(ups)
+    if prim in _REDUCTIONS:
+        return sum(_nelems(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval"))
+    if prim in _TRANSCENDENTAL:
+        return 10 * out_elems
+    if prim in ("sort", "top_k"):
+        n = max((_nelems(v.aval) for v in eqn.invars
+                 if hasattr(v, "aval")), default=0)
+        return int(n * max(np.log2(max(n, 2)), 1))
+    return out_elems  # generic elementwise
+
+
+def _eqn_bytes(eqn) -> int:
+    total = 0
+    for v in eqn.invars:
+        if hasattr(v, "aval") and not hasattr(v, "val"):  # skip literals
+            total += _nbytes(v.aval)
+    for v in eqn.outvars:
+        if hasattr(v, "aval"):
+            total += _nbytes(v.aval)
+    return total
+
+
+@dataclasses.dataclass
+class EqnCost:
+    prim: str
+    flops: int
+    bytes: int
+    where: str
+    path: str = ""
+
+    @property
+    def intensity(self) -> float:
+        return self.flops / self.bytes if self.bytes else float("inf")
+
+
+@dataclasses.dataclass
+class CostSummary:
+    total_flops: int
+    total_bytes: int
+    by_prim: Dict[str, Tuple[int, int, int]]   # prim -> (flops, bytes, n)
+    top: List[EqnCost]                         # heaviest eqns by flops
+    peak_flops: float = DEFAULT_PEAK_FLOPS
+    hbm_bw: float = DEFAULT_HBM_BW
+
+    @property
+    def intensity(self) -> float:
+        return self.total_flops / self.total_bytes if self.total_bytes \
+            else float("inf")
+
+    @property
+    def ridge(self) -> float:
+        return self.peak_flops / self.hbm_bw
+
+    @property
+    def compute_bound(self) -> bool:
+        return self.intensity >= self.ridge
+
+    def table(self, top_prims: int = 12) -> str:
+        lines = [f"{'primitive':28s} {'count':>7s} {'GFLOPs':>12s} "
+                 f"{'GB moved':>10s} {'flop/B':>8s}"]
+        ranked = sorted(self.by_prim.items(), key=lambda kv: -kv[1][0])
+        for prim, (fl, by, n) in ranked[:top_prims]:
+            inten = fl / by if by else float("inf")
+            lines.append(f"{prim:28s} {n:7d} {fl / 1e9:12.3f} "
+                         f"{by / 1e9:10.3f} {inten:8.1f}")
+        bound = "compute" if self.compute_bound else "memory"
+        lines.append(
+            f"{'TOTAL':28s} {sum(v[2] for v in self.by_prim.values()):7d} "
+            f"{self.total_flops / 1e9:12.3f} "
+            f"{self.total_bytes / 1e9:10.3f} {self.intensity:8.1f}")
+        lines.append(
+            f"arithmetic intensity {self.intensity:.1f} flop/B vs ridge "
+            f"{self.ridge:.0f} → likely {bound}-bound "
+            f"(unfused bytes; real traffic is lower)")
+        return "\n".join(lines)
+
+    def to_diagnostics(self) -> List[Diagnostic]:
+        """Roll-up as Diagnostics — what the profiler report renders."""
+        out = [Diagnostic(
+            "cost-model", Severity.INFO,
+            f"total {self.total_flops / 1e9:.2f} GFLOPs, "
+            f"{self.total_bytes / 1e9:.2f} GB moved (unfused), "
+            f"intensity {self.intensity:.1f} flop/B "
+            f"(ridge {self.ridge:.0f})")]
+        for prim, (fl, by, n) in sorted(self.by_prim.items(),
+                                        key=lambda kv: -kv[1][0])[:6]:
+            share = fl / self.total_flops if self.total_flops else 0.0
+            out.append(Diagnostic(
+                "cost-model", Severity.INFO,
+                f"{prim}: {fl / 1e9:.2f} GFLOPs ({share:.0%}), "
+                f"{by / 1e9:.2f} GB, ×{n}"))
+        return out
+
+
+@register_pass("cost-model")
+def cost_model(ctx: PassContext) -> List[Diagnostic]:
+    peak = float(ctx.opt("peak_flops", DEFAULT_PEAK_FLOPS))
+    bw = float(ctx.opt("hbm_bw", DEFAULT_HBM_BW))
+    by_prim: Dict[str, List[int]] = {}
+    top: List[EqnCost] = []
+    total_f = total_b = 0
+    from paddle_tpu.analysis.tracing import _subjaxprs
+    for eqn, path, weight in walk_eqns(ctx.jaxpr):
+        if _subjaxprs(eqn):
+            # container eqn (pjit/scan/while/cond/remat): its body's eqns
+            # are walked separately — charging the call too would double
+            # count every nested FLOP and byte
+            continue
+        fl = _eqn_flops(eqn) * weight
+        by = _eqn_bytes(eqn) * weight
+        total_f += fl
+        total_b += by
+        agg = by_prim.setdefault(eqn.primitive.name, [0, 0, 0])
+        agg[0] += fl
+        agg[1] += by
+        agg[2] += weight
+        if fl:
+            top.append(EqnCost(eqn.primitive.name, fl, by,
+                               where_of(eqn), path))
+    top.sort(key=lambda c: -c.flops)
+    summary = CostSummary(total_f, total_b,
+                          {k: tuple(v) for k, v in by_prim.items()},
+                          top[:16], peak_flops=peak, hbm_bw=bw)
+    ctx.extras["cost"] = summary
+
+    diags: List[Diagnostic] = []
+    if total_f and not summary.compute_bound:
+        est_ms = max(total_f / peak, total_b / bw) * 1e3
+        diags.append(Diagnostic(
+            "cost-model", Severity.WARNING,
+            f"likely memory-bound on TPU: intensity "
+            f"{summary.intensity:.1f} flop/B is below the ridge point "
+            f"{summary.ridge:.0f} (static lower bound ≈{est_ms:.2f} ms "
+            f"on {peak / 1e12:.0f} TFLOP/s / {bw / 1e9:.0f} GB/s)",
+            hint="batch more work per step, fuse host round-trips "
+                 "(steps_per_sync), or quantize weights to cut bytes"))
+    return diags

@@ -1,0 +1,87 @@
+"""Dead-code / unused-value pass over the jaxpr def-use graph.
+
+Tracing records every primitive the Python executed, whether or not its
+result reaches an output — XLA will DCE most of it eventually, but dead
+eqns in the jaxpr mean the Python is doing work (and possibly reading
+memory) for values that never ship, and large dead subgraphs usually
+indicate a bug (forgot to return / wrong variable).  The pass walks
+backwards from the outvars marking liveness; eqns with no live output
+and no effects are reported, as are program inputs nothing reads.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity, dedup
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.tracing import _subjaxprs, where_of
+
+
+def _is_var(v) -> bool:
+    # Literal has .val; DropVar is a Var subclass used for ignored outputs
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+def _analyze(jaxpr, diags: List[Diagnostic], path: str = "",
+             report_unused_inputs: bool = True, invar_names=None):
+    live = {id(v) for v in jaxpr.outvars if _is_var(v)}
+    dead_eqns = []
+    for eqn in reversed(jaxpr.eqns):
+        has_effects = bool(getattr(eqn, "effects", None))
+        outs_live = any(id(v) in live for v in eqn.outvars if _is_var(v))
+        if outs_live or has_effects:
+            for v in eqn.invars:
+                if _is_var(v):
+                    live.add(id(v))
+        else:
+            dead_eqns.append(eqn)
+    for eqn in reversed(dead_eqns):
+        diags.append(Diagnostic(
+            "dead-code", Severity.WARNING,
+            f"result of `{eqn.primitive.name}` is never used"
+            + (f" (in {path.rstrip('/')})" if path else ""),
+            where_of(eqn),
+            hint="delete the computation or return/consume its value"))
+
+    if report_unused_inputs:
+        names = invar_names or [f"in{i}"
+                                for i in range(len(jaxpr.invars))]
+        used = set()
+        for eqn in jaxpr.eqns:
+            for v in eqn.invars:
+                if _is_var(v):
+                    used.add(id(v))
+        used |= {id(v) for v in jaxpr.outvars if _is_var(v)}
+        unused = [n for n, v in zip(names, jaxpr.invars)
+                  if id(v) not in used]
+        # parameters of a model partially exercised by the traced method
+        # are normal (e.g. lm_head under `loss`); a handful is worth a
+        # note, a flood is collapsed into one summary line
+        if 0 < len(unused) <= 8:
+            for n in unused:
+                diags.append(Diagnostic(
+                    "dead-code", Severity.INFO,
+                    f"program input '{n}' is never read", n,
+                    hint="drop the argument/parameter from the traced "
+                         "signature if it is truly unused"))
+        elif len(unused) > 8:
+            diags.append(Diagnostic(
+                "dead-code", Severity.INFO,
+                f"{len(unused)} program inputs are never read "
+                f"(first: {', '.join(unused[:4])}, …)",
+                hint="often fine (partially-exercised parameter set); "
+                     "audit if unexpected"))
+
+    # nested bodies: dead eqns inside a scan/cond body are just as dead
+    for i, eqn in enumerate(jaxpr.eqns):
+        for sub, _w in _subjaxprs(eqn):
+            _analyze(sub, diags, f"{path}{eqn.primitive.name}[{i}]/",
+                     report_unused_inputs=False)
+
+
+@register_pass("dead-code")
+def dead_code(ctx: PassContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    _analyze(ctx.jaxpr, diags, invar_names=ctx.trace.invar_names)
+    return dedup(diags)

@@ -1,0 +1,117 @@
+"""dtype-promotion auditor.
+
+Finds silent precision widenings that cost real TPU throughput:
+
+* anything-→fp64: the MXU/VPU have no fp64 path — XLA emulates it at a
+  double-digit slowdown.  ERROR.
+* bf16/fp16-→fp32 upcasts: INFO normally (fp32 softmax/RoPE islands are
+  deliberate mixed-precision practice), WARNING when the upcast result
+  feeds a matmul/conv — that matmul runs at fp32 MXU rate, half the bf16
+  rate, which is exactly the "mixed-precision matmul" hazard.
+* dot_general whose two operands arrive with different float dtypes:
+  the implicit promotion re-materializes one side and defeats the MXU's
+  native bf16×bf16 path.  WARNING.
+* fp64 program inputs.  WARNING (the convert that follows is flagged
+  ERROR where it happens).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity, dedup
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.tracing import where_of
+
+_MATMUL_PRIMS = {"dot_general", "conv_general_dilated"}
+
+
+_FLOAT_WIDTH = {"float8_e4m3fn": 1, "float8_e5m2": 1, "float16": 2,
+                "bfloat16": 2, "float32": 4, "float64": 8}
+
+
+def _is_float(dt) -> bool:
+    return str(dt) in _FLOAT_WIDTH or str(dt).startswith("float8")
+
+
+def _width(dt) -> int:
+    return _FLOAT_WIDTH.get(str(dt), 1)
+
+
+def _audit_jaxpr(jaxpr, diags: List[Diagnostic], path: str = ""):
+    # var -> consuming primitive names, within THIS jaxpr
+    uses = {}
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not hasattr(v, "val"):
+                uses.setdefault(id(v), []).append(eqn.primitive.name)
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        where = where_of(eqn)
+        if prim == "convert_element_type":
+            src = eqn.invars[0].aval.dtype
+            dst = eqn.params.get("new_dtype", eqn.outvars[0].aval.dtype)
+            if not (_is_float(src) and _is_float(dst)):
+                if str(np.dtype(dst)) == "float64":
+                    diags.append(Diagnostic(
+                        "dtype-promotion", Severity.ERROR,
+                        f"conversion to float64 (from {src})", where,
+                        hint="TPUs have no fp64 unit; XLA emulates it — "
+                             "keep computation in f32/bf16"))
+                continue
+            if str(np.dtype(dst)) == "float64":
+                diags.append(Diagnostic(
+                    "dtype-promotion", Severity.ERROR,
+                    f"float upcast {src}→float64", where,
+                    hint="likely a strong np.float64 scalar or "
+                         "jnp.float64 annotation leaking into the graph"))
+            elif _width(dst) > _width(src):
+                feeds_mxu = any(u in _MATMUL_PRIMS
+                                for u in uses.get(id(eqn.outvars[0]), []))
+                diags.append(Diagnostic(
+                    "dtype-promotion",
+                    Severity.WARNING if feeds_mxu else Severity.INFO,
+                    f"float upcast {src}→{dst}"
+                    + (" feeding a matmul/conv (mixed-precision matmul "
+                       "runs at the wider dtype's MXU rate)"
+                       if feeds_mxu else ""),
+                    where,
+                    hint="cast weights/activations to a common narrow "
+                         "dtype before the matmul" if feeds_mxu else
+                         "fine if this is a deliberate fp32 island "
+                         "(softmax/RoPE/normalization accumulation)"))
+        elif prim in _MATMUL_PRIMS and len(eqn.invars) >= 2:
+            lt = eqn.invars[0].aval.dtype
+            rt = eqn.invars[1].aval.dtype
+            if _is_float(lt) and _is_float(rt) and str(lt) != str(rt):
+                diags.append(Diagnostic(
+                    "dtype-promotion", Severity.WARNING,
+                    f"mixed-precision {prim}: {lt} × {rt}", where,
+                    hint="promote explicitly to the intended compute "
+                         "dtype; implicit promotion defeats the MXU's "
+                         "native narrow path"))
+
+    # recurse structurally (pjit/scan/while/cond/remat bodies)
+    from paddle_tpu.analysis.tracing import _subjaxprs
+    for i, eqn in enumerate(jaxpr.eqns):
+        for sub, _w in _subjaxprs(eqn):
+            _audit_jaxpr(sub, diags, f"{path}{eqn.primitive.name}[{i}]/")
+
+
+@register_pass("dtype-promotion")
+def dtype_promotion(ctx: PassContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    jaxpr = ctx.jaxpr
+    for name, v in zip(ctx.trace.invar_names, jaxpr.invars):
+        dt = getattr(v.aval, "dtype", None)
+        if dt is not None and str(np.dtype(dt)) == "float64":
+            diags.append(Diagnostic(
+                "dtype-promotion", Severity.WARNING,
+                f"float64 program input '{name}'", name,
+                hint="feed f32/bf16; fp64 inputs force emulated math or "
+                     "a downcast on-chip"))
+    _audit_jaxpr(jaxpr, diags)
+    return dedup(diags)

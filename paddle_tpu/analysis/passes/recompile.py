@@ -1,0 +1,39 @@
+"""recompile-hazard pass.
+
+Two sources of evidence: the dynamic signature log a ``to_static``
+callable accumulates (see analysis/recompile.py — flags churn, rank
+variance, weak-type flips observed across real calls), and a static scan
+of the example arguments for python scalars — weak-typed leaves whose
+scalar-vs-array identity is exactly what flips the cache key.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity, dedup
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.recompile import leaf_signature
+
+
+@register_pass("recompile-hazard")
+def recompile_hazard(ctx: PassContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    monitor = getattr(ctx.trace, "monitor", None)
+    if monitor is not None:
+        diags.extend(monitor.report())
+
+    import jax
+    leaves = jax.tree.leaves(tuple(ctx.trace.example_args),
+                             is_leaf=lambda t: hasattr(t, "_data"))
+    scalars = [i for i, v in enumerate(leaves)
+               if leaf_signature(v)[0] == "pyscalar"]
+    if scalars:
+        diags.append(Diagnostic(
+            "recompile-hazard", Severity.INFO,
+            f"{len(scalars)} python-scalar argument leaf/leaves "
+            f"(positions {scalars[:6]}) — weak-typed; alternating with "
+            f"arrays or other scalar types retraces",
+            hint="pass jnp.asarray(x, dtype) if the value varies per "
+                 "call, or close over it if it is a constant"))
+    return dedup(diags)

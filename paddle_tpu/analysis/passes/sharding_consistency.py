@@ -1,0 +1,249 @@
+"""sharding-consistency checker.
+
+GSPMD will always *make it work* — any inconsistent PartitionSpec pair
+is "fixed" by inserting collectives, so sharding bugs ship as silent
+all-gathers instead of errors (GSPMD, arxiv 2105.04663 §3.5).  This pass
+makes them visible statically:
+
+* spec validation: axes must exist on the mesh, an axis may shard only
+  one dim of a tensor, spec rank must fit the tensor, and sharded dims
+  should divide evenly (padding otherwise);
+* dataflow: invar specs (param placements from TrainStep / mpu layer
+  annotations / caller-passed rules) propagate through elementwise ops,
+  transposes, broadcasts and constraints; at every ``dot_general`` the
+  contracting dims of both operands must agree — a dim sharded on one
+  side and not the other is an implicit all-gather of that operand;
+* ``sharding_constraint`` eqns that drop an incoming sharded dim are the
+  explicit all-gathers (e.g. ColumnParallelLinear's gather_output) —
+  reported INFO so intent stays auditable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity, dedup
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.tracing import where_of
+
+_ELEMENTWISE_HINT = ("integer_pow", "neg", "exp", "log", "tanh", "rsqrt",
+                     "sqrt", "logistic", "sin", "cos", "abs", "sign",
+                     "floor", "ceil", "round", "erf", "not", "is_finite",
+                     "stop_gradient", "convert_element_type", "copy",
+                     "reduce_precision")
+_BINARY = ("add", "sub", "mul", "div", "max", "min", "pow", "rem",
+           "atan2", "and", "or", "xor", "shift_left",
+           "shift_right_logical", "shift_right_arithmetic", "nextafter",
+           "eq", "ne", "lt", "le", "gt", "ge")
+
+
+def _norm(spec, ndim: int) -> Tuple:
+    """PartitionSpec → per-dim tuple of axis-name tuples (or None),
+    padded to the tensor's rank."""
+    entries = list(spec) if spec is not None else []
+    out = []
+    for e in entries[:ndim]:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(e) if e else None)
+        else:
+            out.append((e,))
+    out += [None] * (ndim - len(out))
+    return tuple(out)
+
+
+def _spec_for_name(name: str, specs: Dict) -> Optional[object]:
+    if name in specs:
+        return specs[name]
+    for pat, spec in specs.items():
+        if name.endswith(pat) or pat in name:
+            return spec
+    return None
+
+
+def _validate(name, spec, aval, mesh, diags):
+    ndim = len(getattr(aval, "shape", ()))
+    entries = list(spec) if spec is not None else []
+    if len(entries) > ndim:
+        diags.append(Diagnostic(
+            "sharding-consistency", Severity.ERROR,
+            f"spec {spec} for '{name}' has more entries than tensor "
+            f"rank {ndim}", name))
+        return
+    axes_of = lambda e: (() if e is None else
+                         tuple(e) if isinstance(e, (tuple, list)) else (e,))
+    seen = {}
+    mesh_axes = set(getattr(mesh, "axis_names", ()) or ())
+    shape = getattr(aval, "shape", ())
+    mesh_shape = dict(getattr(mesh, "shape", {}) or {})
+    for dim, e in enumerate(entries):
+        for ax in axes_of(e):
+            if mesh_axes and ax not in mesh_axes:
+                diags.append(Diagnostic(
+                    "sharding-consistency", Severity.ERROR,
+                    f"spec for '{name}' names axis '{ax}' which is not "
+                    f"on the mesh {sorted(mesh_axes)}", name,
+                    hint="typo or a spec written for a different mesh; "
+                         "sanitize rules against mesh.axis_names"))
+            if ax in seen:
+                diags.append(Diagnostic(
+                    "sharding-consistency", Severity.ERROR,
+                    f"spec for '{name}' uses axis '{ax}' on dims "
+                    f"{seen[ax]} and {dim} — an axis can shard one dim",
+                    name))
+            seen[ax] = dim
+        if dim < len(shape) and e is not None:
+            total = 1
+            for ax in axes_of(e):
+                total *= mesh_shape.get(ax, 1)
+            if total > 1 and shape[dim] % total:
+                diags.append(Diagnostic(
+                    "sharding-consistency", Severity.WARNING,
+                    f"dim {dim} of '{name}' ({shape[dim]}) does not "
+                    f"divide by its sharding factor {total} — XLA pads "
+                    f"every shard", name))
+
+
+def _merge_elementwise(prim, specs_in, shapes, where, diags):
+    """Same-shape operands: conflicting non-None dims = resharding."""
+    ndim = max((len(s) for s in shapes), default=0)
+    out = [None] * ndim
+    for spec, shape in zip(specs_in, shapes):
+        if spec is None:
+            continue
+        # align trailing dims (numpy broadcasting)
+        offset = ndim - len(shape)
+        for d, e in enumerate(spec):
+            if e is None or shape[d] == 1:
+                continue
+            slot = offset + d
+            if out[slot] is None:
+                out[slot] = e
+            elif out[slot] != e:
+                diags.append(Diagnostic(
+                    "sharding-consistency", Severity.WARNING,
+                    f"operands of `{prim}` carry conflicting shardings "
+                    f"on dim {slot} ({out[slot]} vs {e}) — GSPMD will "
+                    f"reshard one side", where,
+                    hint="add a with_sharding_constraint (mpu.constrain) "
+                         "to pick the intended layout explicitly"))
+    return tuple(out)
+
+
+@register_pass("sharding-consistency")
+def sharding_consistency(ctx: PassContext) -> List[Diagnostic]:
+    specs = ctx.trace.param_specs or {}
+    mesh = ctx.trace.mesh
+    diags: List[Diagnostic] = []
+    if not specs:
+        return []  # unsharded program — nothing to verify
+
+    jaxpr = ctx.jaxpr
+    env: Dict[int, Tuple] = {}
+    for name, var in zip(ctx.trace.invar_names, jaxpr.invars):
+        spec = _spec_for_name(name, specs)
+        if spec is None:
+            continue
+        _validate(name, spec, var.aval, mesh, diags)
+        env[id(var)] = _norm(spec, len(getattr(var.aval, "shape", ())))
+
+    def spec_of(v):
+        if hasattr(v, "val"):
+            return None
+        return env.get(id(v))
+
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        where = where_of(eqn)
+        out = eqn.outvars[0] if eqn.outvars else None
+        in_specs = [spec_of(v) for v in eqn.invars]
+        in_shapes = [tuple(getattr(v.aval, "shape", ()))
+                     for v in eqn.invars]
+
+        if prim == "dot_general":
+            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+            ls, rs = in_specs[0], in_specs[1]
+            for ld, rd in zip(lc, rc):
+                le = ls[ld] if ls else None
+                re_ = rs[rd] if rs else None
+                if le != re_:
+                    gathered = "lhs" if (le and not re_) else \
+                        "rhs" if (re_ and not le) else "one operand"
+                    diags.append(Diagnostic(
+                        "sharding-consistency", Severity.WARNING,
+                        f"contracting dim of dot_general sharded "
+                        f"{le or '(replicated)'} on lhs vs "
+                        f"{re_ or '(replicated)'} on rhs — GSPMD "
+                        f"all-gathers {gathered} before the matmul",
+                        where,
+                        hint="shard both contraction dims on the same "
+                             "axis (partial-sums + one psum) or neither"))
+            if out is not None and (ls or rs):
+                lfree = [d for d in range(len(in_shapes[0]))
+                         if d not in lc and d not in lb]
+                rfree = [d for d in range(len(in_shapes[1]))
+                         if d not in rc and d not in rb]
+                o = [(ls[d] if ls else None) for d in lb]
+                o += [(ls[d] if ls else None) for d in lfree]
+                o += [(rs[d] if rs else None) for d in rfree]
+                env[id(out)] = tuple(o)
+            continue
+
+        if prim == "sharding_constraint":
+            target = eqn.params.get("sharding")
+            tspec = getattr(target, "spec", None)
+            ndim = len(in_shapes[0])
+            norm_t = _norm(tspec, ndim) if tspec is not None else None
+            incoming = in_specs[0]
+            if norm_t is not None and incoming is not None:
+                for d, (i_e, t_e) in enumerate(zip(incoming, norm_t)):
+                    if i_e and not t_e:
+                        diags.append(Diagnostic(
+                            "sharding-consistency", Severity.INFO,
+                            f"sharding_constraint drops axis {i_e} on "
+                            f"dim {d} — an all-gather materializes the "
+                            f"replicated value here", where,
+                            hint="intended for gather_output-style "
+                                 "layers; remove the constraint to keep "
+                                 "the value sharded"))
+                    elif i_e and t_e and i_e != t_e:
+                        diags.append(Diagnostic(
+                            "sharding-consistency", Severity.WARNING,
+                            f"sharding_constraint reshards dim {d} "
+                            f"from {i_e} to {t_e} (all-to-all)", where))
+            if out is not None and norm_t is not None:
+                env[id(out)] = norm_t
+            continue
+
+        if prim == "transpose" and in_specs[0] is not None:
+            perm = eqn.params["permutation"]
+            env[id(out)] = tuple(in_specs[0][p] for p in perm)
+            continue
+
+        if prim == "broadcast_in_dim" and in_specs[0] is not None:
+            bcast = eqn.params["broadcast_dimensions"]
+            o = [None] * len(eqn.params["shape"])
+            for src, dst in enumerate(bcast):
+                o[dst] = in_specs[0][src]
+            env[id(out)] = tuple(o)
+            continue
+
+        known = [s for s in in_specs if s is not None]
+        if not known or out is None:
+            continue
+        out_shape = tuple(getattr(out.aval, "shape", ()))
+        same_rank = all(len(s) == len(out_shape) or s == ()
+                        for s in in_shapes)
+        unary_like = prim in _ELEMENTWISE_HINT or (
+            prim in _BINARY or len(eqn.invars) == 1)
+        if unary_like and same_rank:
+            pairs = [(s, sh) for s, sh in zip(in_specs, in_shapes)
+                     if s is not None]
+            env[id(out)] = _merge_elementwise(
+                prim, [p[0] for p in pairs], [p[1] for p in pairs],
+                where, diags)
+        # other prims (reshape/gather/reductions/…): spec unknown — the
+        # propagation is deliberately conservative, never guessing
+
+    return dedup(diags)

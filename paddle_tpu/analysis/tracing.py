@@ -1,0 +1,247 @@
+"""Trace any Layer / function / TrainStep to a ClosedJaxpr.
+
+Every compiled path in the framework already funnels through a jaxpr
+(``functional_call`` for Layers, ``_step_impl`` for TrainStep, the plain
+function for ``to_static``); this module is the one place that knows how
+to reach it abstractly — no FLOPs run, no parameters are copied — and
+returns enough side information (invar names, partition specs, mesh) for
+the passes to attribute findings to parameters and arguments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+__all__ = ["TraceResult", "trace", "walk_eqns", "where_of", "abstractify"]
+
+
+@dataclasses.dataclass
+class TraceResult:
+    closed: Any                       # jax ClosedJaxpr
+    invar_names: List[str]            # aligned with closed.jaxpr.invars
+    param_specs: Dict[str, Any]       # name/pattern -> PartitionSpec
+    mesh: Optional[Any] = None
+    target_name: str = "<program>"
+    example_args: Tuple = ()          # ORIGINAL args (python scalars kept)
+    monitor: Optional[Any] = None     # SignatureMonitor from to_static
+
+    @property
+    def jaxpr(self):
+        return self.closed.jaxpr
+
+
+def abstractify(x):
+    """Example arg → something make_jaxpr can trace without copying data:
+    Tensors/arrays become ShapeDtypeStructs; python scalars stay scalars
+    (their weak type is itself a finding); InputSpec maps via its dims
+    (dynamic dims traced at a nominal size 1)."""
+    from paddle_tpu.jit.save_load import InputSpec
+    if isinstance(x, InputSpec):
+        import numpy as np
+        from paddle_tpu.core.dtypes import to_jax
+        shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                      else int(d) for d in x.shape)
+        return jax.ShapeDtypeStruct(shape, to_jax(x.dtype))
+    if hasattr(x, "_data"):
+        x = x._data
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x  # python scalar / None / static value
+
+
+def _abstract_tree(tree):
+    return jax.tree.map(abstractify, tree,
+                        is_leaf=lambda t: hasattr(t, "_data"))
+
+
+def where_of(eqn) -> str:
+    """``file:line (fn)`` provenance from the eqn's recorded traceback."""
+    try:
+        from jax._src import source_info_util
+        s = source_info_util.summarize(eqn.source_info)
+        return s or ""
+    except Exception:
+        return ""
+
+
+def _subjaxprs(eqn):
+    """(closed_or_raw_jaxpr, weight) pairs nested in an eqn's params —
+    discovered structurally so primitive-name drift (pjit/scan/while/cond/
+    remat/custom_*) can't silently hide a body from the passes.  Weight
+    scales costs: a scan body runs ``length`` times."""
+    out = []
+    weight = 1
+    if eqn.primitive.name == "scan":
+        weight = int(eqn.params.get("length", 1) or 1)
+    for v in eqn.params.values():
+        for item in (v if isinstance(v, (tuple, list)) else (v,)):
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                out.append((item.jaxpr, weight))     # ClosedJaxpr
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                out.append((item, weight))           # raw Jaxpr
+    return out
+
+
+def walk_eqns(jaxpr, path: str = "", weight: int = 1):
+    """Yield ``(eqn, path, weight)`` over a jaxpr and every nested
+    sub-jaxpr.  ``weight`` multiplies through nested scans."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    for i, eqn in enumerate(jaxpr.eqns):
+        yield eqn, path, weight
+        for sub, w in _subjaxprs(eqn):
+            yield from walk_eqns(
+                sub, f"{path}{eqn.primitive.name}[{i}]/", weight * w)
+
+
+def _specs_of_shardings(param_sh) -> Tuple[Dict[str, Any], Optional[Any]]:
+    specs, mesh = {}, None
+    for n, sh in (param_sh or {}).items():
+        spec = getattr(sh, "spec", None)
+        if spec is not None:
+            specs[n] = spec
+        m = getattr(sh, "mesh", None)
+        if m is not None:
+            mesh = m
+    return specs, mesh
+
+
+def _collect_layer_specs(layer) -> Dict[str, Any]:
+    """Params created by mpu parallel layers carry ``partition_spec``
+    directly on the Parameter; pick those up without being asked."""
+    specs = {}
+    for name, t in layer.state_dict(keep_vars=True).items():
+        spec = getattr(t, "partition_spec", None)
+        if spec is not None:
+            specs[name] = spec
+    return specs
+
+
+def trace(target, *example_args, method: Optional[str] = None,
+          param_specs: Optional[Dict[str, Any]] = None,
+          mesh=None, **example_kwargs) -> TraceResult:
+    """Abstractly trace ``target`` with ``example_args``.
+
+    Accepts an ``nn.Layer`` (traces forward — or ``method`` — through
+    ``functional_call``), a ``jit.TrainStep`` (traces the whole
+    fwd+bwd+update ``_step_impl``; example arg: one batch), a
+    ``to_static``-wrapped callable (unwraps; keeps its signature monitor
+    for the recompile pass), or any plain function.
+    """
+    from paddle_tpu.core.dispatch import unwrap
+
+    monitor = getattr(target, "_signature_monitor", None)
+    if hasattr(target, "__wrapped__"):          # to_static wrapper
+        target = target.__wrapped__
+
+    from paddle_tpu.jit.train_step import CompiledStepBase
+    from paddle_tpu.nn.layer import Layer
+
+    def unwrap_tree(tree):
+        return jax.tree.map(unwrap, tree,
+                            is_leaf=lambda t: hasattr(t, "_data"))
+
+    if isinstance(target, CompiledStepBase):
+        return _trace_train_step(target, example_args, monitor)
+
+    if isinstance(target, Layer):
+        from paddle_tpu.core.functional import functional_call, params_of
+        params = params_of(target)
+        names = sorted(params)
+        p_abs = {n: jax.ShapeDtypeStruct(tuple(params[n].shape),
+                                         params[n].dtype) for n in names}
+        args_abs = _abstract_tree(example_args)
+        kwargs_abs = _abstract_tree(example_kwargs)
+
+        def fn(ps, *xs, **kw):
+            return unwrap_tree(functional_call(target, ps, *xs,
+                                               method=method, **kw))
+
+        closed = jax.make_jaxpr(fn)(p_abs, *args_abs, **kwargs_abs)
+        invar_names = list(names)
+        invar_names += _arg_leaf_names(args_abs, kwargs_abs)
+        specs = dict(_collect_layer_specs(target))
+        specs.update(param_specs or {})
+        return TraceResult(closed, invar_names, specs, mesh=mesh,
+                           target_name=type(target).__name__,
+                           example_args=example_args, monitor=monitor)
+
+    # plain function (possibly dy2static-converted)
+    fn = target
+
+    def pure(*xs, **kw):
+        return unwrap_tree(fn(*xs, **kw))
+
+    args_abs = _abstract_tree(example_args)
+    kwargs_abs = _abstract_tree(example_kwargs)
+    closed = jax.make_jaxpr(pure)(*args_abs, **kwargs_abs)
+    invar_names = _arg_leaf_names(args_abs, kwargs_abs)
+    name = getattr(target, "__name__", type(target).__name__)
+    return TraceResult(closed, invar_names, dict(param_specs or {}),
+                       mesh=mesh, target_name=name,
+                       example_args=example_args, monitor=monitor)
+
+
+def _arg_leaf_names(args_abs, kwargs_abs=None) -> List[str]:
+    """Stable names for flattened positional/keyword arg leaves.  Every
+    pytree leaf (arrays AND python scalars — both become jaxpr invars
+    under make_jaxpr; None is an empty node, not a leaf) gets a name, so
+    the list stays aligned with ``jaxpr.invars``."""
+    names = []
+    for i, a in enumerate(args_abs):
+        n = len(jax.tree.leaves(a))
+        if n == 1:
+            names.append(f"arg{i}")
+        else:
+            names.extend(f"arg{i}.{j}" for j in range(n))
+    for k in sorted(kwargs_abs or {}):
+        n = len(jax.tree.leaves(kwargs_abs[k]))
+        if n == 1:
+            names.append(str(k))
+        else:
+            names.extend(f"{k}.{j}" for j in range(n))
+    return names
+
+
+def _trace_train_step(step, example_args, monitor) -> TraceResult:
+    """Trace the whole compiled train step.  Example arg: one batch
+    (dict/tuple of arrays); params/opt_state come abstract from the
+    step's own live state, shardings from its placement."""
+    import jax.numpy as jnp
+
+    if not example_args:
+        raise ValueError(
+            "tracing a TrainStep needs one example batch: "
+            "check(step, batch)")
+    batch = example_args[0]
+    abs_of = lambda tree: jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+        if hasattr(a, "shape") else a, tree,
+        is_leaf=lambda t: hasattr(t, "_data"))
+    params_abs = abs_of(step.params)
+    opt_abs = abs_of(step.opt_state)
+    batch_abs = _abstract_tree(batch)
+    key = jax.random.PRNGKey(0)
+    lr = jnp.zeros((), jnp.float32)
+    step_count = jnp.zeros((), jnp.int32)
+
+    closed = jax.make_jaxpr(step._step_impl)(
+        params_abs, opt_abs, step_count, batch_abs, key, lr)
+
+    invar_names = sorted(step.params)
+    for n in sorted(step.opt_state):
+        leaves = jax.tree.leaves(step.opt_state[n])
+        invar_names.extend(f"opt_state.{n}.{j}" for j in range(len(leaves)))
+    invar_names.append("step_count")
+    nbatch = len(jax.tree.leaves(batch_abs))
+    invar_names.extend(f"batch.{j}" for j in range(nbatch))
+    invar_names.extend(["rng_key", "lr"])
+
+    specs, mesh = _specs_of_shardings(getattr(step, "_param_sh", None))
+    return TraceResult(closed, invar_names, specs,
+                       mesh=mesh or getattr(step, "mesh", None),
+                       target_name=f"TrainStep({type(step.model).__name__})",
+                       example_args=example_args, monitor=monitor)

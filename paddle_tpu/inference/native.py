@@ -120,7 +120,21 @@ class NativePredictor:
     """Run a jit.save artifact through the C++ PJRT predictor."""
 
     def __init__(self, model_prefix: str, plugin_path: Optional[str] = None,
-                 options: Optional[str] = None):
+                 options: Optional[str] = None,
+                 analyze: Optional[str] = None):
+        # artifact lint BEFORE touching the native library: a bad export
+        # (fp64 ops, symbolic dims) should fail here with a structured
+        # report, not as a PJRT compile error on the serving fleet.
+        # Opt-in: analyze="warn"|"strict" or PADDLE_TPU_ANALYZE env.
+        from paddle_tpu.analysis import analysis_mode
+        mode = analyze if analyze is not None else analysis_mode()
+        if mode:
+            import sys
+            from paddle_tpu.analysis.artifact import check_artifact
+            report = check_artifact(model_prefix,
+                                    strict=(mode == "strict"))
+            if len(report):
+                print(report.format(), file=sys.stderr)
         self._lib = _lib()
         plugin = plugin_path or default_plugin_path()
         if plugin is None:

@@ -89,7 +89,8 @@ class ContinuousBatchingEngine:
                  int8_weights: bool = False,
                  steps_per_sync: int = 1,
                  do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 analyze: Optional[str] = None):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -192,7 +193,6 @@ class ContinuousBatchingEngine:
 
         K = self.steps_per_sync
 
-        @_ft.partial(jax.jit, donate_argnums=(2,))
         def decode(keep, quant, caches, toks, pos, active, key):
             ps = _dequant(keep, quant, dtype)
 
@@ -213,8 +213,35 @@ class ContinuousBatchingEngine:
                 one, (caches, toks, pos, key), None, length=K)
             return jnp.swapaxes(seq, 0, 1), caches   # [B, K]
 
-        self._prefill, self._insert, self._decode = prefill, insert, decode
+        self._prefill, self._insert = prefill, insert
+        # raw (unjitted) decode kept for program analysis — the engine
+        # build step can lint the exact fn it is about to compile
+        self._decode_raw = decode
+        self._decode = jax.jit(decode, donate_argnums=(2,))
         self._fwd = fwd
+
+        from paddle_tpu.analysis import analysis_mode
+        mode = analyze if analyze is not None else analysis_mode()
+        if mode:
+            import sys
+            report = self.analyze(strict=(mode == "strict"))
+            if len(report):
+                print(report.format(), file=sys.stderr)
+
+    def analyze(self, strict: bool = False, passes=None, options=None):
+        """Lint the compiled decode step (the hot serving path) with the
+        ``paddle_tpu.analysis`` pipeline.  Abstract — nothing executes;
+        call any time (the engine build hook uses ``analyze="warn"`` /
+        ``"strict"`` ctor opt-in or PADDLE_TPU_ANALYZE)."""
+        import paddle_tpu.analysis as _analysis
+        toks = jnp.zeros((self.slots,), jnp.int32)
+        pos = jnp.zeros((self.slots,), jnp.int32)
+        active = jnp.ones((self.slots,), jnp.bool_)
+        report = _analysis.check(
+            self._decode_raw, self._keep, self._quant, self._caches,
+            toks, pos, active, self._key, strict=strict, passes=passes,
+            options=options)
+        return report
 
     def _next_key(self):
         """Advance the sampling stream — greedy mode skips the split

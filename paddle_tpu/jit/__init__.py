@@ -6,9 +6,18 @@ TPU-native: Layers are already functional through
 core.functional.functional_call, so "static mode" is jax.jit over the pure
 form — `to_static(layer_or_fn)` returns a compiled callable with no source
 rewriting, and TrainStep compiles a whole fwd+bwd+update step.
+
+Program analysis: every compiled callable carries a
+``_signature_monitor`` (analysis/recompile.py) that, when monitoring is
+on, records call signatures so the recompile-hazard pass can flag
+executable-cache churn; ``analyze="warn"|"strict"`` (or the
+``PADDLE_TPU_ANALYZE`` env var) runs the full ``paddle_tpu.analysis``
+pass pipeline on the first call.
 """
 
 from __future__ import annotations
+
+import sys
 
 import jax
 
@@ -20,17 +29,80 @@ __all__ = ["TrainStep", "to_static", "save", "load", "InputSpec",
            "TranslatedLayer"]
 
 
-def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
+def _coerce_to_specs(args, specs):
+    """Honor ``input_spec``: validate each positional arg against its
+    spec and coerce it to the spec's dtype (python scalars become
+    strongly-typed arrays — which also kills the weak-type recompile
+    hazard).  Dims that are None/-1 are free; int dims must match."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.dtypes import to_jax
+
+    out = list(args)
+    for i, spec in enumerate(specs):
+        if i >= len(out) or not isinstance(spec, InputSpec):
+            continue
+        x = out[i]
+        raw = x._data if hasattr(x, "_data") else x
+        arr = jnp.asarray(raw, to_jax(spec.dtype))
+        shape = tuple(arr.shape)
+        if len(shape) != len(spec.shape):
+            raise ValueError(
+                f"to_static: argument {i} has rank {len(shape)}, "
+                f"input_spec expects rank {len(spec.shape)} "
+                f"(spec {spec}, got shape {shape})")
+        for d, (got, want) in enumerate(zip(shape, spec.shape)):
+            if want is None or (isinstance(want, int) and want < 0):
+                continue
+            if got != want:
+                raise ValueError(
+                    f"to_static: argument {i} dim {d} is {got}, "
+                    f"input_spec pins it to {want} (spec {spec})")
+        out[i] = arr
+    return tuple(out)
+
+
+def to_static(obj=None, input_spec=None, full_graph=True, analyze=None,
+              **kwargs):
     """Decorator/function: compile a Layer's forward or a plain function.
 
     For a Layer, parameters are captured fresh on every call (so eager
     updates by optimizers stay visible) but the XLA executable is cached by
     shape/dtype, like the reference's ConcreteProgram cache
-    (jit/dy2static/program_translator.py)."""
+    (jit/dy2static/program_translator.py).  ``input_spec`` is honored on
+    BOTH paths (Layer forward args and plain/dy2static functions):
+    arguments are validated and coerced to the spec's dtype before
+    tracing.  ``analyze`` opts this callable into the
+    ``paddle_tpu.analysis`` pass pipeline on first call ("warn" prints
+    findings, "strict" raises on ERROR); default follows
+    ``PADDLE_TPU_ANALYZE``."""
     from paddle_tpu.core.functional import functional_call, params_of
     from paddle_tpu.nn.layer import Layer
 
     def wrap(target):
+        from paddle_tpu.analysis.recompile import SignatureMonitor
+        name = getattr(target, "__name__", type(target).__name__)
+        monitor = SignatureMonitor(name=name)
+        specs = list(input_spec) if input_spec is not None else None
+        state = {"analyzed": False}
+
+        def prepare(a, kw):
+            if specs is not None:
+                a = _coerce_to_specs(a, specs)
+            if monitor.active:
+                monitor.record(a, kw)
+            return a, kw
+
+        def maybe_analyze(tgt, a, kw):
+            from paddle_tpu.analysis import analysis_mode
+            mode = analyze if analyze is not None else analysis_mode()
+            if not mode or state["analyzed"]:
+                return
+            state["analyzed"] = True
+            import paddle_tpu.analysis as _A
+            report = _A.check(tgt, *a, strict=(mode == "strict"), **kw)
+            if len(report):
+                print(report.format(), file=sys.stderr)
+
         if not isinstance(target, Layer) and callable(target):
             # AST capture of data-dependent if/while/for-range (reference
             # dy2static transformer pipeline) before tracing
@@ -41,18 +113,24 @@ def to_static(obj=None, input_spec=None, full_graph=True, **kwargs):
                 functional_call(target, params, *a, **kw)))
 
             def call(*a, **kw):
+                a, kw = prepare(a, kw)
+                maybe_analyze(target, a, kw)
                 a = tuple(_raw(x) for x in a)
                 kw = {k: _raw(v) for k, v in kw.items()}
                 return _wrap_tree(jfn(params_of(target), *a, **kw))
             call.__wrapped__ = target
+            call._signature_monitor = monitor
             return call
         jfn = jax.jit(lambda *a, **kw: _raw_tree(target(*a, **kw)))
 
         def call(*a, **kw):
+            a, kw = prepare(a, kw)
+            maybe_analyze(target, a, kw)
             a = tuple(_raw(x) for x in a)
             kw = {k: _raw(v) for k, v in kw.items()}
             return _wrap_tree(jfn(*a, **kw))
         call.__wrapped__ = target
+        call._signature_monitor = monitor
         return call
 
     def _raw(x):

@@ -153,10 +153,16 @@ class TrainStep(CompiledStepBase):
     def __init__(self, model, optimizer, loss_fn: Optional[Callable] = None,
                  mesh=None, param_specs: Optional[Dict[str, Any]] = None,
                  batch_spec=None, compute_dtype=None, seed: int = 0,
-                 remat: bool = False, remat_policy: Optional[str] = None):
+                 remat: bool = False, remat_policy: Optional[str] = None,
+                 analyze: Optional[str] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # opt-in whole-step program analysis ("warn" prints findings on
+        # the first step, "strict" raises on ERROR); default follows the
+        # PADDLE_TPU_ANALYZE env var (paddle_tpu.analysis.analysis_mode)
+        self._analyze_mode = analyze
+        self._analyzed = False
         # (no copy here: _init_step_state copies every leaf before the
         # donated jit, which is what protects the Layer's own Parameters)
         params = params_of(model, dtype=compute_dtype)
@@ -242,8 +248,31 @@ class TrainStep(CompiledStepBase):
                 batch)
         else:
             batch = jax.tree.map(jnp.asarray, batch)
+        if not self._analyzed:
+            self._maybe_analyze(batch)
         self._key, sub = jax.random.split(self._key)
         return self._run_jitted(batch, sub)
+
+    def _maybe_analyze(self, batch):
+        self._analyzed = True
+        from paddle_tpu.analysis import analysis_mode
+        mode = self._analyze_mode if self._analyze_mode is not None \
+            else analysis_mode()
+        if not mode:
+            return
+        import sys
+        report = self.analyze(batch, strict=(mode == "strict"))
+        if len(report):
+            print(report.format(), file=sys.stderr)
+
+    def analyze(self, batch, strict: bool = False, passes=None,
+                options=None):
+        """Run the ``paddle_tpu.analysis`` pass pipeline over the whole
+        compiled step (fwd+bwd+update) with this step's parameter
+        shardings.  Abstract — no step executes."""
+        import paddle_tpu.analysis as _analysis
+        return _analysis.check(self, batch, strict=strict, passes=passes,
+                               options=options)
 
     def sync_to_model(self):
         state = self.model.state_dict(keep_vars=True)

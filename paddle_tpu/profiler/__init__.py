@@ -22,7 +22,23 @@ from typing import Callable, Iterable, Optional
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "benchmark"]
+           "benchmark", "format_diagnostics"]
+
+
+def format_diagnostics(diags, title: str = "program analysis") -> str:
+    """Render ``paddle_tpu.analysis`` Diagnostics in the profiler's
+    table style (duck-typed on pass_id/severity/message/count so the
+    profiler stays import-independent of the analysis package).  The
+    cost model's roll-up (``CostSummary.to_diagnostics()``) renders the
+    same way — static FLOPs/bytes next to measured wall time."""
+    lines = [f"-- {title} " + "-" * max(0, 60 - len(title)),
+             f"{'pass':22s} {'severity':>8s}  finding"]
+    for d in diags:
+        mult = f" (×{d.count})" if getattr(d, "count", 1) > 1 else ""
+        where = f"  [{d.where}]" if getattr(d, "where", "") else ""
+        lines.append(f"{d.pass_id:22s} {str(d.severity):>8s}  "
+                     f"{d.message}{mult}{where}")
+    return "\n".join(lines)
 
 
 class ProfilerTarget(enum.Enum):
@@ -149,6 +165,22 @@ class Profiler:
         self._events = []
         self._step_times = []
         self._last_step_t = None
+        self._diagnostics = []
+        self._cost_summaries = []   # (target, CostSummary) pairs
+
+    def add_diagnostics(self, diags):
+        """Attach analysis findings; they render in ``summary()``."""
+        self._diagnostics.extend(diags)
+
+    def add_analysis(self, report):
+        """Attach a full ``paddle_tpu.analysis.AnalysisReport``: its
+        diagnostics plus the cost-model roll-up (as INFO rows and the
+        FLOPs/bytes table) appear in ``summary()``."""
+        self._diagnostics.extend(report.diagnostics)
+        cost = getattr(report, "extras", {}).get("cost")
+        if cost is not None:
+            self._diagnostics.extend(cost.to_diagnostics())
+            self._cost_summaries.append((report.target, cost))
 
     # device trace control
     def _start_trace(self):
@@ -230,6 +262,11 @@ class Profiler:
                  f"{'total(' + time_unit + ')':>14s}"]
         for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
             lines.append(f"{name:40s} {cnt:8d} {tot * scale:14.3f}")
+        if self._diagnostics:
+            lines.append(format_diagnostics(self._diagnostics))
+        for target, cost in self._cost_summaries:
+            lines.append(f"-- static cost model: {target} " + "-" * 20)
+            lines.append(cost.table())
         table = "\n".join(lines)
         print(table)
         return table
