@@ -66,6 +66,7 @@ from paddle_tpu import jit  # noqa: F401,E402
 from paddle_tpu import metric  # noqa: F401,E402
 from paddle_tpu import nn  # noqa: F401,E402
 from paddle_tpu import optimizer  # noqa: F401,E402
+from paddle_tpu import observability  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
 from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import text  # noqa: F401,E402

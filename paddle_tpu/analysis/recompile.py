@@ -85,7 +85,10 @@ class SignatureMonitor:
     def active(self) -> bool:
         return self.enabled or _ENABLED
 
-    def record(self, args, kwargs=None):
+    def record(self, args, kwargs=None) -> bool:
+        """Returns True when this call's signature is NOVEL (i.e. it
+        would retrace) — the observability recompile counter feeds off
+        this return value."""
         import jax
         self.calls += 1
         leaves = jax.tree.leaves(
@@ -95,6 +98,8 @@ class SignatureMonitor:
         if sig not in self._seen and len(self.records) < self.max_records:
             self._seen.add(sig)
             self.records.append(sig)
+            return True
+        return False
 
     def clear(self):
         self.calls = 0

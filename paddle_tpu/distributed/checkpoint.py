@@ -35,6 +35,7 @@ import glob
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,6 +44,30 @@ __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
            "validate_checkpoint", "Converter", "AutoCheckpoint"]
 
 _SENTINEL = "checkpoint_meta.json"
+
+_DURATION_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300, 900)
+
+
+def _ckpt_metrics():
+    """Save/restore telemetry (observability tentpole).  Durations are
+    per-process wall time of the local shard I/O — the number an
+    operator watches drift as checkpoints grow."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "saves": reg.counter("paddle_tpu_checkpoint_saves_total",
+                             "checkpoint save operations (this process's "
+                             "shard write, sync or async)"),
+        "restores": reg.counter("paddle_tpu_checkpoint_restores_total",
+                                "checkpoint load operations"),
+        "save_s": reg.histogram("paddle_tpu_checkpoint_save_seconds",
+                                "wall time writing this process's shards",
+                                buckets=_DURATION_BUCKETS),
+        "restore_s": reg.histogram(
+            "paddle_tpu_checkpoint_restore_seconds",
+            "wall time assembling this process's regions",
+            buckets=_DURATION_BUCKETS),
+    }
 
 
 def _unwrap(arr):
@@ -116,6 +141,26 @@ def _write_plan(plan: Dict[str, dict], path: str, barrier: bool = True):
     """Write this process's shards + index; process 0 purges stale
     artifacts first and writes the sentinel last (with cross-process
     barriers when running multi-controller)."""
+    from paddle_tpu.observability import flight_recorder
+    t0 = time.perf_counter()
+    recorder = flight_recorder()
+    recorder.record("checkpoint.save_begin", path=path,
+                    tensors=len(plan), barrier=barrier)
+    try:
+        _write_plan_inner(plan, path, barrier)
+    except BaseException as e:
+        recorder.record("checkpoint.save_failed", path=path,
+                        error=type(e).__name__)
+        raise
+    m = _ckpt_metrics()
+    m["saves"].inc()
+    m["save_s"].observe(time.perf_counter() - t0)
+    recorder.record("checkpoint.save_end", path=path,
+                    seconds=time.perf_counter() - t0)
+
+
+def _write_plan_inner(plan: Dict[str, dict], path: str,
+                      barrier: bool = True):
     import jax
     proc, nprocs = jax.process_index(), jax.process_count()
     os.makedirs(path, exist_ok=True)
@@ -262,10 +307,16 @@ def load_state_dict(path: str, mesh=None,
     than the shards its devices need."""
     import jax
     import jax.numpy as jnp
+    from paddle_tpu.observability import flight_recorder
+    t0 = time.perf_counter()
     with open(os.path.join(path, _SENTINEL)) as f:
         meta = json.load(f)
     if meta.get("format", 1) < 2:  # legacy: one global .npy per tensor
-        return _load_format1(path, meta["tensors"], mesh, specs, dtype)
+        out1 = _load_format1(path, meta["tensors"], mesh, specs, dtype)
+        m = _ckpt_metrics()
+        m["restores"].inc()
+        m["restore_s"].observe(time.perf_counter() - t0)
+        return out1
     tensors = _merge_indexes(path, expected_nprocs=meta.get("nprocs"))
     out = {}
     for name, tmeta in tensors.items():
@@ -286,6 +337,12 @@ def load_state_dict(path: str, mesh=None,
             out[name] = jax.make_array_from_callback(gshape, sharding, cb)
         else:
             out[name] = jnp.asarray(cb(()))
+    m = _ckpt_metrics()
+    m["restores"].inc()
+    m["restore_s"].observe(time.perf_counter() - t0)
+    flight_recorder().record("checkpoint.restore", path=path,
+                             tensors=len(out),
+                             seconds=time.perf_counter() - t0)
     return out
 
 

@@ -46,6 +46,25 @@ __all__ = ["ElasticAgent", "ElasticManager", "MultiNodeElasticAgent",
            "free_port"]
 
 
+def _elastic_metrics():
+    """Restart/generation telemetry on the default registry, labeled by
+    failure class so operators can alert on real failures without
+    paging for free infra relaunches."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "restarts": reg.counter(
+            "paddle_tpu_elastic_restarts_total",
+            "generation relaunches", labelnames=("reason",)),
+        "generation": reg.gauge("paddle_tpu_elastic_generation",
+                                "current elastic generation"),
+        "gen_seconds": reg.histogram(
+            "paddle_tpu_elastic_generation_seconds",
+            "lifetime of each finished generation",
+            buckets=(1, 5, 15, 60, 300, 900, 3600, 14400, 86400)),
+    }
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -214,10 +233,16 @@ class ElasticManager:
         binds) and is relaunched on a fresh port WITHOUT consuming a
         restart — bounded by its own small cap so a genuinely
         insta-crashing workload still terminates."""
+        from paddle_tpu.observability import flight_recorder
+        metrics = _elastic_metrics()
+        recorder = flight_recorder()
         infra_retries = 0
         while True:
             self._gen_hb_seen = False
             started = time.time()
+            metrics["generation"].set(self.generation)
+            recorder.record("elastic.spawn", generation=self.generation,
+                            nproc=self.nproc, restarts=self.restarts)
             procs = []
             try:
                 procs = self._spawn()
@@ -226,7 +251,9 @@ class ElasticManager:
                 self._kill_all(procs)
                 for f in getattr(self, "_log_files", []):
                     f.close()
+            metrics["gen_seconds"].observe(time.time() - started)
             if ok:
+                recorder.record("elastic.done", generation=self.generation)
                 return 0
             # final sweep: the generation may have died between heartbeat
             # polls — an hb key in the store means workers DID come up
@@ -236,12 +263,21 @@ class ElasticManager:
             fast_infra_fail = (not self._gen_hb_seen
                                and time.time() - started
                                < min(self.heartbeat_timeout, 10.0))
+            recorder.record("elastic.generation_failed",
+                            generation=self.generation,
+                            infra=fast_infra_fail,
+                            hb_seen=self._gen_hb_seen)
             if fast_infra_fail and infra_retries < 3:
                 infra_retries += 1  # global cap: never re-arms
+                metrics["restarts"].labels(reason="infra").inc()
                 self.generation += 1
                 continue
             self.restarts += 1
+            metrics["restarts"].labels(reason="fail").inc()
             if self.restarts > self.max_restarts:
+                recorder.record("elastic.exhausted",
+                                generation=self.generation,
+                                restarts=self.restarts)
                 return 1
             self.generation += 1
 
@@ -529,12 +565,18 @@ class MultiNodeElasticAgent:
         scale-up rescales and abandoned rendezvous (both recorded in
         ``elastic/why/<g>``) are free, so a 4-node job where 3 survivors
         race to report one death still burns exactly one restart each."""
+        from paddle_tpu.observability import flight_recorder
+        metrics = _elastic_metrics()
+        recorder = flight_recorder()
         failures = 0
         infra = 0    # free infra relaunches (bounded; never re-arms)
         barren = 0   # consecutive DEADLINE-forced rendezvous abandonments
         while True:
             g = self._gen_now()
+            metrics["generation"].set(g)
             if failures > self.max_restarts:
+                recorder.record("elastic.exhausted", generation=g,
+                                node=self.node_id, failures=failures)
                 return 1
             node_rank, members, timed_out = self._rendezvous(g)
             if node_rank is None:
@@ -550,10 +592,20 @@ class MultiNodeElasticAgent:
                 time.sleep(self.poll_interval)
                 continue
             barren = 0
+            gen_started = time.time()
+            recorder.record("elastic.spawn", generation=g,
+                            node=self.node_id, node_rank=node_rank,
+                            nodes=len(members))
             rc = self._run_generation(g, node_rank, members)
+            metrics["gen_seconds"].observe(time.time() - gen_started)
             if rc == 0:
+                recorder.record("elastic.done", generation=g,
+                                node=self.node_id)
                 return 0
             reason = self._bump_reason(g)
+            metrics["restarts"].labels(reason=reason).inc()
+            recorder.record("elastic.generation_failed", generation=g,
+                            node=self.node_id, reason=reason)
             if reason == "infra":
                 infra += 1
                 if infra > 3:   # insta-crashing workload, not infra

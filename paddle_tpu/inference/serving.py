@@ -24,6 +24,7 @@ decode — a bandwidth-bound workload — reads half the bytes.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -34,6 +35,46 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["ContinuousBatchingEngine", "quantize_weights_int8"]
+
+# decode-token latency lives in the sub-ms..s decade; TTFT includes a
+# possible compile, so it keeps the wide default upper range
+_TOKEN_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+def _serving_metrics():
+    """Process-wide serving instruments (observability tentpole)."""
+    from paddle_tpu.observability import DEFAULT_BUCKETS, default_registry
+    reg = default_registry()
+    return {
+        "requests": reg.counter("paddle_tpu_serving_requests_total",
+                                "requests enqueued"),
+        "admissions": reg.counter("paddle_tpu_serving_admissions_total",
+                                  "requests admitted into a slot"),
+        "retirements": reg.counter(
+            "paddle_tpu_serving_retirements_total",
+            "requests retired (eos or budget exhausted)"),
+        "tokens": reg.counter("paddle_tpu_serving_tokens_total",
+                              "tokens generated (prefill first token + "
+                              "decode)"),
+        "bucket": reg.counter(
+            "paddle_tpu_serving_prefill_bucket_total",
+            "prefill admissions per bucket; fit=exact means the prompt "
+            "needed no padding", labelnames=("bucket", "fit")),
+        "pad_tokens": reg.counter(
+            "paddle_tpu_serving_prefill_pad_tokens_total",
+            "prompt positions wasted on bucket padding"),
+        "ttft": reg.histogram(
+            "paddle_tpu_serving_ttft_seconds",
+            "time from enqueue to first generated token",
+            buckets=DEFAULT_BUCKETS),
+        "decode": reg.histogram(
+            "paddle_tpu_serving_decode_token_seconds",
+            "per-token decode latency (chunk wall time / tokens in "
+            "chunk)", buckets=_TOKEN_BUCKETS),
+        "steps": reg.counter("paddle_tpu_serving_decode_steps_total",
+                             "compiled decode dispatches"),
+    }
 
 
 def quantize_weights_int8(params: Dict[str, jnp.ndarray],
@@ -70,6 +111,7 @@ class _Request:
     prompt: np.ndarray              # [Lp] int32
     max_new_tokens: int
     out: List[int] = field(default_factory=list)
+    enqueued_at: float = 0.0        # perf_counter at add_request (TTFT)
 
 
 class ContinuousBatchingEngine:
@@ -147,6 +189,23 @@ class ContinuousBatchingEngine:
         self._queue: deque = deque()
         self._done: deque = deque()
         self._next_rid = 0
+
+        # telemetry: counters/histograms are shared process-wide; the
+        # occupancy gauges are pull-style (read at scrape, zero cost in
+        # the serving loop)
+        self._metrics = _serving_metrics()
+        from paddle_tpu.observability import default_registry, \
+            flight_recorder
+        self._recorder = flight_recorder()
+        reg = default_registry()
+        reg.gauge("paddle_tpu_serving_queue_depth",
+                  "requests waiting for a slot").set_function(
+            lambda q=self._queue: len(q))
+        reg.gauge("paddle_tpu_serving_active_slots",
+                  "slots currently decoding").set_function(
+            lambda a=self._active: sum(r is not None for r in a))
+        reg.gauge("paddle_tpu_serving_slots",
+                  "slot pool size").set(slots)
 
         # serving traces must see eval-mode (dropout off); remembered so
         # close() / context exit can hand the model back for training
@@ -273,7 +332,12 @@ class ContinuousBatchingEngine:
                              f"bucket {self.buckets[-1]}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, p, max_new_tokens))
+        self._queue.append(_Request(rid, p, max_new_tokens,
+                                    enqueued_at=time.perf_counter()))
+        self._metrics["requests"].inc()
+        self._recorder.record("serving.enqueue", rid=rid, prompt_len=len(p),
+                              max_new_tokens=max_new_tokens,
+                              queue_depth=len(self._queue))
         return rid
 
     def finished(self):
@@ -311,6 +375,17 @@ class ContinuousBatchingEngine:
                                     jnp.asarray(slot, jnp.int32))
         first = int(first)
         req.out.append(first)
+        m = self._metrics
+        m["admissions"].inc()
+        m["tokens"].inc()                       # the prefill's first token
+        m["bucket"].labels(bucket=str(Lb),
+                           fit="exact" if Lp == Lb else "padded").inc()
+        if Lb > Lp:
+            m["pad_tokens"].inc(Lb - Lp)
+        if req.enqueued_at:
+            m["ttft"].observe(time.perf_counter() - req.enqueued_at)
+        self._recorder.record("serving.admit", rid=req.rid, slot=slot,
+                              prompt_len=Lp, bucket=Lb)
         self._active[slot] = req
         self._pos[slot] = Lp          # decode writes OVER the pad rows
         self._budget[slot] = req.max_new_tokens - 1
@@ -323,6 +398,9 @@ class ContinuousBatchingEngine:
         req = self._active[slot]
         self._active[slot] = None
         self._done.append((req.rid, req.prompt, list(req.out)))
+        self._metrics["retirements"].inc()
+        self._recorder.record("serving.retire", rid=req.rid, slot=slot,
+                              generated=len(req.out))
 
     def step(self) -> bool:
         """One scheduling step.  Returns False when nothing is left."""
@@ -338,18 +416,23 @@ class ContinuousBatchingEngine:
         # reach (add_request enforces prompt+new <= max_len <= row max)
         pos = np.where(active, self._pos, self.max_len - 1).astype(np.int32)
         sub = self._next_key()
-        toks, self._caches = self._decode(
-            self._keep, self._quant, self._caches,
-            jnp.asarray(self._last_tok), jnp.asarray(pos),
-            jnp.asarray(active), sub)
-        toks = np.asarray(toks)                         # [B, K]
+        t0 = time.perf_counter()
+        with self._recorder.instrumented("serving.decode"):
+            toks, self._caches = self._decode(
+                self._keep, self._quant, self._caches,
+                jnp.asarray(self._last_tok), jnp.asarray(pos),
+                jnp.asarray(active), sub)
+            toks = np.asarray(toks)                     # [B, K]
+        chunk_dt = time.perf_counter() - t0
         K = toks.shape[1]
+        emitted = 0
         for i, req in enumerate(self._active):
             if req is None:
                 continue
             for j in range(K):
                 t = int(toks[i, j])
                 req.out.append(t)
+                emitted += 1
                 self._pos[i] += 1
                 self._budget[i] -= 1
                 self._last_tok[i] = t
@@ -363,6 +446,14 @@ class ContinuousBatchingEngine:
                     break
             else:
                 continue
+        m = self._metrics
+        m["steps"].inc()
+        if emitted:
+            m["tokens"].inc(emitted)
+            # per-token latency: one host interaction covers K sequential
+            # device steps over all active slots — a slot's token costs
+            # chunk time / K (the batch dimension is parallel)
+            m["decode"].observe(chunk_dt / K)
         return True
 
     def run(self):

@@ -14,6 +14,7 @@ every pytree is placed with NamedSharding; XLA/GSPMD inserts the collectives
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -25,6 +26,33 @@ from paddle_tpu.core.functional import functional_call, params_of, \
 __all__ = ["TrainStep", "CompiledStepBase"]
 
 
+def _train_metrics():
+    """Lazily created instruments on the default registry (shared by
+    every TrainStep in the process — that is what an operator scrapes)."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "step": reg.histogram(
+            "paddle_tpu_train_step_seconds",
+            "wall time of one compiled train step (fwd+bwd+update)"),
+        "steps": reg.counter("paddle_tpu_train_steps_total",
+                             "train steps executed"),
+        "tokens": reg.counter("paddle_tpu_train_tokens_total",
+                              "tokens consumed by train steps"),
+        "tps": reg.gauge("paddle_tpu_train_tokens_per_second",
+                         "tokens/s of the most recent train step"),
+        "loss": reg.gauge("paddle_tpu_train_loss",
+                          "loss of the most recent train step"),
+        "gnorm": reg.gauge("paddle_tpu_train_grad_norm",
+                           "global gradient norm of the most recent "
+                           "train step"),
+        "recompiles": reg.counter(
+            "paddle_tpu_train_recompiles_total",
+            "novel call signatures after the first — each one is a "
+            "silent retrace + XLA compile"),
+    }
+
+
 class CompiledStepBase:
     """Shared plumbing for compiled training steps (``TrainStep`` and
     ``distributed.PipelineTrainStep``): sharded placement of params and
@@ -32,7 +60,9 @@ class CompiledStepBase:
     and the checkpoint state_dict round-trip.  Subclasses build
     ``self._jitted`` with signature
     ``(params, opt_state, step_count, *step_args, lr) ->
-    (loss, params, opt_state, step_count)``."""
+    (loss, params, opt_state, step_count)`` — the loss slot may be any
+    pytree the subclass's caller unpacks (TrainStep returns
+    ``(loss, grad_norm)`` there for the telemetry gauges)."""
 
     def _init_step_state(self, optimizer, params, param_sh=None):
         """Place params on their shardings and derive optimizer state
@@ -216,6 +246,18 @@ class TrainStep(CompiledStepBase):
         self._init_step_state(optimizer, params, param_sh)
         self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
 
+        # always-on telemetry (observability tentpole): metric writes are
+        # dict lookups + float adds; the loss / grad-norm gauges hold the
+        # DEVICE scalar and only float() when an exporter scrapes, so the
+        # hot path never blocks on the device
+        self._metrics = _train_metrics()
+        from paddle_tpu.observability import flight_recorder
+        self._recorder = flight_recorder()
+        from paddle_tpu.analysis.recompile import SignatureMonitor
+        self._signature_monitor = SignatureMonitor(
+            name=f"TrainStep({type(model).__name__})")
+        self._host_steps = 0
+
     def _step_impl(self, params, opt_state, step_count, batch, key, lr):
         model, opt = self.model, self.optimizer
 
@@ -231,6 +273,11 @@ class TrainStep(CompiledStepBase):
         train_p = {n: v for n, v in params.items() if self._mask.get(n)}
         frozen_p = {n: v for n, v in params.items() if not self._mask.get(n)}
         loss, grads = jax.value_and_grad(loss_of_trainable)(train_p, frozen_p)
+        # global grad norm for the telemetry gauge: one vdot per leaf —
+        # noise next to the backward pass it rides on
+        gnorm = jnp.sqrt(sum(
+            (jnp.vdot(g, g).real for g in jax.tree.leaves(grads)),
+            start=jnp.zeros((), jnp.float32)))
         step_count = step_count + 1
         new_train, new_state = opt.apply_gradients(
             train_p, grads,
@@ -239,7 +286,7 @@ class TrainStep(CompiledStepBase):
         new_params.update(new_train)
         new_opt_state = dict(opt_state)
         new_opt_state.update(new_state)
-        return loss, new_params, new_opt_state, step_count
+        return (loss, gnorm), new_params, new_opt_state, step_count
 
     def __call__(self, batch):
         if self._batch_sh is not None:
@@ -250,8 +297,45 @@ class TrainStep(CompiledStepBase):
             batch = jax.tree.map(jnp.asarray, batch)
         if not self._analyzed:
             self._maybe_analyze(batch)
+        # recompile telemetry: a novel signature after the first call IS
+        # a retrace (jax.jit keys its executable cache the same way)
+        novel = self._signature_monitor.record((batch,))
+        if novel and self._signature_monitor.calls > 1:
+            self._metrics["recompiles"].inc()
+            self._recorder.record(
+                "train.recompile",
+                target=self._signature_monitor.name,
+                distinct_signatures=len(self._signature_monitor.records))
         self._key, sub = jax.random.split(self._key)
-        return self._run_jitted(batch, sub)
+        t0 = time.perf_counter()
+        with self._recorder.instrumented("train.step",
+                                         step=self._host_steps):
+            loss, gnorm = self._run_jitted(batch, sub)
+        dt = time.perf_counter() - t0
+        self._host_steps += 1
+        m = self._metrics
+        m["step"].observe(dt)
+        m["steps"].inc()
+        m["loss"].set(loss)     # device scalar, resolved at scrape
+        m["gnorm"].set(gnorm)
+        tokens = self._batch_tokens(batch)
+        if tokens:
+            m["tokens"].inc(tokens)
+            if dt > 0:
+                m["tps"].set(tokens / dt)
+        return loss
+
+    @staticmethod
+    def _batch_tokens(batch) -> int:
+        """Token count for throughput metrics: LM batches count
+        input_ids elements, (x, y) batches count examples."""
+        if isinstance(batch, dict) and "input_ids" in batch:
+            ids = batch["input_ids"]
+            return int(ids.size) if hasattr(ids, "size") else 0
+        leaves = jax.tree.leaves(batch)
+        if leaves and getattr(leaves[0], "ndim", 0):
+            return int(leaves[0].shape[0])
+        return 0
 
     def _maybe_analyze(self, batch):
         self._analyzed = True

@@ -1,0 +1,47 @@
+"""paddle_tpu.observability — always-on runtime telemetry.
+
+Three pieces (ISSUE 2 tentpole; see README.md in this package):
+
+* **metrics** — label-aware :class:`Counter` / :class:`Gauge` /
+  :class:`Histogram` in a process-wide registry.  Every hot loop in the
+  framework (``TrainStep``, ``ContinuousBatchingEngine``, elastic
+  restarts, checkpoint save/restore) writes here by default; the cost
+  with no exporter attached is a few dict lookups and float adds per
+  step.
+* **flight recorder** — a bounded ring of structured events whose
+  ``dump()`` auto-fires when an uncaught exception escapes an
+  instrumented loop, so dead runs leave their final N events behind.
+* **exposition** — Prometheus text at ``/metrics`` over stdlib
+  ``http.server`` (``PADDLE_TPU_METRICS_PORT``) and a JSONL snapshot
+  sink for offline diffing (``PADDLE_TPU_METRICS_JSONL``).
+
+Relationship to its siblings: ``paddle_tpu.analysis`` predicts cost
+statically, ``paddle_tpu.profiler`` measures a window you open by hand,
+observability *watches continuously* — drifting counters (recompiles,
+collective time, batch occupancy) surface regressions that a one-off
+trace only explains after the fact.  ``Profiler.summary()`` renders all
+three side by side.
+
+Demo: ``python -m paddle_tpu.observability.demo``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.observability.metrics import (Counter, Gauge, Histogram,
+                                              MetricsRegistry,
+                                              DEFAULT_BUCKETS,
+                                              default_registry)
+from paddle_tpu.observability.recorder import (FlightRecorder,
+                                               flight_recorder)
+from paddle_tpu.observability.exposition import (JsonlSink, MetricsServer,
+                                                 render_json,
+                                                 render_prometheus,
+                                                 start_metrics_server)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_BUCKETS", "default_registry",
+    "FlightRecorder", "flight_recorder",
+    "JsonlSink", "MetricsServer", "render_json", "render_prometheus",
+    "start_metrics_server",
+]

@@ -86,9 +86,9 @@ class _HostEvents:
         self._all = []
         self._lock = threading.Lock()
 
-    def add(self, name, t0, t1):
+    def add(self, name, t0, t1, event_type=None):
         with self._lock:
-            self._all.append((name, t0, t1))
+            self._all.append((name, t0, t1, event_type))
 
     def drain(self):
         with self._lock:
@@ -96,16 +96,40 @@ class _HostEvents:
         return out
 
 
+# Fallback sink ONLY for annotations recorded outside any profiler
+# session.  Each Profiler owns a private sink for its start..stop window
+# (registered in _SESSION_SINKS below): two concurrent — or sequential —
+# profilers no longer steal each other's RecordEvents when one stops
+# first and drains the shared global.
 _EVENTS = _HostEvents()
+_SESSION_SINKS: list = []
+_SINKS_LOCK = threading.Lock()
+
+
+def _deliver(name, t0, t1, event_type=None):
+    """Route a finished host event to every ACTIVE profiler session
+    (each gets its own copy), or to the global fallback when no session
+    is open."""
+    with _SINKS_LOCK:
+        sinks = list(_SESSION_SINKS)
+    if not sinks:
+        _EVENTS.add(name, t0, t1, event_type)
+        return
+    for sink in sinks:
+        sink.add(name, t0, t1, event_type)
 
 
 class RecordEvent:
     """Host-side annotation (reference platform/profiler/event_tracing.h
     RecordEvent).  Usable as context manager or decorator; events appear in
-    the device trace (TraceAnnotation) and in Profiler.summary()."""
+    the device trace (TraceAnnotation) and in Profiler.summary().
+    ``event_type`` (reference TracerEventType, e.g. "Forward",
+    "Communication") is kept and surfaces as the summary's type column
+    and the chrome-trace ``cat`` field."""
 
     def __init__(self, name: str, event_type=None):
         self.name = name
+        self.event_type = getattr(event_type, "name", event_type)
         self._ann = None
         self._t0 = None
 
@@ -123,7 +147,8 @@ class RecordEvent:
             self._ann.__exit__(None, None, None)
             self._ann = None
         if self._t0 is not None:
-            _EVENTS.add(self.name, self._t0, time.perf_counter())
+            _deliver(self.name, self._t0, time.perf_counter(),
+                     self.event_type)
             self._t0 = None
 
     def __enter__(self):
@@ -138,7 +163,7 @@ class RecordEvent:
 
         @functools.wraps(fn)
         def wrapped(*a, **k):
-            with RecordEvent(self.name):
+            with RecordEvent(self.name, self.event_type):
                 return fn(*a, **k)
         return wrapped
 
@@ -167,6 +192,10 @@ class Profiler:
         self._last_step_t = None
         self._diagnostics = []
         self._cost_summaries = []   # (target, CostSummary) pairs
+        # private host-event sink for this session (start() registers it,
+        # stop() unregisters + drains) — concurrent profilers each see
+        # their own events instead of racing over the module global
+        self._sink = _HostEvents()
 
     def add_diagnostics(self, diags):
         """Attach analysis findings; they render in ``summary()``."""
@@ -202,6 +231,9 @@ class Profiler:
     def start(self):
         self.current_state = self.scheduler(self.step_num) \
             if self.scheduler else ProfilerState.RECORD
+        with _SINKS_LOCK:
+            if self._sink not in _SESSION_SINKS:
+                _SESSION_SINKS.append(self._sink)
         if self.current_state in (ProfilerState.RECORD,
                                   ProfilerState.RECORD_AND_RETURN):
             self._start_trace()
@@ -210,7 +242,10 @@ class Profiler:
 
     def stop(self):
         self._stop_trace()
-        self._events.extend(_EVENTS.drain())
+        with _SINKS_LOCK:
+            if self._sink in _SESSION_SINKS:
+                _SESSION_SINKS.remove(self._sink)
+        self._events.extend(self._sink.drain())
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
         self.current_state = ProfilerState.CLOSED
@@ -243,7 +278,8 @@ class Profiler:
         msg = (f"avg {times.mean() * 1000:.2f}ms/step "
                f"(min {times.min() * 1000:.2f}, max {times.max() * 1000:.2f})")
         counts = [n for _, n in self._step_times if n]
-        if counts:
+        # fake-clock runs can record a 0 total — skip the rate, not crash
+        if counts and times.sum() > 0:
             ips = sum(counts) / times.sum()
             msg += f", {ips:.1f} {unit}/s"
         return msg
@@ -251,34 +287,76 @@ class Profiler:
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit: str = "ms"):
         """Host-annotation table (device-side detail lives in the XPlane
-        trace; reference summary tables: profiler_statistic.py)."""
-        self._events.extend(_EVENTS.drain())
+        trace; reference summary tables: profiler_statistic.py), plus the
+        analysis diagnostics / static-cost tables and a runtime-metrics
+        section — static cost, measured time, and live counters side by
+        side."""
+        self._events.extend(self._sink.drain())
         agg = {}
-        for name, t0, t1 in self._events:
-            tot, cnt = agg.get(name, (0.0, 0))
-            agg[name] = (tot + (t1 - t0), cnt + 1)
+        for name, t0, t1, etype in self._events:
+            key = (name, etype or "-")
+            tot, cnt = agg.get(key, (0.0, 0))
+            agg[key] = (tot + (t1 - t0), cnt + 1)
         scale = {"s": 1, "ms": 1e3, "us": 1e6}[time_unit]
-        lines = [f"{'name':40s} {'calls':>8s} "
+        lines = [f"{'name':40s} {'type':>14s} {'calls':>8s} "
                  f"{'total(' + time_unit + ')':>14s}"]
-        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"{name:40s} {cnt:8d} {tot * scale:14.3f}")
+        for (name, etype), (tot, cnt) in sorted(agg.items(),
+                                                key=lambda kv: -kv[1][0]):
+            lines.append(f"{name:40s} {str(etype):>14s} {cnt:8d} "
+                         f"{tot * scale:14.3f}")
         if self._diagnostics:
             lines.append(format_diagnostics(self._diagnostics))
         for target, cost in self._cost_summaries:
             lines.append(f"-- static cost model: {target} " + "-" * 20)
             lines.append(cost.table())
+        metrics = self._format_metrics()
+        if metrics:
+            lines.append(metrics)
         table = "\n".join(lines)
         print(table)
         return table
 
+    @staticmethod
+    def _format_metrics() -> str:
+        """Runtime-counter section from the observability registry (the
+        always-on telemetry the profiler window rode on top of).  Empty
+        string when nothing was recorded."""
+        from paddle_tpu.observability import default_registry
+        rows = []
+        for fam in default_registry().collect():
+            for s in fam["series"]:
+                labels = ",".join(f"{k}={v}"
+                                  for k, v in s["labels"].items())
+                name = fam["name"] + (f"{{{labels}}}" if labels else "")
+                if fam["kind"] == "histogram":
+                    sm = s["summary"]
+                    if not sm["count"]:
+                        continue
+                    rows.append(
+                        f"{name:58s} n={int(sm['count']):<8d} "
+                        f"p50={sm['p50'] * 1e3:.3f}ms "
+                        f"p90={sm['p90'] * 1e3:.3f}ms "
+                        f"p99={sm['p99'] * 1e3:.3f}ms")
+                else:
+                    v = s["value"]
+                    if v != v or not v:   # skip NaN and zero-valued
+                        continue
+                    rows.append(f"{name:58s} {v:g}")
+        if not rows:
+            return ""
+        return "\n".join(["-- runtime metrics (observability) " + "-" * 25]
+                         + rows)
+
     def export(self, path: str, format: str = "json"):
         """Chrome-trace export of host events (device XPlane is exported by
-        start/stop_trace into log_dir)."""
+        start/stop_trace into log_dir).  ``cat`` carries the RecordEvent
+        event_type so annotation categories survive into the trace."""
         import json
-        self._events.extend(_EVENTS.drain())
-        trace = [{"name": n, "ph": "X", "ts": t0 * 1e6,
-                  "dur": (t1 - t0) * 1e6, "pid": 0, "tid": 0}
-                 for n, t0, t1 in self._events]
+        self._events.extend(self._sink.drain())
+        trace = [{"name": n, "cat": str(etype or "host"), "ph": "X",
+                  "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6, "pid": 0,
+                  "tid": 0}
+                 for n, t0, t1, etype in self._events]
         with open(path, "w") as f:
             json.dump({"traceEvents": trace}, f)
 
@@ -305,8 +383,12 @@ def load_profiler_result(path: str):
 
 @contextlib.contextmanager
 def benchmark():
-    """Throughput timing context (reference dataloader benchmark hooks)."""
+    """Throughput timing context (reference dataloader benchmark hooks).
+    ``seconds`` is filled even when the body raises — a crashed run's
+    partial timing is exactly what the post-mortem wants."""
     t0 = time.perf_counter()
     box = {}
-    yield box
-    box["seconds"] = time.perf_counter() - t0
+    try:
+        yield box
+    finally:
+        box["seconds"] = time.perf_counter() - t0
