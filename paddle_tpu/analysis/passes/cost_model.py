@@ -92,6 +92,30 @@ def _eqn_flops(eqn) -> int:
     return out_elems  # generic elementwise
 
 
+def _pallas_grid(eqn) -> int:
+    gm = eqn.params.get("grid_mapping")
+    n = 1
+    for d in getattr(gm, "grid", ()) or ():
+        try:
+            n *= int(d)
+        except Exception:
+            pass
+    return max(n, 1)
+
+
+def _pallas_flops(eqn) -> int:
+    """FLOPs of a pallas_call: one grid step's kernel body (the inner
+    jaxpr computes on BLOCK-shaped avals) times the grid size."""
+    from paddle_tpu.analysis.tracing import _subjaxprs, walk_eqns
+    inner = eqn.params.get("jaxpr")
+    total = 0
+    if inner is not None:
+        for e, _, w in walk_eqns(inner):
+            if not _subjaxprs(e):
+                total += _eqn_flops(e) * w
+    return total * _pallas_grid(eqn)
+
+
 def _eqn_bytes(eqn) -> int:
     total = 0
     for v in eqn.invars:
@@ -184,13 +208,25 @@ def cost_model(ctx: PassContext) -> List[Diagnostic]:
     total_f = total_b = 0
     from paddle_tpu.analysis.tracing import _subjaxprs
     for eqn, path, weight in walk_eqns(ctx.jaxpr):
-        if _subjaxprs(eqn):
+        if "pallas_call[" in path:
+            # inner eqns of a hand-written kernel: block-shaped avals,
+            # accounted at the pallas_call eqn below
+            continue
+        if eqn.primitive.name == "pallas_call":
+            # a Pallas kernel's HBM traffic is its call-level operands +
+            # results — the point of hand-fusing: the fused CE reads the
+            # logits once and writes [T, 1] loss/lse, never the [T, V]
+            # fp32 log-softmax intermediate the unfused lowering charges
+            fl = _pallas_flops(eqn) * weight
+            by = _eqn_bytes(eqn) * weight
+        elif _subjaxprs(eqn):
             # container eqn (pjit/scan/while/cond/remat): its body's eqns
             # are walked separately — charging the call too would double
             # count every nested FLOP and byte
             continue
-        fl = _eqn_flops(eqn) * weight
-        by = _eqn_bytes(eqn) * weight
+        else:
+            fl = _eqn_flops(eqn) * weight
+            by = _eqn_bytes(eqn) * weight
         total_f += fl
         total_b += by
         agg = by_prim.setdefault(eqn.primitive.name, [0, 0, 0])

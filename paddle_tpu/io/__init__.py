@@ -7,11 +7,13 @@ from paddle_tpu.io.dataset import (  # noqa: F401
     random_split)
 from paddle_tpu.io.dataloader import (  # noqa: F401
     DataLoader, default_collate_fn, get_worker_info)
+from paddle_tpu.io.device_prefetch import (  # noqa: F401
+    DevicePrefetchIterator, device_prefetch)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ConcatDataset",
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "default_collate_fn",
-    "get_worker_info",
+    "get_worker_info", "DevicePrefetchIterator", "device_prefetch",
 ]

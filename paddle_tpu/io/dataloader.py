@@ -46,23 +46,56 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIterator:
+    """Background-thread prefetch with EXPLICIT lifecycle: a consumer
+    that stops iterating early (break / exception / GC) must not leave
+    the thread parked on a full queue or the pool holding in-flight
+    futures — ``close()`` (also fired by ``__del__`` and context exit)
+    stops the worker and finalizes the underlying generator, which
+    unwinds its ``finally`` blocks (future cancellation lives there)."""
+
     _STOP = object()
 
     def __init__(self, gen_fn: Callable[[], Iterable], depth: int):
         self._q: "queue.Queue" = queue.Queue(maxsize=depth)
         self._exc = None
         self._done = False
+        self._stop = threading.Event()
 
         def worker():
+            gen = gen_fn()
             try:
-                for item in gen_fn():
-                    self._q.put(item)
+                for item in gen:
+                    if self._stop.is_set():
+                        break
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    else:
+                        break
             except BaseException as e:  # propagate to consumer
                 self._exc = e
             finally:
-                self._q.put(self._STOP)
+                if hasattr(gen, "close"):
+                    try:
+                        gen.close()   # runs the generator's finally blocks
+                    except Exception:
+                        pass
+                # the sentinel must not be dropped on a full queue (the
+                # consumer would block forever); only give up once the
+                # consumer has explicitly closed
+                while True:
+                    try:
+                        self._q.put(self._STOP, timeout=0.05)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            break
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="paddle_tpu-dataloader-prefetch")
         self._thread.start()
 
     def __iter__(self):
@@ -78,6 +111,33 @@ class _PrefetchIterator:
                 raise self._exc
             raise StopIteration
         return item
+
+    def close(self):
+        """Stop the prefetch thread, finalize the source generator, and
+        drop buffered batches.  Idempotent."""
+        self._stop.set()
+        while True:  # unblock a worker stuck on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class DataLoader:
@@ -139,24 +199,31 @@ class DataLoader:
             it = iter(self.batch_sampler)
             import collections
             dq = collections.deque()
-            for _ in range(inflight):
-                try:
-                    dq.append(self._pool.submit(_fetch_worker,
-                                                self.dataset,
-                                                self.collate_fn,
-                                                next(it)))
-                except StopIteration:
-                    break
-            while dq:
-                fut = dq.popleft()
-                yield fut.result()
-                try:
-                    dq.append(self._pool.submit(_fetch_worker,
-                                                self.dataset,
-                                                self.collate_fn,
-                                                next(it)))
-                except StopIteration:
-                    pass
+            try:
+                for _ in range(inflight):
+                    try:
+                        dq.append(self._pool.submit(_fetch_worker,
+                                                    self.dataset,
+                                                    self.collate_fn,
+                                                    next(it)))
+                    except StopIteration:
+                        break
+                while dq:
+                    fut = dq.popleft()
+                    yield fut.result()
+                    try:
+                        dq.append(self._pool.submit(_fetch_worker,
+                                                    self.dataset,
+                                                    self.collate_fn,
+                                                    next(it)))
+                    except StopIteration:
+                        pass
+            finally:
+                # generator finalized early (consumer broke out): drop
+                # queued work so the pool drains instead of grinding
+                # through the whole epoch
+                for fut in dq:
+                    fut.cancel()
         else:
             if self.batch_sampler is None:
                 for i in range(len(self.dataset)):
@@ -185,9 +252,15 @@ class DataLoader:
             return _PrefetchIterator(gen, depth=self.prefetch_factor)
         return iter(gen())
 
-    def __del__(self):
+    def close(self):
+        """Shut down the worker pool.  Live ``_PrefetchIterator``s hold
+        their own ``close()``; call both when tearing down mid-epoch."""
         if self._pool is not None:
             self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
+
+    def __del__(self):
+        self.close()
 
 
 from collections import namedtuple

@@ -1,0 +1,174 @@
+"""Device-prefetch iterator — H2D transfer overlapped one batch ahead.
+
+The host-side ``DataLoader`` already overlaps batch ASSEMBLY with the
+step, but the ``jax.device_put`` (host→device DMA) still ran inline in
+the training loop: with a synchronous dispatch gap it serializes with
+the compiled step.  This iterator keeps a background thread one (or
+``depth``) batches ahead, so by the time the loop asks for batch N+1 its
+arrays are already device-resident — the double-buffering the reference
+gets from ``create_py_reader`` + the C++ blocking queue, done with one
+thread and XLA's transfer engine.
+
+Sharded placement: pass ``sharding=`` (a ``jax.sharding.Sharding``
+applied to every array leaf) or ``mesh=`` + ``spec=`` and each batch
+lands pre-sharded (the same placement ``TrainStep(batch_spec=...)``
+would do inline, minus the step-blocking transfer).
+
+Telemetry: ``paddle_tpu_prefetch_depth`` (pull gauge, current buffered
+batches), ``paddle_tpu_prefetch_batches_total``.
+
+Usage::
+
+    for batch in device_prefetch(loader, depth=2):
+        loss = step(batch)
+
+or explicitly close on early exit::
+
+    it = device_prefetch(gen())
+    with it:
+        for batch in it: ...
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+__all__ = ["DevicePrefetchIterator", "device_prefetch"]
+
+
+def _prefetch_metrics():
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "depth": reg.gauge(
+            "paddle_tpu_prefetch_depth",
+            "device-resident batches currently buffered ahead of the "
+            "training loop"),
+        "batches": reg.counter(
+            "paddle_tpu_prefetch_batches_total",
+            "batches moved host→device by the prefetch thread"),
+    }
+
+
+class DevicePrefetchIterator:
+    """Iterates ``src``, placing every batch on device from a background
+    thread ``depth`` batches ahead of the consumer."""
+
+    _STOP = object()
+
+    def __init__(self, src: Iterable, depth: int = 2, sharding=None,
+                 mesh=None, spec=None, device=None):
+        import jax
+
+        if sharding is None and mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            sharding = NamedSharding(mesh, spec if spec is not None
+                                     else PartitionSpec())
+        self._sharding = sharding
+        self._device = device
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._done = False
+        self._metrics = _prefetch_metrics()
+        self._metrics["depth"].set_function(self._q.qsize)
+
+        def place(batch) -> Any:
+            if self._sharding is not None:
+                return jax.device_put(batch, self._sharding)
+            if self._device is not None:
+                return jax.device_put(batch, self._device)
+            return jax.device_put(batch)
+
+        def worker():
+            it = iter(src)
+            try:
+                for item in it:
+                    if self._stop.is_set():
+                        break
+                    dev = place(item)
+                    self._metrics["batches"].inc()
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(dev, timeout=0.05)
+                            break
+                        except queue.Full:
+                            continue
+                    else:
+                        break
+            except BaseException as e:  # propagate to consumer
+                self._exc = e
+            finally:
+                if hasattr(it, "close"):
+                    try:
+                        it.close()
+                    except Exception:
+                        pass
+                # the sentinel must not be dropped on a full queue (the
+                # consumer would block forever); only give up once the
+                # consumer has explicitly closed
+                while True:
+                    try:
+                        self._q.put(self._STOP, timeout=0.05)
+                        break
+                    except queue.Full:
+                        if self._stop.is_set():
+                            break
+
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="paddle_tpu-device-prefetch")
+        self._thread.start()
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is self._STOP:
+            self._done = True
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the prefetch thread and drop buffered batches.  Safe to
+        call more than once; also runs on GC and context-manager exit so
+        a consumer that stops iterating early leaks nothing."""
+        self._stop.set()
+        while True:  # unblock a worker stuck on a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        thread = getattr(self, "_thread", None)
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=5.0)
+        self._done = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def device_prefetch(src: Iterable, depth: int = 2, sharding=None,
+                    mesh=None, spec=None, device=None) -> \
+        DevicePrefetchIterator:
+    """Wrap any batch iterable so host→device transfer happens ``depth``
+    batches ahead on a background thread (sharded placement when ``mesh``
+    — or an explicit ``sharding`` — is given)."""
+    return DevicePrefetchIterator(src, depth=depth, sharding=sharding,
+                                  mesh=mesh, spec=spec, device=device)

@@ -50,6 +50,10 @@ def _train_metrics():
             "paddle_tpu_train_recompiles_total",
             "novel call signatures after the first — each one is a "
             "silent retrace + XLA compile"),
+        "accum": reg.histogram(
+            "paddle_tpu_train_accum_microbatches",
+            "microbatches accumulated per optimizer update",
+            buckets=(1, 2, 4, 8, 16, 32, 64)),
     }
 
 
@@ -184,10 +188,18 @@ class TrainStep(CompiledStepBase):
                  mesh=None, param_specs: Optional[Dict[str, Any]] = None,
                  batch_spec=None, compute_dtype=None, seed: int = 0,
                  remat: bool = False, remat_policy: Optional[str] = None,
-                 analyze: Optional[str] = None):
+                 analyze: Optional[str] = None, accum_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # microbatch gradient accumulation: the batch's leading axis is
+        # split into accum_steps slices scanned sequentially with an fp32
+        # grad carry — activation memory is per-MICROBATCH, so effective
+        # batch grows without HBM blowup; equivalent to the full batch up
+        # to accumulation order
+        if int(accum_steps) < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self._accum_steps = int(accum_steps)
         # opt-in whole-step program analysis ("warn" prints findings on
         # the first step, "strict" raises on ERROR); default follows the
         # PADDLE_TPU_ANALYZE env var (paddle_tpu.analysis.analysis_mode)
@@ -261,18 +273,46 @@ class TrainStep(CompiledStepBase):
     def _step_impl(self, params, opt_state, step_count, batch, key, lr):
         model, opt = self.model, self.optimizer
 
-        def loss_of_trainable(train_params, frozen_params):
+        def loss_of_trainable(train_params, frozen_params, mb, k):
             full = dict(frozen_params)
             full.update(train_params)
-            f = lambda p: _loss_of(model, self.loss_fn, p, batch,
-                                   {"dropout": key})
+            f = lambda p: _loss_of(model, self.loss_fn, p, mb,
+                                   {"dropout": k})
             if self._remat:
                 f = jax.checkpoint(f, policy=self._remat_policy)
             return f(full)
 
         train_p = {n: v for n, v in params.items() if self._mask.get(n)}
         frozen_p = {n: v for n, v in params.items() if not self._mask.get(n)}
-        loss, grads = jax.value_and_grad(loss_of_trainable)(train_p, frozen_p)
+        n_acc = self._accum_steps
+        if n_acc == 1:
+            loss, grads = jax.value_and_grad(loss_of_trainable)(
+                train_p, frozen_p, batch, key)
+        else:
+            # scan over microbatches: loss/grads are the mean over slices
+            # (each slice weights equally, matching the full-batch mean
+            # for equal-size microbatches); the fp32 carry is donated
+            # buffer-reuse inside the scan, so peak memory holds ONE
+            # microbatch's activations + one fp32 grad copy
+            micro = jax.tree.map(
+                lambda a: a.reshape((n_acc, a.shape[0] // n_acc)
+                                    + a.shape[1:]), batch)
+            keys = jax.random.split(key, n_acc)
+            inv = 1.0 / n_acc
+
+            def one_micro(carry, xs):
+                loss_acc, g_acc = carry
+                mb, k = xs
+                l, g = jax.value_and_grad(loss_of_trainable)(
+                    train_p, frozen_p, mb, k)
+                g_acc = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32) * inv, g_acc, g)
+                return (loss_acc + l.astype(jnp.float32) * inv, g_acc), None
+
+            g0 = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32),
+                              train_p)
+            (loss, grads), _ = jax.lax.scan(
+                one_micro, (jnp.zeros((), jnp.float32), g0), (micro, keys))
         # global grad norm for the telemetry gauge: one vdot per leaf —
         # noise next to the backward pass it rides on
         gnorm = jnp.sqrt(sum(
@@ -294,7 +334,16 @@ class TrainStep(CompiledStepBase):
                 lambda a: jax.device_put(jnp.asarray(a), self._batch_sh),
                 batch)
         else:
+            # device-prefetched batches are already on device; asarray is
+            # a no-op for those and a copy for host numpy
             batch = jax.tree.map(jnp.asarray, batch)
+        if self._accum_steps > 1:
+            for leaf in jax.tree.leaves(batch):
+                if getattr(leaf, "ndim", 0) and \
+                        leaf.shape[0] % self._accum_steps:
+                    raise ValueError(
+                        f"batch leading dim {leaf.shape[0]} not divisible "
+                        f"by accum_steps={self._accum_steps}")
         if not self._analyzed:
             self._maybe_analyze(batch)
         # recompile telemetry: a novel signature after the first call IS
@@ -316,6 +365,7 @@ class TrainStep(CompiledStepBase):
         m = self._metrics
         m["step"].observe(dt)
         m["steps"].inc()
+        m["accum"].observe(self._accum_steps)
         m["loss"].set(loss)     # device scalar, resolved at scrape
         m["gnorm"].set(gnorm)
         tokens = self._batch_tokens(batch)

@@ -111,16 +111,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         # surrounding step better than the separate dq + dkv Pallas
         # dispatches (two extra HBM passes over q/k/v/g).  The Pallas
         # backward kernels remain available (pallas_bwd=True /
-        # PT_FLASH_PALLAS_BWD=1) and win in ISOLATED microbenches
-        # (benchmarks/pallas_kernels_bench.py) — a documented niche:
-        # standalone attention grads without a surrounding fusable step.
-        import os
-        from paddle_tpu.ops.pallas.flash_attention import flash_attention
-        pb_env = os.environ.get("PT_FLASH_PALLAS_BWD")
-        pb = (pb_env.strip().lower() in ("1", "true", "yes", "on")
-              if pb_env is not None else False)
+        # PADDLE_TPU_FLASH_BWD=1, legacy alias PT_FLASH_PALLAS_BWD) and
+        # win in ISOLATED microbenches (benchmarks/pallas_kernels_bench
+        # .py) — a documented niche: standalone attention grads without
+        # a surrounding fusable step.
+        from paddle_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                           flash_bwd_env)
+        pb = flash_bwd_env()
         return flash_attention(query, key, value, causal=is_causal,
-                               scale=scale, pallas_bwd=pb)
+                               scale=scale,
+                               pallas_bwd=False if pb is None else pb)
     dk = None
     if use_dropout:
         from paddle_tpu.core import functional as _cf

@@ -21,10 +21,49 @@ def _reduce(loss, reduction):
     return loss
 
 
+def _ce_route_counter():
+    from paddle_tpu.observability import default_registry
+    return default_registry().counter(
+        "paddle_tpu_fused_ce_calls_total",
+        "cross_entropy routing decisions by path (counted at trace time)",
+        labelnames=("path",))
+
+
 @eager_op
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):
+    # Fused Pallas fast path (hard labels, no class weights): the vocab
+    # axis streams through VMEM blockwise, so neither the fp32
+    # log-softmax nor the one-hot backward ever materializes at
+    # [batch, seq, vocab].  MUST route before the fp32 cast below — the
+    # cast is itself the [B, S, V] fp32 intermediate being avoided.
+    if (use_softmax and not soft_label and weight is None
+            and label_smoothing == 0.0 and input.ndim >= 2
+            and axis in (-1, input.ndim - 1)):
+        lbl = label
+        if lbl.ndim == input.ndim and lbl.shape[-1] == 1:
+            lbl = jnp.squeeze(lbl, axis=-1)
+        v = input.shape[-1]
+        if lbl.ndim == input.ndim - 1 and \
+                jnp.issubdtype(lbl.dtype, jnp.integer):
+            from paddle_tpu.ops.pallas.cross_entropy import (
+                fused_ce_eligible, fused_ce_enabled,
+                fused_softmax_cross_entropy)
+            t = int(lbl.size)
+            if fused_ce_enabled() and fused_ce_eligible(t, v):
+                _ce_route_counter().labels(path="fused").inc()
+                valid = lbl != ignore_index
+                safe = jnp.where(valid, lbl, 0)
+                per = fused_softmax_cross_entropy(
+                    input.reshape(-1, v), safe.reshape(-1))
+                loss = jnp.where(valid, per.reshape(lbl.shape), 0.0)
+                if reduction == "mean":
+                    denom = jnp.maximum(
+                        jnp.sum(valid.astype(jnp.float32)), 1.0)
+                    return jnp.sum(loss) / denom
+                return _reduce(loss, reduction)
+            _ce_route_counter().labels(path="fallback").inc()
     x = input.astype(jnp.float32)
     if use_softmax:
         logp = jax.nn.log_softmax(x, axis=axis)
@@ -40,6 +79,10 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if weight is not None:
             w = jnp.sum(tgt * weight, axis=axis)
             loss = loss * w
+            # weighted mean divides by the sum of weights (matching the
+            # hard-label branch below), not the element count
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
         return _reduce(loss, reduction)
 
     lbl = label

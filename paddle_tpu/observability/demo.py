@@ -50,11 +50,16 @@ def main(argv=None) -> int:
     # -- train: populates the step-latency histogram + loss/grad gauges
     opt = pp.optimizer.SGD(learning_rate=1e-2,
                            parameters=model.parameters())
-    step = TrainStep(model, opt)
+    step = TrainStep(model, opt, accum_steps=2)
     rng = np.random.default_rng(0)
     ids = rng.integers(0, 256, (2, 16)).astype(np.int32)
-    for i in range(args.train_steps):
-        loss = step({"input_ids": ids, "labels": ids})
+    # batches arrive device-resident one step ahead (device_prefetch),
+    # populating the prefetch gauge/counter alongside the train metrics
+    from paddle_tpu.io import device_prefetch
+    for batch in device_prefetch(
+            ({"input_ids": ids, "labels": ids}
+             for _ in range(args.train_steps)), depth=2):
+        loss = step(batch)
     print(f"[demo] trained {args.train_steps} steps, "
           f"loss={float(loss):.4f}", file=sys.stderr)
 

@@ -22,7 +22,8 @@ import os
 import time
 from typing import Callable, Dict, Sequence, Tuple
 
-__all__ = ["autotune", "flash_block_sizes", "cache_path", "clear_cache"]
+__all__ = ["autotune", "flash_block_sizes", "ce_block_sizes", "cache_path",
+           "clear_cache"]
 
 _mem_cache: Dict[str, object] = {}
 _loaded = False
@@ -212,3 +213,70 @@ def flash_block_sizes(b: int, s: int, h: int, hk: int, d: int,
         return (time.perf_counter() - t0) / iters
 
     return tuple(autotune("flash", key, cands, bench, default))
+
+
+def _ce_candidates(t: int, v: int, dtype: str) -> list:
+    """(block_t, block_v) candidates for the fused cross-entropy: the
+    vocab block must divide V; VMEM holds the io block (double-buffered)
+    plus one fp32 working copy and the [bt, 1] statistics."""
+    itemsize = 2 if "bfloat16" in dtype or "float16" in dtype else 4
+    out = []
+    for bt in (64, 128, 256):
+        if bt > max(t, 8):
+            continue
+        for bv in (256, 512, 1024, 2048):
+            if v % bv:
+                continue
+            vmem = bt * bv * (2 * itemsize + 4) + 8 * bt * 4
+            if vmem < 10 * (1 << 20):
+                out.append((bt, bv))
+    if not out:
+        from paddle_tpu.ops.pallas.cross_entropy import _default_blocks
+        out = [_default_blocks(t, v)]
+    return out
+
+
+def ce_block_sizes(t: int, v: int, dtype: str) -> Tuple[int, int]:
+    """Measured (block_t, block_v) for the fused cross-entropy at this
+    [tokens, vocab] shape (loss + grad timed together — the backward is
+    where the one-hot traffic used to live)."""
+    from paddle_tpu.ops.pallas.cross_entropy import _default_blocks
+    default = _default_blocks(t, v)
+    cands = _ce_candidates(t, v, dtype)
+    if len(cands) == 1:
+        return tuple(cands[0])
+    key = f"t{t}v{v}{dtype}@{_device_tag()}"
+
+    def bench(blocks):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from paddle_tpu.ops.pallas.cross_entropy import \
+            fused_softmax_cross_entropy
+
+        bt, bv = blocks
+        iters = 8
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(dtype)
+        x = jnp.asarray(rng.standard_normal((t, v)), dt)
+        lbl = jnp.asarray(rng.integers(0, v, (t,)), jnp.int32)
+
+        @jax.jit
+        def run(x_, lbl_):
+            def loss(a):
+                return jnp.sum(fused_softmax_cross_entropy(
+                    a, lbl_, block_t=bt, block_v=bv, autotune=False))
+
+            def body(i, carry):
+                g = jax.grad(loss)(x_ * (1 + carry * 1e-12).astype(dt))
+                return carry + jnp.sum(jnp.abs(g).astype(jnp.float32))
+            return lax.fori_loop(0, iters, body, 0.0)
+
+        np.asarray(run(x, lbl))                       # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(run(x, lbl))
+        return (time.perf_counter() - t0) / iters
+
+    return tuple(autotune("fused_ce", key, cands, bench, default))

@@ -30,6 +30,7 @@ kernels see [batch, heads, seq, head_dim].
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +43,27 @@ except Exception:  # pragma: no cover
     pltpu = None
     _HAVE_TPU_PL = False
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_bwd_env"]
+
+
+def flash_bwd_env():
+    """Backward-implementation override from the environment:
+    ``PADDLE_TPU_FLASH_BWD=1`` forces the Pallas dq/dkv kernels, ``0``
+    the blockwise-jax recompute; unset → None (autotuner / call site
+    decides).  ``PT_FLASH_PALLAS_BWD`` is honored as a legacy alias."""
+    raw = os.environ.get("PADDLE_TPU_FLASH_BWD",
+                         os.environ.get("PT_FLASH_PALLAS_BWD"))
+    if raw is None:
+        return None
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _bwd_path_counter():
+    from paddle_tpu.observability import default_registry
+    return default_registry().counter(
+        "paddle_tpu_flash_bwd_path_total",
+        "flash-attention backward implementation chosen at trace time",
+        labelnames=("path",))
 
 _NEG_INF = -1e30
 
@@ -478,6 +499,8 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         interpret = jax.default_backend() != "tpu"
     if autotune is None:
         autotune = not interpret
+    if pallas_bwd is None:
+        pallas_bwd = flash_bwd_env()
     if block_q is None or block_k is None or pallas_bwd is None:
         if autotune and not interpret:
             from paddle_tpu.ops.pallas.autotune import flash_block_sizes
@@ -498,6 +521,10 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     if s % block_q or s % block_k:
         raise ValueError(f"seq {s} must be divisible by block sizes "
                          f"({block_q},{block_k})")
+
+    # trace-time telemetry: which backward this compile will run
+    _bwd_path_counter().labels(
+        path="pallas" if pallas_bwd else "blockwise").inc()
 
     def to_bhsd(x):
         return jnp.swapaxes(x, 1, 2)
