@@ -68,6 +68,7 @@ from paddle_tpu import nn  # noqa: F401,E402
 from paddle_tpu import optimizer  # noqa: F401,E402
 from paddle_tpu import observability  # noqa: F401,E402
 from paddle_tpu import profiler  # noqa: F401,E402
+from paddle_tpu import robustness  # noqa: F401,E402
 from paddle_tpu import sparse  # noqa: F401,E402
 from paddle_tpu import text  # noqa: F401,E402
 from paddle_tpu import hub  # noqa: F401,E402

@@ -32,10 +32,13 @@ Format 2 layout (format 1 = one global .npy per tensor remains loadable):
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
+import logging
 import os
 import threading
 import time
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -44,6 +47,8 @@ __all__ = ["save_state_dict", "load_state_dict", "async_save_state_dict",
            "validate_checkpoint", "Converter", "AutoCheckpoint"]
 
 _SENTINEL = "checkpoint_meta.json"
+
+_log = logging.getLogger("paddle_tpu.robustness.checkpoint")
 
 _DURATION_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1, 5, 15, 60, 300, 900)
 
@@ -92,6 +97,59 @@ def _shard_fname(name: str, offsets: List[List[int]]) -> str:
     return f"{safe}.shard.{tag}.npy"
 
 
+def _file_digest(path: str) -> Dict[str, Any]:
+    """Integrity metadata for one written shard file: byte length +
+    crc32 always (cheap — zlib streams at GB/s), sha256 additionally when
+    ``PADDLE_TPU_CKPT_DIGEST=sha256`` (collision-resistant, for storage
+    you genuinely distrust).  Computed over the final FILE bytes, so the
+    validator re-reads exactly what a load would."""
+    crc = 0
+    sha = hashlib.sha256() if \
+        os.environ.get("PADDLE_TPU_CKPT_DIGEST") == "sha256" else None
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            n += len(chunk)
+            crc = zlib.crc32(chunk, crc)
+            if sha is not None:
+                sha.update(chunk)
+    out: Dict[str, Any] = {"bytes": n, "crc32": crc & 0xFFFFFFFF}
+    if sha is not None:
+        out["sha256"] = sha.hexdigest()
+    return out
+
+
+def _verify_shard_file(path: str, entry: dict) -> Optional[str]:
+    """None when the on-disk file matches the index entry's digests;
+    otherwise a human-readable reason.  Entries from pre-digest
+    checkpoints (no ``bytes``/``crc32`` keys) verify trivially."""
+    if "bytes" in entry:
+        actual = os.path.getsize(path)
+        if actual != entry["bytes"]:
+            return (f"{os.path.basename(path)}: size {actual} != recorded "
+                    f"{entry['bytes']} (truncated/torn write)")
+    if "crc32" in entry or "sha256" in entry:
+        crc = 0
+        sha = hashlib.sha256() if "sha256" in entry else None
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+                if sha is not None:
+                    sha.update(chunk)
+        if "crc32" in entry and (crc & 0xFFFFFFFF) != entry["crc32"]:
+            return (f"{os.path.basename(path)}: crc32 mismatch "
+                    f"(bit rot / partial overwrite)")
+        if sha is not None and sha.hexdigest() != entry["sha256"]:
+            return f"{os.path.basename(path)}: sha256 mismatch"
+    return None
+
+
 def _snapshot_shards(state_dict: Dict[str, Any],
                      coordinator_rank: int = 0) -> Dict[str, dict]:
     """Device → host, addressable shards only (replica 0 of each piece).
@@ -128,13 +186,39 @@ def _snapshot_shards(state_dict: Dict[str, Any],
 def _purge_stale(path: str):
     """Remove any previous checkpoint artifacts so a re-save under a
     different sharding cannot leave stale offset-tagged shard files that
-    a later load would merge with the new ones."""
-    for pattern in ("index.*.json", "*.shard.npy", "*.shard.*.npy"):
+    a later load would merge with the new ones — including orphaned
+    ``*.tmp.*`` files from saves interrupted between write and rename."""
+    for pattern in ("index.*.json", "*.shard.npy", "*.shard.*.npy",
+                    "*.tmp.*"):
         for f in glob.glob(os.path.join(glob.escape(path), pattern)):
             os.remove(f)
     sentinel = os.path.join(path, _SENTINEL)
     if os.path.exists(sentinel):
         os.remove(sentinel)
+
+
+def _write_shard(path: str, fname: str, data: np.ndarray) -> dict:
+    """Atomic shard publish: write to a pid-tagged tmp file, digest it,
+    rename into place.  A crash at ANY point leaves either no file or a
+    ``.tmp.*`` orphan (purged by the next save / validator-invisible) —
+    never a half-written file under the final name.  Returns the digest
+    entry fields for the index."""
+    from paddle_tpu.robustness import fault_fires, fault_point
+    final = os.path.join(path, fname)
+    tmp = final + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:  # handle, not path: np.save must not
+        np.save(f, data)        # append ".npy" to the tmp name
+    digest = _file_digest(tmp)
+    # chaos: crash-before-publish — the tmp orphan must be invisible to
+    # loads and cleaned by the next save's purge
+    fault_point("checkpoint.shard_write", file=fname)
+    if fault_fires("checkpoint.torn_shard", file=fname):
+        # chaos: torn write / silent corruption — the recorded digest is
+        # of the INTENDED bytes, so validation must catch the mismatch
+        with open(tmp, "r+b") as f:
+            f.truncate(max(1, digest["bytes"] // 2))
+    os.replace(tmp, final)
+    return digest
 
 
 def _write_plan(plan: Dict[str, dict], path: str, barrier: bool = True):
@@ -179,18 +263,27 @@ def _write_plan_inner(plan: Dict[str, dict], path: str,
         entries = []
         for offsets, data in tmeta["shards"]:
             fname = _shard_fname(name, offsets)
-            np.save(os.path.join(path, fname), data)
-            entries.append({"file": fname, "offsets": offsets})
+            digest = _write_shard(path, fname, data)
+            entries.append({"file": fname, "offsets": offsets, **digest})
         index[name] = {"global_shape": tmeta["global_shape"],
                        "dtype": tmeta["dtype"], "shards": entries}
-    with open(os.path.join(path, f"index.{proc}.json"), "w") as f:
-        json.dump({"tensors": index, "process": proc}, f)
+    _atomic_json(os.path.join(path, f"index.{proc}.json"),
+                 {"tensors": index, "process": proc})
     if nprocs > 1 and barrier:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_save:{path}")
     if proc == 0:
-        with open(os.path.join(path, _SENTINEL), "w") as f:
-            json.dump({"format": 2, "nprocs": nprocs}, f)
+        _atomic_json(os.path.join(path, _SENTINEL),
+                     {"format": 2, "nprocs": nprocs})
+
+
+def _atomic_json(path: str, obj):
+    """tmp+rename JSON write: a crash mid-dump must not leave a
+    truncated (unparseable) index/sentinel under the final name."""
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
@@ -363,34 +456,63 @@ def _load_format1(path, tensors, mesh, specs, dtype):
     return out
 
 
-def validate_checkpoint(path: str) -> bool:
-    """Metadata-only global completeness check: sentinel + all per-process
-    index files present, every referenced shard file on disk, and every
-    tensor's FULL global region exactly tiled by its shard entries.
+def validate_checkpoint(path: str,
+                        verify_digests: Optional[bool] = None) -> bool:
+    """Global integrity check: sentinel + all per-process index files
+    present and parseable, every referenced shard file on disk, every
+    tensor's FULL global region exactly tiled by its shard entries, and
+    (by default) every shard file's size + crc32/sha256 matching the
+    digests recorded at save time — so a torn write or bit rot fails
+    validation instead of surfacing as a crash (or silent corruption) at
+    load.  ``verify_digests=False`` (or ``PADDLE_TPU_CKPT_VERIFY=meta``)
+    skips the content re-read for very large checkpoints.
 
-    Because it inspects only (shared-storage) metadata — never local
-    device regions — every process reaches the SAME verdict, which is what
-    lets multi-controller ``restore_latest`` agree on a resume step."""
+    Returns False with a logged reason on ANY defect — truncated or
+    unparseable index/sentinel included — never raises.  Because every
+    process reads the same shared-storage artifacts, every process
+    reaches the SAME verdict, which is what lets multi-controller
+    ``restore_latest`` agree on a resume step."""
+    if verify_digests is None:
+        verify_digests = os.environ.get(
+            "PADDLE_TPU_CKPT_VERIFY", "digest") != "meta"
+
+    def invalid(reason: str) -> bool:
+        _log.warning("invalid checkpoint at %s: %s", path, reason)
+        try:
+            from paddle_tpu.observability import flight_recorder
+            flight_recorder().record("checkpoint.validate_failed",
+                                     path=path, reason=reason[:200])
+        except Exception:
+            pass
+        return False
+
     try:
         with open(os.path.join(path, _SENTINEL)) as f:
             meta = json.load(f)
         if meta.get("format", 1) < 2:
-            return all(os.path.exists(os.path.join(path, i["file"]))
-                       for i in meta["tensors"].values())
+            for i in meta["tensors"].values():
+                if not os.path.exists(os.path.join(path, i["file"])):
+                    return invalid(f"missing tensor file {i['file']}")
+            return True
         tensors = _merge_indexes(path, expected_nprocs=meta.get("nprocs"))
-        for tmeta in tensors.values():
+        for name, tmeta in tensors.items():
             shards = tmeta["shards"]
             for sh in shards:
-                if not os.path.exists(os.path.join(path, sh["file"])):
-                    return False
+                fpath = os.path.join(path, sh["file"])
+                if not os.path.exists(fpath):
+                    return invalid(f"{name}: missing shard {sh['file']}")
+                if verify_digests:
+                    reason = _verify_shard_file(fpath, sh)
+                    if reason is not None:
+                        return invalid(f"{name}: {reason}")
             gshape = tmeta["global_shape"]
             if not gshape:
                 _check_0d(shards)  # raises → caught below
             else:
                 _tile_region(shards, [[0, d] for d in gshape])
         return True
-    except (ValueError, OSError, KeyError, json.JSONDecodeError):
-        return False
+    except (ValueError, OSError, KeyError, json.JSONDecodeError) as e:
+        return invalid(f"{type(e).__name__}: {e}")
 
 
 class _AsyncSave:
@@ -576,21 +698,56 @@ class AutoCheckpoint:
         return self._pending
 
     def restore_latest(self, mesh=None, specs=None):
-        """Restore from the newest LOADABLE checkpoint.  The sentinel can
-        exist for an incomplete multi-controller async save that was cut
-        down mid-write; the under-coverage check in load surfaces that, and
-        we fall back to the next-older checkpoint instead of failing the
-        whole resume."""
+        """Restore from the newest VALID checkpoint (digest-verified),
+        falling back step by step past corrupted ones.  A checkpoint the
+        validator passed can still fail to load (storage fault between
+        validate and read); that too falls back to the next-older valid
+        step rather than failing the whole resume.  Both the validator
+        and the loader are deterministic over shared storage, so every
+        process picks the SAME step.  Only when NO candidate loads does
+        the last error propagate — silently restarting from step 0 would
+        let subsequent saves + GC destroy the surviving good checkpoints."""
         steps = self._complete_steps()
         if not steps:
             return None, None
-        # The metadata validator is deterministic over shared storage, so
-        # every process picks the SAME step.  A load failure on a
-        # validated checkpoint is a real storage fault — propagate it
-        # rather than silently restarting from step 0 (where subsequent
-        # saves + GC would destroy the surviving good checkpoints).
-        return steps[0], load_state_dict(self._step_dir(steps[0]),
-                                         mesh=mesh, specs=specs)
+        last_err = None
+        for step in steps:
+            try:
+                return step, load_state_dict(self._step_dir(step),
+                                             mesh=mesh, specs=specs)
+            except Exception as e:  # noqa: BLE001 — re-raised when all fail
+                last_err = e
+                _log.warning("checkpoint step %d validated but failed to "
+                             "load (%s: %s); falling back to next-older",
+                             step, type(e).__name__, e)
+                from paddle_tpu.observability import flight_recorder
+                flight_recorder().record("checkpoint.restore_fallback",
+                                         step=step,
+                                         error=type(e).__name__)
+        raise last_err
+
+    def save_now(self, step: int, state_dict: Dict[str, Any]) -> str:
+        """SYNCHRONOUS save for the preemption drain path: wait out any
+        in-flight async save, then write `step` to durable storage before
+        returning — the caller is about to exit and must not leave the
+        final checkpoint on a daemon thread."""
+        import jax
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices("ckpt_prev_complete")
+        step_dir = self._step_dir(step)
+        import shutil
+        if jax.process_index() == 0:
+            shutil.rmtree(step_dir, ignore_errors=True)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_fresh:{step}")
+        save_state_dict(state_dict, step_dir)
+        self._gc(step)
+        return step_dir
 
     def _gc(self, current_step: int):
         """Keep the newest `keep-1` COMPLETE checkpoints (the in-flight

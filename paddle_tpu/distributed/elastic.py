@@ -33,6 +33,8 @@ Two tiers:
 from __future__ import annotations
 
 import os
+import random
+import signal
 import socket
 import subprocess
 import sys
@@ -44,6 +46,30 @@ from paddle_tpu.distributed.tcp_store import TCPStore
 
 __all__ = ["ElasticAgent", "ElasticManager", "MultiNodeElasticAgent",
            "free_port"]
+
+_DRAIN_KEY = "elastic/drain"
+
+
+def _install_drain_handlers(on_signal):
+    """Route SIGTERM/SIGINT to `on_signal(signum)`; returns the previous
+    handlers for restoration (empty when not on the main thread, where
+    the signal module refuses installs — callers just skip the feature)."""
+    old = {}
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            old[sig] = signal.signal(
+                sig, lambda signum, frame: on_signal(signum))
+    except ValueError:  # not the main thread
+        old.clear()
+    return old
+
+
+def _restore_handlers(old):
+    for sig, handler in old.items():
+        try:
+            signal.signal(sig, handler)
+        except (ValueError, TypeError):
+            pass
 
 
 def _elastic_metrics():
@@ -79,10 +105,18 @@ class ElasticAgent:
     Reads PADDLE_ELASTIC_STORE / PADDLE_ELASTIC_GEN / PADDLE_TRAINER_ID
     from the env the manager sets; a daemon thread refreshes
     ``hb/<gen>/<rank>`` every ``interval`` seconds.
+
+    Preemption awareness: the heartbeat thread also polls the manager's
+    ``elastic/drain`` key, and (by default) SIGTERM/SIGINT in the worker
+    sets the same flag — either way :attr:`draining` flips True and the
+    training loop is expected to write a final synchronous checkpoint
+    (``AutoCheckpoint.save_now``) and exit 0 before the manager's
+    ``drain_timeout`` expires.
     """
 
     def __init__(self, rank: Optional[int] = None,
-                 store: Optional[TCPStore] = None, interval: float = 0.5):
+                 store: Optional[TCPStore] = None, interval: float = 0.5,
+                 handle_signals: bool = True):
         addr = os.environ.get("PADDLE_ELASTIC_STORE")
         if store is None:
             if not addr:
@@ -97,22 +131,40 @@ class ElasticAgent:
         self._key = f"hb/{self.generation}/{self.rank}"
         self._interval = interval
         self._stop = threading.Event()
+        self._drain = threading.Event()
+        self._old_handlers = _install_drain_handlers(
+            lambda signum: self._drain.set()) if handle_signals else {}
         self._beat()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _beat(self):
+        from paddle_tpu.robustness import fault_fires
+        if fault_fires("elastic.heartbeat", rank=self.rank,
+                       generation=self.generation):
+            return  # chaos: this beat is lost (hang / network loss)
         self._store.set(self._key, repr(time.time()).encode())
 
     def _loop(self):
         while not self._stop.wait(self._interval):
             try:
                 self._beat()
+                if not self._drain.is_set() and \
+                        self._store.check(_DRAIN_KEY):
+                    self._drain.set()
             except Exception:
                 return  # store gone: manager is tearing the generation down
 
+    @property
+    def draining(self) -> bool:
+        """True once a preemption drain was requested (manager store key
+        or a SIGTERM/SIGINT delivered to this worker): checkpoint NOW and
+        exit 0."""
+        return self._drain.is_set()
+
     def stop(self):
         self._stop.set()
+        _restore_handlers(self._old_handlers)
 
 
 class ElasticManager:
@@ -123,13 +175,25 @@ class ElasticManager:
     env; any non-zero exit or heartbeat staleness fails the generation,
     which is killed and relaunched up to ``max_restarts`` times.
     Training scripts resume via AutoCheckpoint.restore_latest().
+
+    Robustness tentpole additions: SIGTERM/SIGINT triggers a graceful
+    drain (workers signaled + ``elastic/drain`` store flag, bounded wait
+    for their final synchronous checkpoint, exit 0 iff all left
+    cleanly); failed generations relaunch with exponential backoff +
+    jitter; ``circuit_fast_failures`` consecutive sub-
+    ``circuit_min_uptime`` generations open a circuit breaker instead
+    of burning the whole restart budget on a hopeless loop.
     """
 
     def __init__(self, cmd: Sequence[str], nproc: int = 1,
                  max_restarts: int = 3, heartbeat_timeout: float = 10.0,
                  poll_interval: float = 0.2,
                  env: Optional[Dict[str, str]] = None,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 drain_timeout: float = 30.0,
+                 backoff_base: float = 0.5, backoff_max: float = 30.0,
+                 circuit_fast_failures: int = 5,
+                 circuit_min_uptime: float = 5.0):
         self.cmd = list(cmd)
         self.nproc = nproc
         self.max_restarts = max_restarts
@@ -139,6 +203,20 @@ class ElasticManager:
         self.log_dir = log_dir
         self.restarts = 0
         self.generation = 0
+        # preemption drain: SIGTERM/SIGINT → signal workers, bounded wait
+        # for their final synchronous checkpoint, exit 0 — never hard-kill
+        self.drain_timeout = drain_timeout
+        self._drain_signal: Optional[int] = None
+        # relaunch pacing: exponential backoff + jitter between failed
+        # generations (a crashing dependency gets time to recover instead
+        # of being hammered), and a circuit breaker that stops relaunching
+        # after `circuit_fast_failures` CONSECUTIVE generations each dying
+        # within `circuit_min_uptime` seconds — a restart loop that never
+        # reaches useful uptime burns quota without making progress
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.circuit_fast_failures = circuit_fast_failures
+        self.circuit_min_uptime = circuit_min_uptime
         self._port = free_port()
         self._store = TCPStore("127.0.0.1", self._port, is_master=True)
 
@@ -196,9 +274,12 @@ class ElasticManager:
                 return False
         return True
 
-    def _watch(self, procs: List[subprocess.Popen]) -> bool:
-        """True when all workers exit 0; False on any failure."""
+    def _watch(self, procs: List[subprocess.Popen]):
+        """True when all workers exit 0; False on any failure; "drain"
+        when a preemption signal arrived (graceful drain already ran)."""
         while True:
+            if self._drain_signal is not None:
+                return "drain"
             alive = False
             for p in procs:
                 rc = p.poll()
@@ -211,6 +292,16 @@ class ElasticManager:
             if not self._heartbeats_fresh(time.time(), procs):
                 return False
             time.sleep(self.poll_interval)
+
+    def _graceful_drain(self, procs: List[subprocess.Popen]) -> int:
+        """Preemption path: publish the drain flag (agents poll it),
+        forward SIGTERM to every live worker, wait up to `drain_timeout`
+        for them to write their final checkpoint and exit, then report
+        0 only if every worker left cleanly.  Stragglers are killed —
+        the platform's hard deadline is coming either way."""
+        return _drain_workers(self._store, procs, self.drain_timeout,
+                              generation=self.generation,
+                              signal=self._drain_signal)
 
     def _kill_all(self, procs: List[subprocess.Popen]):
         for p in procs:
@@ -237,49 +328,97 @@ class ElasticManager:
         metrics = _elastic_metrics()
         recorder = flight_recorder()
         infra_retries = 0
-        while True:
-            self._gen_hb_seen = False
-            started = time.time()
-            metrics["generation"].set(self.generation)
-            recorder.record("elastic.spawn", generation=self.generation,
-                            nproc=self.nproc, restarts=self.restarts)
-            procs = []
-            try:
-                procs = self._spawn()
-                ok = self._watch(procs)
-            finally:
-                self._kill_all(procs)
-                for f in getattr(self, "_log_files", []):
-                    f.close()
-            metrics["gen_seconds"].observe(time.time() - started)
-            if ok:
-                recorder.record("elastic.done", generation=self.generation)
-                return 0
-            # final sweep: the generation may have died between heartbeat
-            # polls — an hb key in the store means workers DID come up
-            self._gen_hb_seen = self._gen_hb_seen or any(
-                self._store.check(f"hb/{self.generation}/{r}")
-                for r in range(self.nproc))
-            fast_infra_fail = (not self._gen_hb_seen
-                               and time.time() - started
-                               < min(self.heartbeat_timeout, 10.0))
-            recorder.record("elastic.generation_failed",
-                            generation=self.generation,
-                            infra=fast_infra_fail,
-                            hb_seen=self._gen_hb_seen)
-            if fast_infra_fail and infra_retries < 3:
-                infra_retries += 1  # global cap: never re-arms
-                metrics["restarts"].labels(reason="infra").inc()
-                self.generation += 1
-                continue
-            self.restarts += 1
-            metrics["restarts"].labels(reason="fail").inc()
-            if self.restarts > self.max_restarts:
-                recorder.record("elastic.exhausted",
+        fast_fail_streak = 0
+        old_handlers = _install_drain_handlers(self._on_drain_signal)
+        try:
+            while True:
+                self._gen_hb_seen = False
+                started = time.time()
+                metrics["generation"].set(self.generation)
+                recorder.record("elastic.spawn",
                                 generation=self.generation,
-                                restarts=self.restarts)
-                return 1
-            self.generation += 1
+                                nproc=self.nproc, restarts=self.restarts)
+                procs, drain_rc = [], None
+                try:
+                    procs = self._spawn()
+                    ok = self._watch(procs)
+                    if ok == "drain":
+                        drain_rc = self._graceful_drain(procs)
+                finally:
+                    self._kill_all(procs)
+                    for f in getattr(self, "_log_files", []):
+                        f.close()
+                metrics["gen_seconds"].observe(time.time() - started)
+                if ok == "drain":
+                    return drain_rc
+                if ok:
+                    recorder.record("elastic.done",
+                                    generation=self.generation)
+                    return 0
+                # final sweep: the generation may have died between
+                # heartbeat polls — an hb key in the store means workers
+                # DID come up
+                self._gen_hb_seen = self._gen_hb_seen or any(
+                    self._store.check(f"hb/{self.generation}/{r}")
+                    for r in range(self.nproc))
+                fast_infra_fail = (not self._gen_hb_seen
+                                   and time.time() - started
+                                   < min(self.heartbeat_timeout, 10.0))
+                recorder.record("elastic.generation_failed",
+                                generation=self.generation,
+                                infra=fast_infra_fail,
+                                hb_seen=self._gen_hb_seen)
+                # circuit breaker: consecutive sub-`circuit_min_uptime`
+                # failures mean relaunching is not helping — open the
+                # circuit instead of burning the restart budget forever
+                if time.time() - started < self.circuit_min_uptime:
+                    fast_fail_streak += 1
+                else:
+                    fast_fail_streak = 0
+                if self.circuit_fast_failures and \
+                        fast_fail_streak >= self.circuit_fast_failures:
+                    recorder.record("elastic.circuit_open",
+                                    generation=self.generation,
+                                    streak=fast_fail_streak)
+                    return 1
+                if fast_infra_fail and infra_retries < 3:
+                    infra_retries += 1  # global cap: never re-arms
+                    metrics["restarts"].labels(reason="infra").inc()
+                    self.generation += 1
+                    continue
+                self.restarts += 1
+                metrics["restarts"].labels(reason="fail").inc()
+                if self.restarts > self.max_restarts:
+                    recorder.record("elastic.exhausted",
+                                    generation=self.generation,
+                                    restarts=self.restarts)
+                    return 1
+                self._backoff(self.restarts)
+                if self._drain_signal is not None:
+                    # preempted between generations: nothing is running,
+                    # the last checkpoint is already durable — leave clean
+                    recorder.record("elastic.drain_end",
+                                    generation=self.generation,
+                                    clean=True, stragglers=0)
+                    return 0
+                self.generation += 1
+        finally:
+            _restore_handlers(old_handlers)
+
+    def _on_drain_signal(self, signum: int):
+        self._drain_signal = signum
+
+    def _backoff(self, attempt: int):
+        """Exponential backoff + jitter before a relaunch, capped and
+        interruptible by a drain signal (a preempted manager must not
+        sit out its grace period asleep)."""
+        delay = min(self.backoff_max,
+                    self.backoff_base * (2 ** max(0, attempt - 1)))
+        deadline = time.monotonic() + delay * (1.0 + 0.25 * random.random())
+        while time.monotonic() < deadline:
+            if self._drain_signal is not None:
+                return
+            time.sleep(min(0.05, self.poll_interval))
 
     def close(self):
         self._store.close()
@@ -351,18 +490,15 @@ class MultiNodeElasticAgent:
         if host_store:
             self._store = TCPStore(host, int(port), is_master=True)
         else:
-            # the hosting agent may still be starting up — retry the
-            # connect (the etcd client's dial-retry analog)
-            deadline = time.monotonic() + 60.0
-            while True:
-                try:
-                    self._store = TCPStore(host, int(port), is_master=False)
-                    break
-                except RuntimeError:
-                    if time.monotonic() > deadline:
-                        raise
-                    time.sleep(0.3)
+            # the hosting agent may still be starting up — TCPStore's own
+            # backoff-retry connect (the etcd client's dial-retry analog)
+            # covers the window; 60s is the join patience
+            self._store = TCPStore(host, int(port), is_master=False,
+                                   connect_timeout=60.0)
         self._log_files: List = []
+        self.drain_timeout = 30.0
+        self.backoff_base, self.backoff_max = 0.5, 30.0
+        self._drain_signal: Optional[int] = None
 
     # -- store helpers -------------------------------------------------------
     def _gen_now(self) -> int:
@@ -485,7 +621,8 @@ class MultiNodeElasticAgent:
         return procs
 
     def _run_generation(self, g: int, node_rank: int, members):
-        """0 on global success, _RESTART to re-rendezvous."""
+        """0 on global success, _RESTART to re-rendezvous, or
+        ``("drain", rc)`` after a graceful preemption drain."""
         n_nodes = len(members)
         base = sum(m["nproc"] for m in members[:node_rank])
         started = time.monotonic()
@@ -495,6 +632,16 @@ class MultiNodeElasticAgent:
         try:
             while True:
                 now = time.monotonic()
+                if self._drain_signal is not None or \
+                        self._store.check(_DRAIN_KEY):
+                    # preemption (local signal or a peer's published
+                    # flag): drain THIS node's workers gracefully; peers
+                    # see the store flag and do the same
+                    return ("drain",
+                            _drain_workers(self._store, procs,
+                                           self.drain_timeout,
+                                           generation=g,
+                                           node=self.node_id))
                 self._store.set(f"elastic/nodehb/{g}/{node_rank}",
                                 repr(time.time()).encode())
                 if self._gen_now() != g:
@@ -571,9 +718,29 @@ class MultiNodeElasticAgent:
         failures = 0
         infra = 0    # free infra relaunches (bounded; never re-arms)
         barren = 0   # consecutive DEADLINE-forced rendezvous abandonments
+        old_handlers = _install_drain_handlers(
+            lambda signum: setattr(self, "_drain_signal", signum))
+        try:
+            return self._run_inner(metrics, recorder, failures, infra,
+                                   barren)
+        finally:
+            _restore_handlers(old_handlers)
+
+    def _run_inner(self, metrics, recorder, failures, infra, barren) -> int:
         while True:
             g = self._gen_now()
             metrics["generation"].set(g)
+            if self._drain_signal is not None:
+                # preempted while between generations: no local workers,
+                # nothing to flush — leave clean (peers drain themselves)
+                try:
+                    self._store.set(_DRAIN_KEY, b"1")
+                except Exception:
+                    pass
+                recorder.record("elastic.drain_end", generation=g,
+                                node=self.node_id, clean=True,
+                                stragglers=0)
+                return 0
             if failures > self.max_restarts:
                 recorder.record("elastic.exhausted", generation=g,
                                 node=self.node_id, failures=failures)
@@ -602,6 +769,8 @@ class MultiNodeElasticAgent:
                 recorder.record("elastic.done", generation=g,
                                 node=self.node_id)
                 return 0
+            if isinstance(rc, tuple) and rc[0] == "drain":
+                return rc[1]
             reason = self._bump_reason(g)
             metrics["restarts"].labels(reason=reason).inc()
             recorder.record("elastic.generation_failed", generation=g,
@@ -612,6 +781,13 @@ class MultiNodeElasticAgent:
                     failures += 1
             elif reason == "fail":
                 failures += 1
+                # pace the re-rendezvous after a real failure: peers all
+                # back off together (similar delays), so the crashed
+                # dependency gets breathing room before the next epoch
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** max(0,
+                                                          failures - 1)))
+                time.sleep(delay * (1.0 + 0.25 * random.random()))
 
     def close(self):
         self._store.close()
@@ -628,3 +804,34 @@ def _kill_procs(procs: List[subprocess.Popen]):
         except subprocess.TimeoutExpired:
             p.kill()
             p.wait()
+
+
+def _drain_workers(store, procs: List[subprocess.Popen],
+                   drain_timeout: float, **ctx) -> int:
+    """Shared graceful-drain body (single-node manager + multi-node
+    agent): publish the store drain flag, SIGTERM live workers, bounded
+    wait for the final synchronous checkpoints, 0 iff all exited 0."""
+    from paddle_tpu.observability import flight_recorder
+    recorder = flight_recorder()
+    recorder.record("elastic.drain_begin", timeout=drain_timeout, **ctx)
+    try:
+        store.set(_DRAIN_KEY, b"1")
+    except Exception:
+        pass  # store already down: the SIGTERM forward still drains
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+    deadline = time.monotonic() + drain_timeout
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.05, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            pass
+    stragglers = sum(p.poll() is None for p in procs)
+    clean = stragglers == 0 and all(p.poll() == 0 for p in procs)
+    recorder.record("elastic.drain_end", clean=clean,
+                    stragglers=stragglers, **ctx)
+    return 0 if clean else 1

@@ -11,10 +11,29 @@ sync (barriers, small blobs) the reference exposes on its store.
 from __future__ import annotations
 
 import ctypes
+import random
 import time
 from typing import Optional
 
 __all__ = ["TCPStore"]
+
+
+def _store_metrics():
+    """Retry telemetry: a rising connect-retry counter during job start
+    is the 'rank-0 store is slow' signature; op retries after that point
+    mean the store host is struggling."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "connect_retries": reg.counter(
+            "paddle_tpu_tcp_store_connect_retries_total",
+            "TCPStore client connect attempts that failed and were "
+            "retried with backoff"),
+        "op_retries": reg.counter(
+            "paddle_tpu_tcp_store_op_retries_total",
+            "TCPStore operations that failed transiently and were "
+            "retried", labelnames=("op",)),
+    }
 
 
 def _lib():
@@ -52,25 +71,72 @@ class TCPStore:
     process (master included) connects a client."""
 
     def __init__(self, host: str, port: int, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 300.0):
+                 world_size: int = 1, timeout: float = 300.0,
+                 connect_timeout: Optional[float] = None):
         self._lib = _lib()
         self._server = None
         self.world_size = world_size
         self.timeout = timeout
+        self._metrics = _store_metrics()
         if is_master:
             self._server = self._lib.tcpstore_server_start(port)
             if not self._server:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
-        self._fd = self._lib.tcpstore_connect(
-            host.encode(), port, int(timeout * 1000))
-        if self._fd < 0:
-            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+        # connect with exponential backoff + jitter: joining ranks beat a
+        # slow-starting rank-0 store to the socket all the time — a
+        # refused connection during the window is a retry, not a crash.
+        # The master connecting to its own in-process server skips the
+        # patience (a local refusal there is a real bug).
+        budget = 0.5 if is_master else (
+            timeout if connect_timeout is None else connect_timeout)
+        deadline = time.monotonic() + budget
+        delay = 0.05
+        from paddle_tpu.robustness import fault_fires
+        while True:
+            fd = -2 if fault_fires("tcp_store.connect", host=host,
+                                   port=port) else \
+                self._lib.tcpstore_connect(
+                    host.encode(), port,
+                    int(max(0.05, deadline - time.monotonic()) * 1000))
+            if fd >= 0:
+                self._fd = fd
+                break
+            if time.monotonic() + delay > deadline:
+                self._fd = -1
+                raise RuntimeError(
+                    f"TCPStore: cannot connect {host}:{port} after "
+                    f"{budget:.1f}s of retries")
+            self._metrics["connect_retries"].inc()
+            time.sleep(delay * (1.0 + random.random() * 0.25))
+            delay = min(delay * 2, 2.0)
+
+    def _retry_op(self, op: str, attempt, attempts: int = 3):
+        """Bounded retry with backoff for IDEMPOTENT ops (set/check/get).
+        ``add`` is deliberately excluded: a retried add whose first
+        round-trip succeeded server-side but lost its response would
+        double-count — counters must fail loudly instead."""
+        from paddle_tpu.robustness import fault_point
+        delay = 0.02
+        for i in range(attempts):
+            try:
+                fault_point("tcp_store.op", op=op, attempt=i)
+                return attempt()
+            except RuntimeError:
+                if i == attempts - 1:
+                    raise
+                self._metrics["op_retries"].labels(op=op).inc()
+                time.sleep(delay * (1.0 + random.random() * 0.25))
+                delay *= 2
 
     def set(self, key: str, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        rc = self._lib.tcpstore_set(self._fd, key.encode(), data, len(data))
-        if rc != 0:
-            raise RuntimeError("TCPStore.set failed")
+
+        def attempt():
+            rc = self._lib.tcpstore_set(self._fd, key.encode(), data,
+                                        len(data))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set failed")
+        self._retry_op("set", attempt)
 
     def get(self, key: str, wait: bool = True) -> bytes:
         """Blocking get (reference semantics: waits for the key)."""
@@ -96,10 +162,12 @@ class TCPStore:
         return int(v)
 
     def check(self, key: str) -> bool:
-        rc = self._lib.tcpstore_check(self._fd, key.encode())
-        if rc < 0:
-            raise RuntimeError("TCPStore.check failed")
-        return bool(rc)
+        def attempt():
+            rc = self._lib.tcpstore_check(self._fd, key.encode())
+            if rc < 0:
+                raise RuntimeError("TCPStore.check failed")
+            return bool(rc)
+        return self._retry_op("check", attempt)
 
     def wait(self, keys, timeout: Optional[float] = None):
         if isinstance(keys, str):

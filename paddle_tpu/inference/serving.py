@@ -74,6 +74,17 @@ def _serving_metrics():
             "chunk)", buckets=_TOKEN_BUCKETS),
         "steps": reg.counter("paddle_tpu_serving_decode_steps_total",
                              "compiled decode dispatches"),
+        "timeouts": reg.counter(
+            "paddle_tpu_serving_timeouts_total",
+            "requests retired with status=timeout (deadline expired "
+            "while queued or decoding)"),
+        "rejections": reg.counter(
+            "paddle_tpu_serving_rejections_total",
+            "requests rejected at admission", labelnames=("reason",)),
+        "engine_errors": reg.counter(
+            "paddle_tpu_serving_engine_errors_total",
+            "engine-step exceptions recovered by failing the in-flight "
+            "batch (the engine itself survives)"),
     }
 
 
@@ -112,6 +123,7 @@ class _Request:
     max_new_tokens: int
     out: List[int] = field(default_factory=list)
     enqueued_at: float = 0.0        # perf_counter at add_request (TTFT)
+    deadline: Optional[float] = None  # perf_counter; None = no deadline
 
 
 class ContinuousBatchingEngine:
@@ -132,7 +144,10 @@ class ContinuousBatchingEngine:
                  steps_per_sync: int = 1,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0, seed: int = 0,
-                 analyze: Optional[str] = None):
+                 analyze: Optional[str] = None,
+                 max_queue: Optional[int] = None,
+                 request_timeout_s: Optional[float] = None,
+                 max_consecutive_errors: int = 3):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -189,6 +204,23 @@ class ContinuousBatchingEngine:
         self._queue: deque = deque()
         self._done: deque = deque()
         self._next_rid = 0
+        # backpressure + fault containment (robustness tentpole):
+        # * bounded admission queue — at capacity add_request REJECTS
+        #   (QueueFullError) instead of growing; a serving tier must shed
+        #   load at the edge, not queue into OOM
+        # * per-request deadlines — expired requests (queued OR decoding)
+        #   are retired with status "timeout"; a stuck slot frees itself
+        # * engine-step exception recovery — a step() exception fails the
+        #   in-flight batch (status "error", caches rebuilt) but the
+        #   engine keeps serving; `max_consecutive_errors` straight
+        #   failures re-raise (the fault is persistent, not transient)
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._max_queue = max_queue
+        self._default_timeout = request_timeout_s
+        self._status: Dict[int, str] = {}
+        self._error_streak = 0
+        self._max_consecutive_errors = max(1, int(max_consecutive_errors))
 
         # telemetry: counters/histograms are shared process-wide; the
         # occupancy gauges are pull-style (read at scrape, zero cost in
@@ -311,12 +343,27 @@ class ContinuousBatchingEngine:
         return sub
 
     # -- public API ----------------------------------------------------------
-    def add_request(self, prompt_ids, max_new_tokens: int = 64) -> int:
+    def add_request(self, prompt_ids, max_new_tokens: int = 64,
+                    timeout_s: Optional[float] = None) -> int:
+        """Enqueue a prompt.  `timeout_s` (or the engine-wide
+        ``request_timeout_s`` default) is a wall-clock deadline from NOW:
+        a request still queued or decoding past it is retired with
+        status "timeout".  Raises :class:`QueueFullError` when the
+        bounded admission queue is at capacity."""
         p = np.asarray(prompt_ids, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1 (the prefill "
                              f"already emits one token); got "
                              f"{max_new_tokens}")
+        if self._max_queue is not None and \
+                len(self._queue) >= self._max_queue:
+            from paddle_tpu.robustness import QueueFullError
+            self._metrics["rejections"].labels(reason="queue_full").inc()
+            self._recorder.record("serving.reject", reason="queue_full",
+                                  queue_depth=len(self._queue))
+            raise QueueFullError(
+                f"admission queue at capacity ({self._max_queue}); "
+                "retry with backoff or scale out")
         # strict bound: row max_len-1 is the inactive-slot scratch row and
         # must stay unreachable; chunked decode over-writes up to the next
         # steps_per_sync boundary, so budget in whole chunks
@@ -332,8 +379,12 @@ class ContinuousBatchingEngine:
                              f"bucket {self.buckets[-1]}")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, p, max_new_tokens,
-                                    enqueued_at=time.perf_counter()))
+        timeout = timeout_s if timeout_s is not None \
+            else self._default_timeout
+        now = time.perf_counter()
+        self._queue.append(_Request(
+            rid, p, max_new_tokens, enqueued_at=now,
+            deadline=(now + timeout) if timeout is not None else None))
         self._metrics["requests"].inc()
         self._recorder.record("serving.enqueue", rid=rid, prompt_len=len(p),
                               max_new_tokens=max_new_tokens,
@@ -394,16 +445,100 @@ class ContinuousBatchingEngine:
                 or self._budget[slot] <= 0:
             self._retire(slot)
 
-    def _retire(self, slot: int):
+    def _retire(self, slot: int, status: str = "ok"):
         req = self._active[slot]
         self._active[slot] = None
+        self._finish(req, slot=slot, status=status)
+
+    def _finish(self, req: _Request, slot: Optional[int] = None,
+                status: str = "ok"):
+        self._status[req.rid] = status
+        while len(self._status) > 8192:   # bounded, like everything else
+            self._status.pop(next(iter(self._status)))
         self._done.append((req.rid, req.prompt, list(req.out)))
         self._metrics["retirements"].inc()
         self._recorder.record("serving.retire", rid=req.rid, slot=slot,
-                              generated=len(req.out))
+                              generated=len(req.out), status=status)
+
+    def request_status(self, rid: int) -> Optional[str]:
+        """Terminal status of a finished request: "ok" (eos/budget),
+        "timeout" (deadline expired), "error" (engine-step failure);
+        None while still queued/decoding."""
+        return self._status.get(rid)
+
+    def _expire(self):
+        """Retire every request whose deadline has passed — stuck SLOTS
+        free themselves (the other slots keep decoding), and queued
+        requests stop waiting for a slot that isn't coming."""
+        now = time.perf_counter()
+        for slot, req in enumerate(self._active):
+            if req is not None and req.deadline is not None \
+                    and now > req.deadline:
+                self._metrics["timeouts"].inc()
+                self._recorder.record("serving.timeout", rid=req.rid,
+                                      slot=slot, generated=len(req.out))
+                self._retire(slot, status="timeout")
+        if self._queue:
+            keep = deque()
+            for req in self._queue:
+                if req.deadline is not None and now > req.deadline:
+                    self._metrics["timeouts"].inc()
+                    self._recorder.record("serving.timeout", rid=req.rid,
+                                          slot=None, generated=0)
+                    self._finish(req, status="timeout")
+                else:
+                    keep.append(req)
+            self._queue.clear()
+            self._queue.extend(keep)
+
+    def _recover(self, exc: BaseException):
+        """Engine-step exception containment: fail the in-flight batch
+        (every active slot retires with status "error"), rebuild the KV
+        caches (the failed donated call may have consumed them), keep
+        the queue — the engine stays alive for the next request.  After
+        ``max_consecutive_errors`` straight failures the exception
+        re-raises: that is a persistent fault, not a transient one."""
+        self._error_streak += 1
+        self._metrics["engine_errors"].inc()
+        self._recorder.record("serving.engine_error",
+                              error=type(exc).__name__,
+                              message=str(exc)[:200],
+                              streak=self._error_streak)
+        for slot, req in enumerate(self._active):
+            if req is not None:
+                self._retire(slot, status="error")
+        cfgm = self.model.config
+        kv_shape = (self.slots, self.max_len, cfgm.num_key_value_heads,
+                    cfgm.head_dim)
+        self._caches = [
+            (jnp.zeros(kv_shape, self._dtype),
+             jnp.zeros(kv_shape, self._dtype))
+            for _ in range(cfgm.num_hidden_layers)]
+        self._pos[:] = 0
+        self._budget[:] = 0
+        self._last_tok[:] = 0
+        if self._error_streak >= self._max_consecutive_errors:
+            raise exc
 
     def step(self) -> bool:
-        """One scheduling step.  Returns False when nothing is left."""
+        """One scheduling step.  Returns False when nothing is left.
+        Engine-step exceptions fail the in-flight batch without killing
+        the engine (see :meth:`_recover`)."""
+        self._expire()
+        try:
+            out = self._step_inner()
+        except Exception as e:  # KeyboardInterrupt etc. still propagate
+            self._recover(e)
+            return bool(self._queue) or \
+                any(r is not None for r in self._active)
+        self._error_streak = 0
+        return out
+
+    def _step_inner(self) -> bool:
+        from paddle_tpu.robustness import fault_point
+        fault_point("serving.engine_step",
+                    active=sum(r is not None for r in self._active),
+                    queued=len(self._queue))
         free = [i for i, r in enumerate(self._active) if r is None]
         if free and self._queue:
             self._admit(free[0], self._queue.popleft())

@@ -15,6 +15,7 @@ does, without native code (XLA's transfer engine does the H2D overlap).
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterable, Optional
@@ -153,6 +154,10 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(1, prefetch_factor)
         self.use_buffer_reader = use_buffer_reader
+        # per-batch result deadline (seconds; 0 = wait forever, the
+        # reference's semantics): a worker stuck in __getitem__ becomes a
+        # clear RuntimeError instead of an indefinite consumer hang
+        self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
 
         if self._iterable_mode:
@@ -184,6 +189,47 @@ class DataLoader:
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 
+    def _result(self, fut):
+        """One pool future → batch, with worker death surfaced as a
+        clear RuntimeError naming the dead worker processes — a crashed
+        worker (OOM-killed, segfaulted C extension, os._exit) otherwise
+        reads as either an opaque BrokenProcessPool or, in naive queue
+        designs, an indefinite consumer hang."""
+        import concurrent.futures as cf
+        from concurrent.futures.process import BrokenProcessPool
+        alive_before = self._worker_pids()
+        try:
+            return fut.result(timeout=self.timeout or None)
+        except cf.TimeoutError:
+            raise RuntimeError(
+                f"DataLoader batch not produced within timeout="
+                f"{self.timeout}s (worker pids {sorted(alive_before)}) — "
+                "a worker is stuck in dataset.__getitem__/collate_fn")
+        except BrokenProcessPool as e:
+            dead = self._dead_workers()
+            self._pool = None  # broken pools cannot be reused
+            who = f"worker pid(s) {dead}" if dead else \
+                f"one of worker pids {sorted(alive_before)}"
+            raise RuntimeError(
+                f"DataLoader worker process died: {who} terminated "
+                f"abruptly (num_workers={self.num_workers}); look for "
+                "OOM kills or native crashes in dataset code") from e
+
+    def _worker_pids(self):
+        pool = self._pool
+        try:
+            return set(pool._processes or {}) if pool is not None else set()
+        except Exception:
+            return set()
+
+    def _dead_workers(self):
+        pool = self._pool
+        try:
+            return sorted(pid for pid, p in (pool._processes or {}).items()
+                          if not p.is_alive())
+        except Exception:
+            return []
+
     def _gen_map_style(self):
         if self.num_workers > 0 and self.batch_sampler is not None:
             # process pool maps index batches; order preserved
@@ -210,7 +256,7 @@ class DataLoader:
                         break
                 while dq:
                     fut = dq.popleft()
-                    yield fut.result()
+                    yield self._result(fut)
                     try:
                         dq.append(self._pool.submit(_fetch_worker,
                                                     self.dataset,
@@ -292,4 +338,9 @@ def _worker_init(counter, num_workers, base_seed):
 
 
 def _fetch_worker(dataset, collate_fn, indices):
+    # chaos hook: runs IN the worker process (the registry re-reads
+    # PADDLE_TPU_FAULTS there), so action=exit is a genuine hard worker
+    # death and the default raise travels back through fut.result()
+    from paddle_tpu.robustness import fault_point
+    fault_point("io.dataloader.worker", pid=os.getpid())
     return collate_fn([dataset[i] for i in indices])

@@ -54,6 +54,11 @@ def _train_metrics():
             "paddle_tpu_train_accum_microbatches",
             "microbatches accumulated per optimizer update",
             buckets=(1, 2, 4, 8, 16, 32, 64)),
+        "skipped": reg.counter(
+            "paddle_tpu_train_step_skipped_total",
+            "optimizer updates skipped by the non-finite step-guard "
+            "(params and optimizer state left unchanged)",
+            labelnames=("reason",)),
     }
 
 
@@ -66,7 +71,8 @@ class CompiledStepBase:
     ``(params, opt_state, step_count, *step_args, lr) ->
     (loss, params, opt_state, step_count)`` — the loss slot may be any
     pytree the subclass's caller unpacks (TrainStep returns
-    ``(loss, grad_norm)`` there for the telemetry gauges)."""
+    ``(loss, grad_norm, skip_code)`` there for the telemetry gauges and
+    the non-finite step-guard)."""
 
     def _init_step_state(self, optimizer, params, param_sh=None):
         """Place params on their shardings and derive optimizer state
@@ -188,10 +194,33 @@ class TrainStep(CompiledStepBase):
                  mesh=None, param_specs: Optional[Dict[str, Any]] = None,
                  batch_spec=None, compute_dtype=None, seed: int = 0,
                  remat: bool = False, remat_policy: Optional[str] = None,
-                 analyze: Optional[str] = None, accum_steps: int = 1):
+                 analyze: Optional[str] = None, accum_steps: int = 1,
+                 guard_nonfinite: Optional[bool] = None,
+                 max_consecutive_skips: Optional[int] = None):
         self.model = model
         self.loss_fn = loss_fn
         self.mesh = mesh
+        # anomaly step-guard (robustness tentpole): a jitted all-finite
+        # check on (loss, grad-norm); a NaN/Inf step SKIPS the optimizer
+        # update — params, opt state and step_count come back bitwise
+        # unchanged — instead of poisoning every weight.  Default ON
+        # (PADDLE_TPU_STEP_GUARD=0 or guard_nonfinite=False disables);
+        # after max_consecutive_skips straight skips the guard dumps the
+        # flight recorder and raises NonFiniteStepError — a persistent
+        # divergence must page someone, not spin forever.
+        import os as _os
+        if guard_nonfinite is None:
+            guard_nonfinite = _os.environ.get(
+                "PADDLE_TPU_STEP_GUARD", "1") != "0"
+        self._guard_nonfinite = bool(guard_nonfinite)
+        if max_consecutive_skips is None:
+            max_consecutive_skips = int(_os.environ.get(
+                "PADDLE_TPU_MAX_SKIP_STEPS", "25"))
+        if max_consecutive_skips < 1:
+            raise ValueError("max_consecutive_skips must be >= 1, got "
+                             f"{max_consecutive_skips}")
+        self._max_skips = max_consecutive_skips
+        self._skip_streak = 0
         # microbatch gradient accumulation: the batch's leading axis is
         # split into accum_steps slices scanned sequentially with an fp32
         # grad carry — activation memory is per-MICROBATCH, so effective
@@ -326,9 +355,41 @@ class TrainStep(CompiledStepBase):
         new_params.update(new_train)
         new_opt_state = dict(opt_state)
         new_opt_state.update(new_state)
-        return (loss, gnorm), new_params, new_opt_state, step_count
+        # non-finite step-guard: skip_code 0 = applied, 1 = non-finite
+        # loss, 2 = finite loss but non-finite grad norm (a single
+        # NaN/Inf anywhere in the grads poisons the norm, so one scalar
+        # check covers every leaf).  On skip, a jnp.where per leaf keeps
+        # the OLD params/opt state/step_count — the anomalous update is
+        # fully discarded on device; no host round-trip decides anything.
+        if self._guard_nonfinite:
+            skip_code = jnp.where(
+                jnp.isfinite(loss),
+                jnp.where(jnp.isfinite(gnorm), 0, 2), 1).astype(jnp.int32)
+            keep = skip_code == 0
+
+            def sel(new, old):
+                return jax.tree.map(
+                    lambda a, b: jnp.where(keep, a, b), new, old)
+
+            new_params = sel(new_params, params)
+            new_opt_state = sel(new_opt_state, opt_state)
+            step_count = jnp.where(keep, step_count, step_count - 1)
+        else:
+            skip_code = jnp.zeros((), jnp.int32)
+        return (loss, gnorm, skip_code), new_params, new_opt_state, \
+            step_count
 
     def __call__(self, batch):
+        # chaos: poison this batch's float leaves with NaN — the
+        # injectable twin of a corrupt record / bad-loss microbatch,
+        # which the step-guard must absorb (int-only LM batches have no
+        # poisonable leaf; use a float-input model to drill this path)
+        from paddle_tpu.robustness import fault_fires
+        if fault_fires("train.nonfinite_batch", step=self._host_steps):
+            batch = jax.tree.map(
+                lambda a: a * jnp.nan
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a, batch)
         if self._batch_sh is not None:
             batch = jax.tree.map(
                 lambda a: jax.device_put(jnp.asarray(a), self._batch_sh),
@@ -359,7 +420,7 @@ class TrainStep(CompiledStepBase):
         t0 = time.perf_counter()
         with self._recorder.instrumented("train.step",
                                          step=self._host_steps):
-            loss, gnorm = self._run_jitted(batch, sub)
+            loss, gnorm, skip_code = self._run_jitted(batch, sub)
         dt = time.perf_counter() - t0
         self._host_steps += 1
         m = self._metrics
@@ -368,12 +429,39 @@ class TrainStep(CompiledStepBase):
         m["accum"].observe(self._accum_steps)
         m["loss"].set(loss)     # device scalar, resolved at scrape
         m["gnorm"].set(gnorm)
+        if self._guard_nonfinite:
+            self._account_skip(int(skip_code))
         tokens = self._batch_tokens(batch)
         if tokens:
             m["tokens"].inc(tokens)
             if dt > 0:
                 m["tps"].set(tokens / dt)
         return loss
+
+    def _account_skip(self, code: int):
+        """Host side of the step-guard: metric + flight-recorder entry
+        per skipped step, escape hatch after K consecutive skips.  The
+        ``int(skip_code)`` in __call__ is the guard's one cost — it
+        synchronizes on the step (the price of knowing in time)."""
+        if code == 0:
+            self._skip_streak = 0
+            return
+        reason = "nonfinite_loss" if code == 1 else "nonfinite_grad"
+        self._skip_streak += 1
+        self._metrics["skipped"].labels(reason=reason).inc()
+        self._recorder.record("train.step_skipped", reason=reason,
+                              step=self._host_steps - 1,
+                              streak=self._skip_streak)
+        if self._skip_streak >= self._max_skips:
+            from paddle_tpu.robustness import NonFiniteStepError
+            self._recorder.dump(
+                reason=f"step-guard: {self._skip_streak} consecutive "
+                       f"non-finite steps ({reason})")
+            raise NonFiniteStepError(
+                f"{self._skip_streak} consecutive optimizer updates "
+                f"skipped (last reason: {reason}) — persistent "
+                "divergence, not a transient bad microbatch; params are "
+                "unchanged since the last finite step")
 
     @staticmethod
     def _batch_tokens(batch) -> int:

@@ -1,0 +1,31 @@
+"""paddle_tpu.robustness — fault injection + the hardening it proves.
+
+The subsystem has two halves (ISSUE 4 tentpole; see README.md here):
+
+* **fault registry** (:mod:`paddle_tpu.robustness.faults`) — named fault
+  points wired into checkpoint writes, TCP-store ops, elastic
+  heartbeats, dataloader workers, and the serving step; armed via
+  ``PADDLE_TPU_FAULTS`` or :func:`inject`, every firing recorded to the
+  flight recorder and ``paddle_tpu_fault_injections_total``.
+* **hardening** — lives in the subsystems themselves: checkpoint shard
+  digests + atomic writes + newest-valid fallback, the TrainStep
+  non-finite step-guard, preemption-aware elastic drain with restart
+  backoff and a circuit breaker, serving deadlines/admission
+  bounds/engine-step recovery, dataloader worker-crash surfacing.
+
+Chaos tests (tests/test_robustness.py) inject each catalogued fault
+through the registry and assert the system recovers.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.robustness.faults import (  # noqa: F401
+    FaultRegistry, FaultSpec, InjectedFault, NonFiniteStepError,
+    QueueFullError, clear_faults, fault_fires, fault_point, fault_registry,
+    fault_stats, inject, reset_registry)
+
+__all__ = [
+    "FaultRegistry", "FaultSpec", "InjectedFault", "NonFiniteStepError",
+    "QueueFullError", "clear_faults", "fault_fires", "fault_point",
+    "fault_registry", "fault_stats", "inject", "reset_registry",
+]
