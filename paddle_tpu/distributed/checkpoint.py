@@ -226,12 +226,15 @@ def _write_plan(plan: Dict[str, dict], path: str, barrier: bool = True):
     artifacts first and writes the sentinel last (with cross-process
     barriers when running multi-controller)."""
     from paddle_tpu.observability import flight_recorder
+    from paddle_tpu.observability.tracing import tracer
     t0 = time.perf_counter()
     recorder = flight_recorder()
     recorder.record("checkpoint.save_begin", path=path,
                     tensors=len(plan), barrier=barrier)
     try:
-        _write_plan_inner(plan, path, barrier)
+        with tracer().span("checkpoint.save", path=path,
+                           tensors=len(plan), root_eligible=False):
+            _write_plan_inner(plan, path, barrier)
     except BaseException as e:
         recorder.record("checkpoint.save_failed", path=path,
                         error=type(e).__name__)
@@ -258,12 +261,18 @@ def _write_plan_inner(plan: Dict[str, dict], path: str,
     if nprocs > 1 and barrier:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"ckpt_purge:{path}")
+    from paddle_tpu.observability.tracing import tracer
+    tr = tracer()
     index = {}
     for name, tmeta in plan.items():
         entries = []
         for offsets, data in tmeta["shards"]:
             fname = _shard_fname(name, offsets)
-            digest = _write_shard(path, fname, data)
+            # per-shard span: which tensor's write is the slow one (or
+            # the one a fault fired in) reads straight off the trace
+            with tr.span("checkpoint.shard", file=fname,
+                         bytes=int(data.nbytes), root_eligible=False):
+                digest = _write_shard(path, fname, data)
             entries.append({"file": fname, "offsets": offsets, **digest})
         index[name] = {"global_shape": tmeta["global_shape"],
                        "dtype": tmeta["dtype"], "shards": entries}
@@ -401,7 +410,10 @@ def load_state_dict(path: str, mesh=None,
     import jax
     import jax.numpy as jnp
     from paddle_tpu.observability import flight_recorder
+    from paddle_tpu.observability.tracing import tracer
     t0 = time.perf_counter()
+    restore_span = tracer().start_span("checkpoint.restore", path=path,
+                                       root_eligible=False)
     with open(os.path.join(path, _SENTINEL)) as f:
         meta = json.load(f)
     if meta.get("format", 1) < 2:  # legacy: one global .npy per tensor
@@ -409,6 +421,8 @@ def load_state_dict(path: str, mesh=None,
         m = _ckpt_metrics()
         m["restores"].inc()
         m["restore_s"].observe(time.perf_counter() - t0)
+        restore_span.set_attribute("tensors", len(out1))
+        restore_span.end()
         return out1
     tensors = _merge_indexes(path, expected_nprocs=meta.get("nprocs"))
     out = {}
@@ -433,6 +447,8 @@ def load_state_dict(path: str, mesh=None,
     m = _ckpt_metrics()
     m["restores"].inc()
     m["restore_s"].observe(time.perf_counter() - t0)
+    restore_span.set_attribute("tensors", len(out))
+    restore_span.end()
     flight_recorder().record("checkpoint.restore", path=path,
                              tensors=len(out),
                              seconds=time.perf_counter() - t0)
@@ -522,10 +538,17 @@ class _AsyncSave:
 
     def __init__(self, target, args, kwargs):
         self.error: Optional[BaseException] = None
+        # explicit trace-context handoff: the writer thread's spans
+        # (checkpoint.save / per-shard) parent under whatever span the
+        # train loop was in when it kicked off the save
+        from paddle_tpu.observability.tracing import tracer
+        tr = tracer()
+        ctx = tr.current_context()
 
         def run():
             try:
-                target(*args, **kwargs)
+                with tr.attach(ctx):
+                    target(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — re-raised in wait()
                 self.error = e
 

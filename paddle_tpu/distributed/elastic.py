@@ -129,6 +129,19 @@ class ElasticAgent:
             int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         self.generation = int(os.environ.get("PADDLE_ELASTIC_GEN", "0"))
         self._key = f"hb/{self.generation}/{self.rank}"
+        # cross-host trace stitching: the manager publishes its
+        # generation span's context under trace/gen/<g>; adopting it as
+        # this process's ambient parent makes every local step span part
+        # of the manager's trace — one timeline across all workers
+        try:
+            from paddle_tpu.observability.tracing import (extract_context,
+                                                          tracer)
+            ctx = extract_context(self._store,
+                                  key=f"trace/gen/{self.generation}")
+            if ctx is not None:
+                tracer().set_process_context(ctx)
+        except Exception:
+            pass  # nobody tracing (or store too old): run untraced
         self._interval = interval
         self._stop = threading.Event()
         self._drain = threading.Event()
@@ -325,8 +338,11 @@ class ElasticManager:
         restart — bounded by its own small cap so a genuinely
         insta-crashing workload still terminates."""
         from paddle_tpu.observability import flight_recorder
+        from paddle_tpu.observability.tracing import (inject_context,
+                                                      tracer)
         metrics = _elastic_metrics()
         recorder = flight_recorder()
+        tr = tracer()
         infra_retries = 0
         fast_fail_streak = 0
         old_handlers = _install_drain_handlers(self._on_drain_signal)
@@ -338,7 +354,22 @@ class ElasticManager:
                 recorder.record("elastic.spawn",
                                 generation=self.generation,
                                 nproc=self.nproc, restarts=self.restarts)
-                procs, drain_rc = [], None
+                # generation-lifetime span; its context is published on
+                # the store BEFORE workers spawn so their ElasticAgents
+                # adopt it and the whole generation stitches into one
+                # trace across processes
+                gen_span = tr.start_span("elastic.generation",
+                                         generation=self.generation,
+                                         nproc=self.nproc)
+                if gen_span.context is not None:
+                    try:
+                        inject_context(self._store,
+                                       key=f"trace/gen/"
+                                           f"{self.generation}",
+                                       ctx=gen_span.context)
+                    except Exception:
+                        pass
+                procs, drain_rc, ok = [], None, None
                 try:
                     procs = self._spawn()
                     ok = self._watch(procs)
@@ -348,6 +379,12 @@ class ElasticManager:
                     self._kill_all(procs)
                     for f in getattr(self, "_log_files", []):
                         f.close()
+                    gen_span.set_attribute(
+                        "outcome",
+                        "drain" if ok == "drain" else
+                        "ok" if ok is True else
+                        "fail" if ok is False else "error")
+                    gen_span.end()
                 metrics["gen_seconds"].observe(time.time() - started)
                 if ok == "drain":
                     return drain_rc
@@ -628,6 +665,28 @@ class MultiNodeElasticAgent:
         started = time.monotonic()
         peer_seen: Dict[int, tuple] = {}   # rank -> (last bytes, seen at)
         done_marked = False
+        # node 0 roots the generation trace and publishes its context;
+        # every other node parents a node-local span under it, and all
+        # workers (via ElasticAgent's extract) join the same trace_id —
+        # the multi-host timeline stitches on that id
+        from paddle_tpu.observability.tracing import (extract_context,
+                                                      inject_context,
+                                                      tracer)
+        tr = tracer()
+        if node_rank == 0:
+            gen_span = tr.start_span("elastic.generation", generation=g,
+                                     node=self.node_id, nodes=n_nodes)
+            if gen_span.context is not None:
+                try:
+                    inject_context(self._store, key=f"trace/gen/{g}",
+                                   ctx=gen_span.context)
+                except Exception:
+                    pass
+        else:
+            parent = extract_context(self._store, key=f"trace/gen/{g}")
+            gen_span = tr.start_span("elastic.node_generation",
+                                     parent=parent, generation=g,
+                                     node=self.node_id)
         procs = self._spawn(g, node_rank, members)
         try:
             while True:
@@ -705,6 +764,7 @@ class MultiNodeElasticAgent:
             _kill_procs(procs)
             for f in self._log_files:
                 f.close()
+            gen_span.end()
 
     def run(self) -> int:
         """Budget accounting: only generations that this agent actually

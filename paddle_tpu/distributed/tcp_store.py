@@ -78,6 +78,10 @@ class TCPStore:
         self.world_size = world_size
         self.timeout = timeout
         self._metrics = _store_metrics()
+        from paddle_tpu.observability.tracing import tracer
+        # store ops get spans (root_eligible=False: a bare heartbeat
+        # set() outside any trace must not crowd the slow-trace table)
+        self._tracer = tracer()
         if is_master:
             self._server = self._lib.tcpstore_server_start(port)
             if not self._server:
@@ -136,30 +140,38 @@ class TCPStore:
                                         len(data))
             if rc != 0:
                 raise RuntimeError("TCPStore.set failed")
-        self._retry_op("set", attempt)
+        with self._tracer.span("store.set", key=key,
+                               root_eligible=False):
+            self._retry_op("set", attempt)
 
     def get(self, key: str, wait: bool = True) -> bytes:
-        """Blocking get (reference semantics: waits for the key)."""
+        """Blocking get (reference semantics: waits for the key).  The
+        span covers the whole wait — a control-plane stall shows up as
+        one long ``store.get`` in the trace, not as unexplained gap."""
         buf = ctypes.create_string_buffer(1 << 20)
         deadline = time.monotonic() + self.timeout
-        while True:
-            n = self._lib.tcpstore_get(self._fd, key.encode(), buf,
-                                       len(buf))
-            if n >= 0:
-                return buf.raw[:n]
-            if n == -1:
-                raise RuntimeError("TCPStore.get failed")
-            if not wait:
-                raise KeyError(key)
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"TCPStore.get({key}) timed out")
-            time.sleep(0.01)
+        with self._tracer.span("store.get", key=key, wait=wait,
+                               root_eligible=False):
+            while True:
+                n = self._lib.tcpstore_get(self._fd, key.encode(), buf,
+                                           len(buf))
+                if n >= 0:
+                    return buf.raw[:n]
+                if n == -1:
+                    raise RuntimeError("TCPStore.get failed")
+                if not wait:
+                    raise KeyError(key)
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"TCPStore.get({key}) timed out")
+                time.sleep(0.01)
 
     def add(self, key: str, amount: int = 1) -> int:
-        v = self._lib.tcpstore_add(self._fd, key.encode(), amount)
-        if v == -(2 ** 63):
-            raise RuntimeError("TCPStore.add failed")
-        return int(v)
+        with self._tracer.span("store.add", key=key,
+                               root_eligible=False):
+            v = self._lib.tcpstore_add(self._fd, key.encode(), amount)
+            if v == -(2 ** 63):
+                raise RuntimeError("TCPStore.add failed")
+            return int(v)
 
     def check(self, key: str) -> bool:
         def attempt():
@@ -167,7 +179,9 @@ class TCPStore:
             if rc < 0:
                 raise RuntimeError("TCPStore.check failed")
             return bool(rc)
-        return self._retry_op("check", attempt)
+        with self._tracer.span("store.check", key=key,
+                               root_eligible=False):
+            return self._retry_op("check", attempt)
 
     def wait(self, keys, timeout: Optional[float] = None):
         if isinstance(keys, str):
@@ -182,16 +196,18 @@ class TCPStore:
     def barrier(self, name: str = "barrier"):
         """All world_size processes rendezvous (reference barrier via
         counting key)."""
-        n = self.add(f"__{name}_count", 1)
-        target = self.world_size
-        deadline = time.monotonic() + self.timeout
-        while n < target:
-            cur = self.add(f"__{name}_count", 0)
-            if cur >= target:
-                break
-            if time.monotonic() > deadline:
-                raise TimeoutError("barrier timed out")
-            time.sleep(0.01)
+        with self._tracer.span("store.barrier", barrier=name,
+                               root_eligible=False):
+            n = self.add(f"__{name}_count", 1)
+            target = self.world_size
+            deadline = time.monotonic() + self.timeout
+            while n < target:
+                cur = self.add(f"__{name}_count", 0)
+                if cur >= target:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError("barrier timed out")
+                time.sleep(0.01)
 
     def close(self):
         if self._fd is not None and self._fd >= 0:

@@ -34,7 +34,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ContinuousBatchingEngine", "quantize_weights_int8"]
+__all__ = ["ContinuousBatchingEngine", "RequestStatus",
+           "quantize_weights_int8"]
 
 # decode-token latency lives in the sub-ms..s decade; TTFT includes a
 # possible compile, so it keeps the wide default upper range
@@ -124,6 +125,44 @@ class _Request:
     out: List[int] = field(default_factory=list)
     enqueued_at: float = 0.0        # perf_counter at add_request (TTFT)
     deadline: Optional[float] = None  # perf_counter; None = no deadline
+    span: Any = None                # root trace span (admission→retire)
+    admitted_at: float = 0.0        # perf_counter at slot admission
+    first_token_at: float = 0.0     # perf_counter when prefill emitted
+    retired_at: float = 0.0         # perf_counter at retirement
+
+
+class RequestStatus(str):
+    """Terminal request status that IS the plain status string
+    (``"ok"`` / ``"timeout"`` / ``"error"`` — every existing ``==``
+    comparison keeps working) but additionally carries the request's
+    lifecycle timing fields and trace id, so a client staring at its
+    own timeout can tell queued-too-long from decoded-too-slowly
+    without server logs."""
+
+    def __new__(cls, status: str, timings: Optional[Dict[str, float]]
+                = None, trace_id: Optional[str] = None):
+        obj = super().__new__(cls, status)
+        obj.timings = dict(timings or {})
+        obj.trace_id = trace_id
+        return obj
+
+
+def _request_timings(req: "_Request") -> Dict[str, float]:
+    """Lifecycle stamps (perf_counter; 0.0 = phase never reached) plus
+    the derived durations clients actually reason about."""
+    t = {"enqueued": req.enqueued_at, "admitted": req.admitted_at,
+         "first_token": req.first_token_at, "retired": req.retired_at}
+    if req.admitted_at and req.enqueued_at:
+        t["queue_s"] = req.admitted_at - req.enqueued_at
+    if req.first_token_at and req.enqueued_at:
+        t["ttft_s"] = req.first_token_at - req.enqueued_at
+    if req.first_token_at and req.admitted_at:
+        t["prefill_s"] = req.first_token_at - req.admitted_at
+    if req.retired_at and req.first_token_at:
+        t["decode_s"] = req.retired_at - req.first_token_at
+    if req.retired_at and req.enqueued_at:
+        t["total_s"] = req.retired_at - req.enqueued_at
+    return t
 
 
 class ContinuousBatchingEngine:
@@ -228,7 +267,9 @@ class ContinuousBatchingEngine:
         self._metrics = _serving_metrics()
         from paddle_tpu.observability import default_registry, \
             flight_recorder
+        from paddle_tpu.observability.tracing import tracer
         self._recorder = flight_recorder()
+        self._tracer = tracer()
         reg = default_registry()
         reg.gauge("paddle_tpu_serving_queue_depth",
                   "requests waiting for a slot").set_function(
@@ -382,13 +423,23 @@ class ContinuousBatchingEngine:
         timeout = timeout_s if timeout_s is not None \
             else self._default_timeout
         now = time.perf_counter()
-        self._queue.append(_Request(
+        req = _Request(
             rid, p, max_new_tokens, enqueued_at=now,
-            deadline=(now + timeout) if timeout is not None else None))
+            deadline=(now + timeout) if timeout is not None else None)
+        # per-request root span, open until retirement.  The engine loop
+        # may run on another thread; the span rides the request object —
+        # explicit propagation, no thread-local assumptions.
+        req.span = self._tracer.start_span(
+            "serving.request", rid=rid, prompt_len=len(p),
+            max_new_tokens=max_new_tokens)
+        self._queue.append(req)
         self._metrics["requests"].inc()
-        self._recorder.record("serving.enqueue", rid=rid, prompt_len=len(p),
-                              max_new_tokens=max_new_tokens,
-                              queue_depth=len(self._queue))
+        ev = dict(rid=rid, prompt_len=len(p),
+                  max_new_tokens=max_new_tokens,
+                  queue_depth=len(self._queue))
+        if req.span.trace_id is not None:
+            ev["trace_id"] = req.span.trace_id
+        self._recorder.record("serving.enqueue", **ev)
         return rid
 
     def finished(self):
@@ -409,6 +460,7 @@ class ContinuousBatchingEngine:
         from paddle_tpu.generation import StaticCache  # noqa: F401
         Lp = len(req.prompt)
         Lb = self._bucket(Lp)
+        req.admitted_at = time.perf_counter()
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :Lp] = req.prompt
         cfgm = self.model.config
@@ -419,12 +471,18 @@ class ContinuousBatchingEngine:
                                                           self._dtype))
                for _ in range(cfgm.num_hidden_layers)]
         sub = self._next_key()
-        first, caches1 = self._prefill(self._keep, self._quant,
-                                       jnp.asarray(ids), kv1,
-                                       jnp.asarray(Lp, jnp.int32), sub)
-        self._caches = self._insert(self._caches, caches1,
-                                    jnp.asarray(slot, jnp.int32))
-        first = int(first)
+        # prefill child span under the request's root: covers the
+        # bucketed forward AND the slot insert (both block admission)
+        with self._tracer.span("serving.prefill", parent=req.span,
+                               rid=req.rid, bucket=Lb, prompt_len=Lp):
+            first, caches1 = self._prefill(self._keep, self._quant,
+                                           jnp.asarray(ids), kv1,
+                                           jnp.asarray(Lp, jnp.int32),
+                                           sub)
+            self._caches = self._insert(self._caches, caches1,
+                                        jnp.asarray(slot, jnp.int32))
+            first = int(first)
+        req.first_token_at = time.perf_counter()
         req.out.append(first)
         m = self._metrics
         m["admissions"].inc()
@@ -452,18 +510,35 @@ class ContinuousBatchingEngine:
 
     def _finish(self, req: _Request, slot: Optional[int] = None,
                 status: str = "ok"):
-        self._status[req.rid] = status
+        req.retired_at = time.perf_counter()
+        trace_id = req.span.trace_id if req.span is not None else None
+        self._status[req.rid] = RequestStatus(
+            status, timings=_request_timings(req), trace_id=trace_id)
         while len(self._status) > 8192:   # bounded, like everything else
             self._status.pop(next(iter(self._status)))
         self._done.append((req.rid, req.prompt, list(req.out)))
         self._metrics["retirements"].inc()
-        self._recorder.record("serving.retire", rid=req.rid, slot=slot,
-                              generated=len(req.out), status=status)
+        ev = dict(rid=req.rid, slot=slot, generated=len(req.out),
+                  status=status)
+        if trace_id is not None:
+            ev["trace_id"] = trace_id
+        self._recorder.record("serving.retire", **ev)
+        if req.span is not None:
+            req.span.set_attribute("status", status)
+            req.span.set_attribute("generated", len(req.out))
+            req.span.end(end_time=req.retired_at)
 
     def request_status(self, rid: int) -> Optional[str]:
         """Terminal status of a finished request: "ok" (eos/budget),
         "timeout" (deadline expired), "error" (engine-step failure);
-        None while still queued/decoding."""
+        None while still queued/decoding.  The returned value compares
+        equal to those plain strings but is a :class:`RequestStatus`
+        whose ``.timings`` carries the lifecycle stamps
+        (enqueued/admitted/first_token/retired + queue_s/ttft_s/
+        prefill_s/decode_s/total_s, sourced from the request's trace
+        span bookkeeping) and whose ``.trace_id`` joins it to the
+        exported trace — a timed-out client can self-diagnose where its
+        deadline went."""
         return self._status.get(rid)
 
     def _expire(self):
@@ -550,6 +625,7 @@ class ContinuousBatchingEngine:
         # their write lands on max_len-1 which no active sequence can
         # reach (add_request enforces prompt+new <= max_len <= row max)
         pos = np.where(active, self._pos, self.max_len - 1).astype(np.int32)
+        chunk_reqs = [r for r in self._active if r is not None]
         sub = self._next_key()
         t0 = time.perf_counter()
         with self._recorder.instrumented("serving.decode"):
@@ -560,6 +636,13 @@ class ContinuousBatchingEngine:
             toks = np.asarray(toks)                     # [B, K]
         chunk_dt = time.perf_counter() - t0
         K = toks.shape[1]
+        # one retroactive decode-step span per request in the chunk:
+        # the fused dispatch is shared, but each request's trace shows
+        # its own slice of the timeline (same endpoints, K tokens)
+        for r in chunk_reqs:
+            self._tracer.add_span("serving.decode_step", t0,
+                                  t0 + chunk_dt, parent=r.span,
+                                  rid=r.rid, tokens=K)
         emitted = 0
         for i, req in enumerate(self._active):
             if req is None:

@@ -61,21 +61,35 @@ class _PrefetchIterator:
         self._exc = None
         self._done = False
         self._stop = threading.Event()
+        # explicit context propagation: batch-assembly spans recorded on
+        # the prefetch thread stay part of the constructing trace
+        from paddle_tpu.observability.tracing import tracer
+        self._tracer = tracer()
+        self._ctx = self._tracer.current_context()
 
         def worker():
             gen = gen_fn()
+            it = iter(gen)
             try:
-                for item in gen:
-                    if self._stop.is_set():
-                        break
+                with self._tracer.attach(self._ctx):
                     while not self._stop.is_set():
-                        try:
-                            self._q.put(item, timeout=0.05)
+                        # batch assembly (sampling + __getitem__ +
+                        # collate all run inside next()) gets its own
+                        # span; the sentinel default sidesteps
+                        # StopIteration-through-contextmanager
+                        with self._tracer.span("dataloader.batch",
+                                               root_eligible=False):
+                            item = next(it, self._STOP)
+                        if item is self._STOP:
                             break
-                        except queue.Full:
-                            continue
-                    else:
-                        break
+                        while not self._stop.is_set():
+                            try:
+                                self._q.put(item, timeout=0.05)
+                                break
+                            except queue.Full:
+                                continue
+                        else:
+                            break
             except BaseException as e:  # propagate to consumer
                 self._exc = e
             finally:
@@ -230,6 +244,24 @@ class DataLoader:
         except Exception:
             return []
 
+    def _submit(self, indices):
+        """Submit one index batch, translating a broken pool the same
+        way ``_result`` does — a worker that died between batches breaks
+        the pool before any future exists, and the raw
+        ``BrokenProcessPool`` from ``submit`` named nobody."""
+        from concurrent.futures.process import BrokenProcessPool
+        try:
+            return self._pool.submit(_fetch_worker, self.dataset,
+                                     self.collate_fn, indices)
+        except BrokenProcessPool as e:
+            dead = self._dead_workers()
+            self._pool = None  # broken pools cannot be reused
+            who = f"worker pid(s) {dead}" if dead else "a worker"
+            raise RuntimeError(
+                f"DataLoader worker process died: {who} terminated "
+                f"abruptly (num_workers={self.num_workers}); look for "
+                "OOM kills or native crashes in dataset code") from e
+
     def _gen_map_style(self):
         if self.num_workers > 0 and self.batch_sampler is not None:
             # process pool maps index batches; order preserved
@@ -248,20 +280,14 @@ class DataLoader:
             try:
                 for _ in range(inflight):
                     try:
-                        dq.append(self._pool.submit(_fetch_worker,
-                                                    self.dataset,
-                                                    self.collate_fn,
-                                                    next(it)))
+                        dq.append(self._submit(next(it)))
                     except StopIteration:
                         break
                 while dq:
                     fut = dq.popleft()
                     yield self._result(fut)
                     try:
-                        dq.append(self._pool.submit(_fetch_worker,
-                                                    self.dataset,
-                                                    self.collate_fn,
-                                                    next(it)))
+                        dq.append(self._submit(next(it)))
                     except StopIteration:
                         pass
             finally:

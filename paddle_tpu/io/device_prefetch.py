@@ -74,6 +74,12 @@ class DevicePrefetchIterator:
         self._done = False
         self._metrics = _prefetch_metrics()
         self._metrics["depth"].set_function(self._q.qsize)
+        # explicit context propagation: capture the constructing
+        # thread's span context so transfers traced on the background
+        # thread stay part of the caller's trace
+        from paddle_tpu.observability.tracing import tracer
+        self._tracer = tracer()
+        self._ctx = self._tracer.current_context()
 
         def place(batch) -> Any:
             if self._sharding is not None:
@@ -85,19 +91,8 @@ class DevicePrefetchIterator:
         def worker():
             it = iter(src)
             try:
-                for item in it:
-                    if self._stop.is_set():
-                        break
-                    dev = place(item)
-                    self._metrics["batches"].inc()
-                    while not self._stop.is_set():
-                        try:
-                            self._q.put(dev, timeout=0.05)
-                            break
-                        except queue.Full:
-                            continue
-                    else:
-                        break
+                with self._tracer.attach(self._ctx):
+                    self._worker_loop(it, place)
             except BaseException as e:  # propagate to consumer
                 self._exc = e
             finally:
@@ -120,6 +115,23 @@ class DevicePrefetchIterator:
         self._thread = threading.Thread(target=worker, daemon=True,
                                         name="paddle_tpu-device-prefetch")
         self._thread.start()
+
+    def _worker_loop(self, it, place):
+        for item in it:
+            if self._stop.is_set():
+                break
+            with self._tracer.span("prefetch.place",
+                                   root_eligible=False):
+                dev = place(item)
+            self._metrics["batches"].inc()
+            while not self._stop.is_set():
+                try:
+                    self._q.put(dev, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+            else:
+                break
 
     def __iter__(self) -> Iterator:
         return self

@@ -293,7 +293,9 @@ class TrainStep(CompiledStepBase):
         # hot path never blocks on the device
         self._metrics = _train_metrics()
         from paddle_tpu.observability import flight_recorder
+        from paddle_tpu.observability.tracing import tracer
         self._recorder = flight_recorder()
+        self._tracer = tracer()
         from paddle_tpu.analysis.recompile import SignatureMonitor
         self._signature_monitor = SignatureMonitor(
             name=f"TrainStep({type(model).__name__})")
@@ -380,6 +382,14 @@ class TrainStep(CompiledStepBase):
             step_count
 
     def __call__(self, batch):
+        # step span: children cover h2d placement, the compiled dispatch
+        # (with the accum scan as a nested level), and the step-guard's
+        # device sync — a slow step names its slow phase in the trace
+        with self._tracer.span("train.step", step=self._host_steps,
+                               accum=self._accum_steps):
+            return self._call_traced(batch)
+
+    def _call_traced(self, batch):
         # chaos: poison this batch's float leaves with NaN — the
         # injectable twin of a corrupt record / bad-loss microbatch,
         # which the step-guard must absorb (int-only LM batches have no
@@ -390,14 +400,16 @@ class TrainStep(CompiledStepBase):
                 lambda a: a * jnp.nan
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
                 else a, batch)
-        if self._batch_sh is not None:
-            batch = jax.tree.map(
-                lambda a: jax.device_put(jnp.asarray(a), self._batch_sh),
-                batch)
-        else:
-            # device-prefetched batches are already on device; asarray is
-            # a no-op for those and a copy for host numpy
-            batch = jax.tree.map(jnp.asarray, batch)
+        with self._tracer.span("train.h2d"):
+            if self._batch_sh is not None:
+                batch = jax.tree.map(
+                    lambda a: jax.device_put(jnp.asarray(a),
+                                             self._batch_sh),
+                    batch)
+            else:
+                # device-prefetched batches are already on device;
+                # asarray is a no-op for those, a copy for host numpy
+                batch = jax.tree.map(jnp.asarray, batch)
         if self._accum_steps > 1:
             for leaf in jax.tree.leaves(batch):
                 if getattr(leaf, "ndim", 0) and \
@@ -420,7 +432,18 @@ class TrainStep(CompiledStepBase):
         t0 = time.perf_counter()
         with self._recorder.instrumented("train.step",
                                          step=self._host_steps):
-            loss, gnorm, skip_code = self._run_jitted(batch, sub)
+            with self._tracer.span("train.dispatch",
+                                   microbatches=self._accum_steps):
+                if self._accum_steps > 1:
+                    # the scan runs on device as ONE program; this child
+                    # span marks the accumulated region so the trace
+                    # shows dispatch time is microbatch work, not gap
+                    with self._tracer.span("train.accum_microbatches",
+                                           n=self._accum_steps):
+                        loss, gnorm, skip_code = self._run_jitted(batch,
+                                                                  sub)
+                else:
+                    loss, gnorm, skip_code = self._run_jitted(batch, sub)
         dt = time.perf_counter() - t0
         self._host_steps += 1
         m = self._metrics
@@ -430,7 +453,10 @@ class TrainStep(CompiledStepBase):
         m["loss"].set(loss)     # device scalar, resolved at scrape
         m["gnorm"].set(gnorm)
         if self._guard_nonfinite:
-            self._account_skip(int(skip_code))
+            # the int() sync IS the guard's cost; the span makes it
+            # visible instead of smearing into "step overhead"
+            with self._tracer.span("train.guard"):
+                self._account_skip(int(skip_code))
         tokens = self._batch_tokens(batch)
         if tokens:
             m["tokens"].inc(tokens)

@@ -1,6 +1,7 @@
 """paddle_tpu.observability — always-on runtime telemetry.
 
-Three pieces (ISSUE 2 tentpole; see README.md in this package):
+Five pieces (ISSUE 2 + ISSUE 5 tentpoles; see README.md in this
+package):
 
 * **metrics** — label-aware :class:`Counter` / :class:`Gauge` /
   :class:`Histogram` in a process-wide registry.  Every hot loop in the
@@ -11,16 +12,28 @@ Three pieces (ISSUE 2 tentpole; see README.md in this package):
 * **flight recorder** — a bounded ring of structured events whose
   ``dump()`` auto-fires when an uncaught exception escapes an
   instrumented loop, so dead runs leave their final N events behind.
-* **exposition** — Prometheus text at ``/metrics`` over stdlib
-  ``http.server`` (``PADDLE_TPU_METRICS_PORT``) and a JSONL snapshot
-  sink for offline diffing (``PADDLE_TPU_METRICS_JSONL``).
+  Events recorded under an active trace span carry its trace/span ids.
+* **tracing** — hierarchical spans over the hot paths (train step,
+  serving request lifecycle, store ops, checkpoint shard writes,
+  prefetch threads) with explicit cross-thread and cross-host (TCPStore
+  header) context propagation, head-based sampling
+  (``PADDLE_TPU_TRACE_SAMPLE``), and Perfetto/chrome-trace export that
+  nests profiler ``RecordEvent`` annotations under spans.
+* **watchdog** — declarative SLO rules (step-time drift, recompile
+  storms, queue saturation, skip streaks, heartbeat gaps) evaluated
+  against the registry on a daemon thread; a breach emits a structured
+  alert, bumps ``paddle_tpu_slo_breaches_total{rule}``, and dumps the
+  flight recorder plus the slowest recent traces.
+* **exposition** — Prometheus text (cumulative ``_bucket{le=...}``
+  histograms) at ``/metrics`` over stdlib ``http.server``
+  (``PADDLE_TPU_METRICS_PORT``) and a JSONL snapshot sink that keeps
+  the pre-computed quantile summaries (``PADDLE_TPU_METRICS_JSONL``).
 
 Relationship to its siblings: ``paddle_tpu.analysis`` predicts cost
 statically, ``paddle_tpu.profiler`` measures a window you open by hand,
-observability *watches continuously* — drifting counters (recompiles,
-collective time, batch occupancy) surface regressions that a one-off
-trace only explains after the fact.  ``Profiler.summary()`` renders all
-three side by side.
+observability *watches continuously* — drifting counters surface
+regressions, traces say where the time went, and the watchdog turns
+both into auto-triage instead of dashboards someone must be watching.
 
 Demo: ``python -m paddle_tpu.observability.demo``.
 """
@@ -37,6 +50,13 @@ from paddle_tpu.observability.exposition import (JsonlSink, MetricsServer,
                                                  render_json,
                                                  render_prometheus,
                                                  start_metrics_server)
+from paddle_tpu.observability.tracing import (Span, SpanContext, Tracer,
+                                              extract_context,
+                                              inject_context, trace_span,
+                                              tracer)
+from paddle_tpu.observability.watchdog import (Alert, Watchdog,
+                                               default_rules,
+                                               rules_from_spec)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -44,4 +64,7 @@ __all__ = [
     "FlightRecorder", "flight_recorder",
     "JsonlSink", "MetricsServer", "render_json", "render_prometheus",
     "start_metrics_server",
+    "Span", "SpanContext", "Tracer", "tracer", "trace_span",
+    "inject_context", "extract_context",
+    "Alert", "Watchdog", "default_rules", "rules_from_spec",
 ]

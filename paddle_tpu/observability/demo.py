@@ -7,19 +7,36 @@ continuous-batching serving loop over a tiny Llama — then:
 1. starts the ``/metrics`` endpoint and fetches it over real HTTP
    (urllib against 127.0.0.1), printing the Prometheus text to stdout
    (CI greps it for ``paddle_tpu_serving_tokens_total`` and the
-   train-step latency histogram);
+   cumulative ``_bucket{le=...}`` train-step latency histogram);
 2. injects a mid-loop exception inside a flight-recorder-instrumented
    loop and shows ``dump()`` producing the run's final structured
-   events.
+   events;
+3. exports the distributed trace (``--trace-out``) as Perfetto/chrome
+   JSON and verifies it holds a stitched train+serve timeline with >= 3
+   nesting levels whose trace ids also appear in flight-recorder
+   events;
+4. arms the SLO watchdog with a step-time drift rule, forces a step-
+   time regression, and shows the breach: exactly one ``slo_breach``
+   alert event with the flight-recorder + slowest-trace dump.
 
-Exit code 0 only when every expected series is present.
+Exit code 0 only when every expected artifact is present.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import sys
 import urllib.request
+
+
+def _span_depth(span, by_id):
+    d, p = 1, span["args"].get("parent_id")
+    while p and p in by_id:
+        d += 1
+        p = by_id[p]["args"].get("parent_id")
+    return d
 
 
 def main(argv=None) -> int:
@@ -29,7 +46,13 @@ def main(argv=None) -> int:
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--train-steps", type=int, default=3)
     ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--trace-out", default="/tmp/paddle_tpu_trace.json",
+                    help="Perfetto/chrome-trace export path")
     args = ap.parse_args(argv)
+
+    # head-based sampling must be on before the first instrument builds
+    # the process tracer (CI exports a full trace; operators lower it)
+    os.environ.setdefault("PADDLE_TPU_TRACE_SAMPLE", "1.0")
 
     import numpy as np
 
@@ -37,8 +60,10 @@ def main(argv=None) -> int:
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
-    from paddle_tpu.observability import (default_registry, flight_recorder,
-                                          start_metrics_server)
+    from paddle_tpu.observability import (Watchdog, default_registry,
+                                          flight_recorder,
+                                          start_metrics_server, tracer)
+    from paddle_tpu.observability.watchdog import StepTimeDriftRule
 
     pp.seed(0)
     cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64,
@@ -66,19 +91,21 @@ def main(argv=None) -> int:
     # -- serve: 4-slot continuous batching populates the serving counters
     with ContinuousBatchingEngine(model, slots=args.slots, max_len=64,
                                   prefill_buckets=(16, 32)) as eng:
-        for i in range(args.requests):
-            eng.add_request(rng.integers(0, 256, (5 + 3 * i,)),
-                            max_new_tokens=8)
+        rids = [eng.add_request(rng.integers(0, 256, (5 + 3 * i,)),
+                                max_new_tokens=8)
+                for i in range(args.requests)]
         results = eng.run()
     print(f"[demo] served {len(results)} requests", file=sys.stderr)
-
-    # -- exposition: serve /metrics and fetch it over real HTTP
-    server = start_metrics_server(port=args.port,
-                                  registry=default_registry())
-    print(f"[demo] metrics endpoint: {server.url}", file=sys.stderr)
-    with urllib.request.urlopen(server.url, timeout=10) as resp:
-        text = resp.read().decode()
-    print(text)
+    # retired requests self-describe their lifecycle (ISSUE 5 satellite)
+    st = eng.request_status(rids[0])
+    if st != "ok" or not st.timings.get("first_token") or not st.trace_id:
+        print(f"[demo] FAIL: request_status timings missing: {st} "
+              f"{getattr(st, 'timings', None)}", file=sys.stderr)
+        return 1
+    print(f"[demo] request {rids[0]}: status={st} "
+          f"ttft={st.timings['ttft_s'] * 1e3:.1f}ms "
+          f"total={st.timings['total_s'] * 1e3:.1f}ms "
+          f"trace={st.trace_id}", file=sys.stderr)
 
     # -- flight recorder: inject a mid-loop crash, show the post-mortem
     recorder = flight_recorder()
@@ -94,19 +121,70 @@ def main(argv=None) -> int:
     print(f"[demo] flight recorder retained {len(recorder)} events; "
           f"last kinds: {[e['kind'] for e in events]}", file=sys.stderr)
 
+    # -- tracing: export the stitched train+serve timeline
+    trace = tracer().export_chrome(args.trace_out)
+    spans = {e["args"]["span_id"]: e for e in trace["traceEvents"]
+             if e.get("ph") == "X" and e.get("args", {}).get("span_id")}
+    names = {e["name"] for e in spans.values()}
+    depth = max(_span_depth(e, spans) for e in spans.values())
+    trace_ids = {e["args"]["trace_id"] for e in spans.values()}
+    stamped = [e for e in recorder.snapshot()
+               if e.get("trace_id") in trace_ids]
+    print(f"[demo] trace: {len(spans)} spans, max nesting {depth}, "
+          f"{len(stamped)} flight-recorder events stamped with trace "
+          f"ids -> {args.trace_out}", file=sys.stderr)
+    if not {"train.step", "train.dispatch",
+            "serving.request", "serving.prefill",
+            "serving.decode_step"} <= names:
+        print(f"[demo] FAIL: expected spans missing from {sorted(names)}",
+              file=sys.stderr)
+        return 1
+    if depth < 3 or not stamped:
+        print(f"[demo] FAIL: nesting depth {depth} < 3 or no stamped "
+              "recorder events", file=sys.stderr)
+        return 1
+
+    # -- watchdog: baseline from the real steps, then a forced step-time
+    # regression must trip the drift rule (alert + dumps)
+    wd = Watchdog(rules=[StepTimeDriftRule(factor=1.5, min_samples=1)],
+                  cooldown=0.0)
+    wd.evaluate_once()                      # interval 1: seeds baseline
+    hist = default_registry().get("paddle_tpu_train_step_seconds")
+    slow = 10.0 * hist.sum() / max(1.0, hist.count())
+    for _ in range(3):
+        hist.observe(slow)                  # the forced regression
+    alerts = wd.evaluate_once()
+    breaches = [e for e in recorder.snapshot()
+                if e["kind"] == "slo_breach"]
+    print(f"[demo] watchdog: {len(alerts)} alert(s), "
+          f"{len(breaches)} slo_breach event(s): "
+          f"{alerts[0].detail if alerts else '-'}", file=sys.stderr)
+    if len(alerts) != 1 or len(breaches) != 1:
+        print("[demo] FAIL: expected exactly one slo_breach",
+              file=sys.stderr)
+        return 1
+
+    # -- exposition: serve /metrics and fetch it over real HTTP
+    server = start_metrics_server(port=args.port,
+                                  registry=default_registry())
+    print(f"[demo] metrics endpoint: {server.url}", file=sys.stderr)
+    with urllib.request.urlopen(server.url, timeout=10) as resp:
+        text = resp.read().decode()
+    print(text)
     server.close()
 
-    expected = ("paddle_tpu_train_step_seconds_bucket",
+    expected = ("paddle_tpu_train_step_seconds_bucket{le=",
                 "paddle_tpu_train_loss",
                 "paddle_tpu_serving_tokens_total",
-                "paddle_tpu_serving_ttft_seconds_bucket",
-                "paddle_tpu_serving_decode_token_seconds_bucket",
-                "paddle_tpu_serving_prefill_bucket_total")
+                "paddle_tpu_serving_ttft_seconds_bucket{le=",
+                "paddle_tpu_serving_decode_token_seconds_bucket{le=",
+                "paddle_tpu_serving_prefill_bucket_total",
+                'paddle_tpu_slo_breaches_total{rule="step_time_drift"} 1')
     missing = [name for name in expected if name not in text]
     if missing:
         print(f"[demo] FAIL: missing series {missing}", file=sys.stderr)
         return 1
-    if not any(e["kind"] == "crash" for e in events):
+    if not any(e["kind"] == "crash" for e in recorder.snapshot()):
         print("[demo] FAIL: crash event not recorded", file=sys.stderr)
         return 1
     print("[demo] OK", file=sys.stderr)

@@ -216,14 +216,17 @@ class JsonlSink:
 
 _ENV_SERVER: Optional[MetricsServer] = None
 _ENV_SINK: Optional[JsonlSink] = None
+_ENV_WATCHDOG = None
 
 
 def maybe_start_from_env(registry) -> None:
     """Attach exporters requested by env (called once from
     ``default_registry()``): PADDLE_TPU_METRICS_PORT starts the HTTP
     endpoint, PADDLE_TPU_METRICS_JSONL starts a periodic file sink
-    (interval via PADDLE_TPU_METRICS_JSONL_INTERVAL, default 10s)."""
-    global _ENV_SERVER, _ENV_SINK
+    (interval via PADDLE_TPU_METRICS_JSONL_INTERVAL, default 10s), and
+    PADDLE_TPU_SLO_RULES starts the SLO watchdog with the declarative
+    rule spec (interval via PADDLE_TPU_SLO_INTERVAL, default 15s)."""
+    global _ENV_SERVER, _ENV_SINK, _ENV_WATCHDOG
     port = os.environ.get("PADDLE_TPU_METRICS_PORT")
     if port is not None and _ENV_SERVER is None:
         try:
@@ -237,3 +240,14 @@ def maybe_start_from_env(registry) -> None:
         interval = float(os.environ.get(
             "PADDLE_TPU_METRICS_JSONL_INTERVAL", "10"))
         _ENV_SINK = JsonlSink(path, registry=registry).start(interval)
+    rules = os.environ.get("PADDLE_TPU_SLO_RULES")
+    if rules and _ENV_WATCHDOG is None:
+        try:
+            from paddle_tpu.observability.watchdog import Watchdog
+            _ENV_WATCHDOG = Watchdog.from_spec(
+                rules, registry=registry).start(
+                float(os.environ.get("PADDLE_TPU_SLO_INTERVAL", "15")))
+        except Exception as e:  # a typo'd rule must not crash the job
+            import sys
+            print(f"paddle_tpu.observability: SLO watchdog from env "
+                  f"failed: {e}", file=sys.stderr)

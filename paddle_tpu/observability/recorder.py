@@ -36,10 +36,28 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._seq = 0
         self._clock = clock
+        self._ctx_provider = None
+
+    def set_context_provider(self, fn):
+        """Install a ``() -> (trace_id, span_id) | None`` callback (the
+        tracer registers one): every event recorded while a sampled span
+        is active on the calling thread is stamped with its ids, so a
+        dump and a trace can be joined post-mortem.  Costs one None
+        check per record() until someone installs it."""
+        self._ctx_provider = fn
 
     def record(self, kind: str, **fields):
         """Append one event.  O(1), allocation = one tuple + the fields
         dict the caller already built."""
+        prov = self._ctx_provider
+        if prov is not None:
+            try:
+                ctx = prov()
+            except Exception:
+                ctx = None
+            if ctx is not None:
+                fields.setdefault("trace_id", ctx[0])
+                fields.setdefault("span_id", ctx[1])
         with self._lock:
             self._seq += 1
             self._ring.append((self._seq, self._clock(), kind, fields))
@@ -61,6 +79,13 @@ class FlightRecorder:
             items = items[-last:]
         return [{"seq": s, "time": t, "kind": k, **f}
                 for s, t, k, f in items]
+
+    def snapshot(self, n: Optional[int] = None) -> List[dict]:
+        """The last ``n`` events (all retained when None) WITHOUT
+        clearing or otherwise disturbing the ring — the read a watchdog
+        or a debugger wants mid-flight.  Alias of :meth:`events` with
+        the non-destructive contract in the name."""
+        return self.events(last=n)
 
     def clear(self):
         with self._lock:

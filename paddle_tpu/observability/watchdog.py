@@ -1,0 +1,421 @@
+"""SLO watchdog — declarative rules over the metrics registry, with
+auto-triage on breach.
+
+PR 2 made the telemetry passive: counters drift, histograms fill, and a
+human must be looking at a dashboard at the right moment.  The watchdog
+closes the loop: a daemon thread periodically evaluates a set of
+declarative :class:`Rule` objects against the live registry and, on a
+breach,
+
+1. increments ``paddle_tpu_slo_breaches_total{rule=...}``,
+2. records a structured ``slo_breach`` event into the flight recorder,
+3. emits a one-line JSON alert (``{"slo_alert": ...}``) to stderr and to
+   ``PADDLE_TPU_SLO_ALERT_PATH`` when set,
+4. dumps the flight recorder's recent events (stderr +
+   ``PADDLE_TPU_FLIGHT_RECORDER_PATH``) and the N slowest recent traces
+   from the tracer — the "what was it doing" bundle, attached to the
+   alert instead of hunted down afterwards.
+
+Built-in rule types (see ``default_rules()``):
+
+=================  =======================================================
+``step_time_drift``   mean train-step time over the last interval vs. an
+                      EMA baseline of earlier intervals (``factor``×)
+``recompile_storm``   recompile counter rising faster than ``max_delta``
+                      per interval
+``queue_saturation``  serving admission queue depth at/above
+                      ``threshold`` for ``consecutive`` intervals
+``skip_streak``       non-finite step-guard skips rising faster than
+                      ``max_delta`` per interval
+``heartbeat_gap``     a progress counter (train steps by default) that
+                      stopped moving for ``max_gap_s`` seconds
+=================  =======================================================
+
+Rules are also constructible from a spec string (the env-var syntax,
+``PADDLE_TPU_SLO_RULES``)::
+
+    step_time_drift:factor=2.0,min_samples=10;queue_saturation:threshold=64
+
+Each ``;``-separated clause is ``<rule_name>[:k=v[,k=v...]]``; values
+are coerced to int/float when they parse.  ``Watchdog.from_spec`` /
+the ``PADDLE_TPU_SLO_RULES`` env var (checked when the default registry
+first starts its exporters) turn that line into a running watchdog.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+__all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
+           "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
+           "Alert", "Watchdog", "default_rules", "rules_from_spec",
+           "RULE_TYPES"]
+
+
+def _series_total(metric) -> float:
+    """Sum of a metric's children — collapses labeled counters (e.g.
+    skip reasons) into one progress number."""
+    return sum(child.value() for _, child in metric.series())
+
+
+def _hist_totals(metric):
+    count = csum = 0.0
+    for _, child in metric.series():
+        count += child.count()
+        csum += child.sum()
+    return count, csum
+
+
+@dataclass
+class Alert:
+    rule: str
+    detail: str
+    time: float
+
+
+class Rule:
+    """One declarative SLO condition.  ``evaluate`` returns a breach
+    detail string (truthy → alert) or None; rules keep their own
+    interval state so the watchdog can stay stateless about them."""
+
+    name = "rule"
+
+    def evaluate(self, registry, now: float) -> Optional[str]:
+        raise NotImplementedError
+
+
+class StepTimeDriftRule(Rule):
+    """Mean step time of the most recent interval vs. a rolling (EMA)
+    baseline of earlier intervals.  The first interval with at least
+    ``min_samples`` steps seeds the baseline; later intervals breach
+    when their mean exceeds ``factor``× the baseline (the baseline is
+    NOT polluted by the breaching interval)."""
+
+    def __init__(self, metric: str = "paddle_tpu_train_step_seconds",
+                 factor: float = 2.0, min_samples: int = 5,
+                 alpha: float = 0.3, name: str = "step_time_drift"):
+        self.name = name
+        self.metric = metric
+        self.factor = float(factor)
+        self.min_samples = int(min_samples)
+        self.alpha = float(alpha)
+        self.baseline: Optional[float] = None
+        self._last = (0.0, 0.0)    # (count, sum) at previous evaluation
+
+    def evaluate(self, registry, now):
+        h = registry.get(self.metric)
+        if h is None or h.kind != "histogram":
+            return None
+        count, total = _hist_totals(h)
+        dn, ds = count - self._last[0], total - self._last[1]
+        if dn < self.min_samples:
+            return None            # not enough fresh steps to judge
+        self._last = (count, total)
+        mean = ds / dn
+        if self.baseline is None:
+            self.baseline = mean
+            return None
+        if mean > self.factor * self.baseline:
+            return (f"mean step time {mean * 1e3:.2f}ms over last "
+                    f"{int(dn)} steps > {self.factor:g}x baseline "
+                    f"{self.baseline * 1e3:.2f}ms")
+        self.baseline = (1 - self.alpha) * self.baseline \
+            + self.alpha * mean
+        return None
+
+
+class RecompileStormRule(Rule):
+    """More than ``max_delta`` new recompiles in one interval — the
+    silent retrace loop (drifting shapes) that eats a TPU alive."""
+
+    def __init__(self, metric: str = "paddle_tpu_train_recompiles_total",
+                 max_delta: float = 2, name: str = "recompile_storm"):
+        self.name = name
+        self.metric = metric
+        self.max_delta = float(max_delta)
+        self._last: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        value = _series_total(m)
+        last, self._last = self._last, value
+        if last is None:
+            return None
+        delta = value - last
+        if delta > self.max_delta:
+            return (f"{int(delta)} recompiles in one interval "
+                    f"(> {self.max_delta:g}) — input signatures are "
+                    "churning")
+        return None
+
+
+class QueueSaturationRule(Rule):
+    """Serving admission queue at/above ``threshold`` for
+    ``consecutive`` intervals: the tier is shedding or about to."""
+
+    def __init__(self, metric: str = "paddle_tpu_serving_queue_depth",
+                 threshold: float = 16, consecutive: int = 3,
+                 name: str = "queue_saturation"):
+        self.name = name
+        self.metric = metric
+        self.threshold = float(threshold)
+        self.consecutive = int(consecutive)
+        self._streak = 0
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        depth = _series_total(m)
+        if depth >= self.threshold:
+            self._streak += 1
+        else:
+            self._streak = 0
+        if self._streak >= self.consecutive:
+            return (f"serving queue depth {depth:g} >= "
+                    f"{self.threshold:g} for {self._streak} consecutive "
+                    "intervals")
+        return None
+
+
+class SkipStreakRule(Rule):
+    """Non-finite step-guard skips rising faster than ``max_delta`` per
+    interval — the run is skating on divergence even before the guard's
+    own K-consecutive-skips escape hatch fires."""
+
+    def __init__(self,
+                 metric: str = "paddle_tpu_train_step_skipped_total",
+                 max_delta: float = 3, name: str = "skip_streak"):
+        self.name = name
+        self.metric = metric
+        self.max_delta = float(max_delta)
+        self._last: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        value = _series_total(m)
+        last, self._last = self._last, value
+        if last is None:
+            return None
+        delta = value - last
+        if delta > self.max_delta:
+            return (f"{int(delta)} optimizer updates skipped "
+                    f"(non-finite) in one interval (> "
+                    f"{self.max_delta:g})")
+        return None
+
+
+class HeartbeatGapRule(Rule):
+    """A progress counter that stopped moving: armed once the counter
+    first advances, breaches after ``max_gap_s`` seconds without any
+    further increase (a hung device dispatch or a deadlocked loop
+    produces exactly this signature — alive process, frozen counter)."""
+
+    def __init__(self, metric: str = "paddle_tpu_train_steps_total",
+                 max_gap_s: float = 120.0, name: str = "heartbeat_gap"):
+        self.name = name
+        self.metric = metric
+        self.max_gap_s = float(max_gap_s)
+        self._last_value: Optional[float] = None
+        self._last_change: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        value = _series_total(m)
+        if value != self._last_value:
+            self._last_value = value
+            self._last_change = now
+            return None
+        if not value or self._last_change is None:
+            return None            # never progressed: not armed yet
+        gap = now - self._last_change
+        if gap > self.max_gap_s:
+            return (f"{self.metric} frozen at {value:g} for "
+                    f"{gap:.1f}s (> {self.max_gap_s:g}s)")
+        return None
+
+
+RULE_TYPES = {
+    "step_time_drift": StepTimeDriftRule,
+    "recompile_storm": RecompileStormRule,
+    "queue_saturation": QueueSaturationRule,
+    "skip_streak": SkipStreakRule,
+    "heartbeat_gap": HeartbeatGapRule,
+}
+
+
+def default_rules() -> List[Rule]:
+    return [StepTimeDriftRule(), RecompileStormRule(),
+            QueueSaturationRule(), SkipStreakRule(), HeartbeatGapRule()]
+
+
+def _coerce(v: str):
+    for cast in (int, float):
+        try:
+            return cast(v)
+        except ValueError:
+            continue
+    return v
+
+
+def rules_from_spec(spec: str) -> List[Rule]:
+    """Parse the declarative rule syntax (module docstring) into rule
+    instances.  Unknown rule names raise — a typo'd SLO that silently
+    never fires is worse than a crash at startup."""
+    rules: List[Rule] = []
+    for clause in spec.split(";"):
+        clause = clause.strip()
+        if not clause:
+            continue
+        rname, _, argstr = clause.partition(":")
+        rname = rname.strip()
+        if rname not in RULE_TYPES:
+            raise ValueError(
+                f"unknown SLO rule {rname!r}; choose from "
+                f"{sorted(RULE_TYPES)}")
+        kwargs = {}
+        for pair in filter(None, (p.strip()
+                                  for p in argstr.split(","))):
+            k, _, v = pair.partition("=")
+            if not _ or not k:
+                raise ValueError(f"bad rule arg {pair!r} in {clause!r}")
+            kwargs[k.strip()] = _coerce(v.strip())
+        rules.append(RULE_TYPES[rname](**kwargs))
+    return rules
+
+
+class Watchdog:
+    """Evaluate rules on an interval; auto-triage on breach.
+
+    ``evaluate_once(now)`` is the synchronous core (tests drive it with
+    synthetic clocks/metric streams); ``start(interval)`` runs it on a
+    daemon thread.  A per-rule ``cooldown`` keeps a persistently-bad
+    condition from re-alerting every interval."""
+
+    def __init__(self, rules: Optional[List[Rule]] = None, registry=None,
+                 recorder=None, trace_source=None,
+                 cooldown: float = 60.0, slow_traces: int = 3,
+                 dump_events: int = 100, alert_file=None):
+        if registry is None:
+            from paddle_tpu.observability.metrics import default_registry
+            registry = default_registry()
+        if recorder is None:
+            from paddle_tpu.observability.recorder import flight_recorder
+            recorder = flight_recorder()
+        self.registry = registry
+        self.recorder = recorder
+        self._trace_source = trace_source
+        self.rules = list(rules) if rules is not None else default_rules()
+        self.cooldown = cooldown
+        self.slow_traces = slow_traces
+        self.dump_events = dump_events
+        self.alert_file = alert_file
+        self.alerts: List[Alert] = []
+        self._last_fire: Dict[str, float] = {}
+        self._breaches = registry.counter(
+            "paddle_tpu_slo_breaches_total",
+            "SLO rule breaches detected by the watchdog",
+            labelnames=("rule",))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_spec(cls, spec: str, **kwargs) -> "Watchdog":
+        return cls(rules=rules_from_spec(spec), **kwargs)
+
+    def _tracer(self):
+        if self._trace_source is not None:
+            return self._trace_source
+        from paddle_tpu.observability.tracing import tracer
+        return tracer()
+
+    # -- evaluation ---------------------------------------------------------
+    def evaluate_once(self, now: Optional[float] = None) -> List[Alert]:
+        """One pass over every rule; returns the alerts fired.  ``now``
+        is injectable (monotonic seconds) so heartbeat/cooldown logic is
+        testable with a synthetic clock."""
+        if now is None:
+            now = time.monotonic()
+        fired: List[Alert] = []
+        for rule in self.rules:
+            try:
+                detail = rule.evaluate(self.registry, now)
+            except Exception:
+                continue           # a broken rule must not kill the dog
+            if not detail:
+                continue
+            last = self._last_fire.get(rule.name)
+            if last is not None and now - last < self.cooldown:
+                continue
+            self._last_fire[rule.name] = now
+            fired.append(self._fire(rule.name, detail))
+        return fired
+
+    def _fire(self, rule_name: str, detail: str) -> Alert:
+        alert = Alert(rule=rule_name, detail=detail, time=time.time())
+        self.alerts.append(alert)
+        self._breaches.labels(rule=rule_name).inc()
+        # the breach event goes into the ring FIRST so the dump below —
+        # and any later crash dump — contains it
+        self.recorder.record("slo_breach", rule=rule_name, detail=detail)
+        line = json.dumps({"slo_alert": {
+            "rule": rule_name, "detail": detail, "time": alert.time}})
+        print(line, file=sys.stderr)
+        sink = self.alert_file or os.environ.get(
+            "PADDLE_TPU_SLO_ALERT_PATH")
+        if sink:
+            try:
+                with open(sink, "a") as f:
+                    f.write(line + "\n")
+            except Exception:
+                pass
+        # auto-triage bundle: recent flight-recorder events + the
+        # slowest recent traces, attached to the alert
+        try:
+            self.recorder.dump(last=self.dump_events,
+                               reason=f"slo breach: {rule_name}")
+            path = os.environ.get("PADDLE_TPU_FLIGHT_RECORDER_PATH")
+            if path:
+                self.recorder.dump(file=path, last=self.dump_events,
+                                   reason=f"slo breach: {rule_name}")
+        except Exception:
+            pass
+        try:
+            traces = self._tracer().slowest_traces(self.slow_traces)
+            if traces:
+                print(json.dumps({"slow_traces": traces},
+                                 default=str), file=sys.stderr)
+        except Exception:
+            pass
+        return alert
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self, interval: float = 15.0) -> "Watchdog":
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.evaluate_once()
+                except Exception:
+                    pass           # the watchdog must outlive bad scrapes
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="paddle-tpu-slo-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -109,14 +109,22 @@ _SINKS_LOCK = threading.Lock()
 def _deliver(name, t0, t1, event_type=None):
     """Route a finished host event to every ACTIVE profiler session
     (each gets its own copy), or to the global fallback when no session
-    is open."""
+    is open.  Independently, the event is offered to the span tracer:
+    an annotation finishing under an active span becomes a child span,
+    so the Perfetto export shows RecordEvents nested inside the
+    step/request structure (observability tracing unification)."""
     with _SINKS_LOCK:
         sinks = list(_SESSION_SINKS)
     if not sinks:
         _EVENTS.add(name, t0, t1, event_type)
-        return
-    for sink in sinks:
-        sink.add(name, t0, t1, event_type)
+    else:
+        for sink in sinks:
+            sink.add(name, t0, t1, event_type)
+    try:
+        from paddle_tpu.observability.tracing import on_host_event
+        on_host_event(name, t0, t1, event_type)
+    except Exception:
+        pass  # tracing must never break profiling
 
 
 class RecordEvent:
