@@ -4,14 +4,24 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline (BASELINE.md): Llama-3-8B pretraining >= 40% MFU on v5p; on a single
 chip we measure a Llama-proportioned model that fits one chip's HBM and
 report model FLOPs utilisation of the full fwd+bwd+update step.
+
+The ``detail`` payload carries the device-observability evidence next to
+the headline: AOT compile-phase times and the executable's XLA-measured
+FLOPs / bytes / peak HBM, plus the device-profiler's roofline-gap
+attribution (the ranked fusion target list) and the live-byte watermark.
+``--compare`` re-checks the fresh run against the newest BENCH_r*.json:
+a headline drop (or step-time rise) beyond ``--tolerance`` prints a
+``bench_compare`` line to stderr and exits 1.
 """
 
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import os
 import re
+import sys
 import time
 
 import numpy as np
@@ -33,10 +43,10 @@ def _peak_flops(device) -> float:
     return 459e12  # assume v5p (the baseline hardware)
 
 
-def _prev_value():
-    """Headline value of the latest successful BENCH_r*.json, so the
-    emitted line carries trajectory (vs_prev) next to target (vs_baseline)."""
-    best_round, best_val = -1, None
+def _prev_record():
+    """Parsed payload of the latest successful BENCH_r*.json (headline +
+    detail), so fresh runs can be compared against trajectory."""
+    best_round, best = -1, None
     here = os.path.dirname(os.path.abspath(__file__))
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
@@ -45,15 +55,52 @@ def _prev_value():
         try:
             with open(path) as f:
                 rec = json.load(f)
-            val = rec.get("parsed", {}).get("value")
+            parsed = rec.get("parsed") or {}
+            val = parsed.get("value")
         except Exception:
             continue
         if val is not None and int(m.group(1)) > best_round:
-            best_round, best_val = int(m.group(1)), float(val)
-    return best_val
+            best_round, best = int(m.group(1)), parsed
+    return best
 
 
-def main():
+def _prev_value():
+    prev = _prev_record()
+    return float(prev["value"]) if prev else None
+
+
+def compare_records(cur: dict, prev: dict, tolerance: float = 0.05):
+    """Regression check of a fresh result against a previous BENCH
+    payload.  Returns a list of human-readable regression strings
+    (empty = within tolerance).  Headline value is better-higher;
+    step_time_s is better-lower."""
+    regressions = []
+    pv = prev.get("value")
+    cv = cur.get("value")
+    if pv and cv is not None and cv < float(pv) * (1.0 - tolerance):
+        regressions.append(
+            f"value {cv:.4f} < prev {float(pv):.4f} - {tolerance:.0%} "
+            f"tolerance (ratio {cv / float(pv):.3f})")
+    pt = (prev.get("detail") or {}).get("step_time_s")
+    ct = (cur.get("detail") or {}).get("step_time_s")
+    if pt and ct and float(ct) > float(pt) * (1.0 + tolerance):
+        regressions.append(
+            f"step_time_s {float(ct):.4f} > prev {float(pt):.4f} + "
+            f"{tolerance:.0%} tolerance")
+    return regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--compare", action="store_true",
+                    help="flag regressions vs the newest BENCH_r*.json "
+                         "(exit 1 beyond --tolerance)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="relative regression tolerance for --compare")
+    ap.add_argument("--no-device-profile", action="store_true",
+                    help="skip the roofline-gap segment profiling pass")
+    args = ap.parse_args(argv)
+
     import jax
 
     import paddle_tpu as pp
@@ -63,7 +110,6 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
-    import os
     if on_tpu:
         # Llama-3-8B-proportioned, scaled to fit one 16G-HBM chip with the
         # full AdamW training state (bf16 params + f32 master + f32 m/v
@@ -89,6 +135,8 @@ def main():
     remat = os.environ.get("PT_BENCH_REMAT", "0") == "1"
     remat_policy = os.environ.get("PT_BENCH_REMAT_POLICY") or None
     accum = int(os.environ.get("PT_BENCH_ACCUM", "1"))
+    profile_segments = not args.no_device_profile and \
+        os.environ.get("PT_BENCH_PROFILE", "1") != "0"
 
     model = LlamaForCausalLM(cfg)
     opt = pp.optimizer.AdamW(learning_rate=1e-4,
@@ -101,6 +149,12 @@ def main():
     rng = np.random.default_rng(0)
     ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
     batch_dict = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    # explicit AOT compile first: the measured run dispatches through the
+    # compiled executable (no first-step compile spike inside timing) and
+    # lower/compile wall time + XLA's flops/bytes/peak-memory become part
+    # of the artifact
+    compile_info = step.compile(batch_dict)
 
     # device prefetch: H2D for batch N+1 rides behind step N instead of
     # serializing ahead of it (paddle_tpu.io.device_prefetch)
@@ -159,6 +213,27 @@ def main():
         "device_prefetch": True,
     }
 
+    # device-time breakdown: where the step's MFU gap actually sits —
+    # the ranked attribution rows are the fusion target list (ROADMAP 2)
+    from paddle_tpu.observability.device_profiler import (
+        DeviceProfiler, device_memory_monitor, llama_step_segments)
+    device_profile = None
+    if profile_segments:
+        try:
+            prof = DeviceProfiler()
+            for seg in llama_step_segments(model, batch_dict):
+                prof.add(seg)
+            result = prof.profile(reps=2, warmup=1,
+                                  parent_span="train.step")
+            device_profile = {
+                "segments": result.to_dicts(top=8),
+                "peak_flops": result.peak_flops,
+                "hbm_bw": result.hbm_bw,
+            }
+        except Exception as e:   # attribution must never sink the bench
+            device_profile = {"error": f"{type(e).__name__}: {e}"}
+    live_watermark = device_memory_monitor().watermark
+
     prev = _prev_value()
     result = {
         "metric": "llama_pretrain_mfu",
@@ -175,10 +250,37 @@ def main():
             "device": getattr(dev, "device_kind", dev.platform),
             "final_loss": float(loss),
             "paths": paths,
+            "compile": {
+                "lower_s": round(compile_info.lower_s, 4),
+                "compile_s": round(compile_info.compile_s, 4),
+                "flops": compile_info.stats.flops,
+                "bytes_accessed": compile_info.stats.bytes_accessed,
+                "peak_hbm_bytes": compile_info.stats.peak_bytes,
+            },
+            "peak_hbm_bytes": compile_info.stats.peak_bytes,
+            "device_live_bytes_watermark": live_watermark,
+            "device_profile": device_profile,
         },
     }
     print(json.dumps(result))
 
+    if args.compare:
+        prev_rec = _prev_record()
+        if prev_rec is None:
+            print(json.dumps({"bench_compare": {
+                "ok": True, "note": "no previous BENCH artifact"}}),
+                file=sys.stderr)
+            return 0
+        regressions = compare_records(result, prev_rec, args.tolerance)
+        print(json.dumps({"bench_compare": {
+            "ok": not regressions,
+            "tolerance": args.tolerance,
+            "prev_value": prev_rec.get("value"),
+            "regressions": regressions}}), file=sys.stderr)
+        if regressions:
+            return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

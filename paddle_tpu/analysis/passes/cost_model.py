@@ -162,6 +162,17 @@ class CostSummary:
     def compute_bound(self) -> bool:
         return self.intensity >= self.ridge
 
+    def roofline_seconds(self) -> float:
+        """Static roofline lower bound on execution time: the slower of
+        the compute leg and the memory leg.  Bytes are unfused, so this
+        is conservative — the device-profiler gap ratios it feeds
+        (observability.device_profiler) understate rather than invent
+        fusion headroom."""
+        compute = self.total_flops / self.peak_flops if self.peak_flops \
+            else 0.0
+        memory = self.total_bytes / self.hbm_bw if self.hbm_bw else 0.0
+        return max(compute, memory)
+
     def table(self, top_prims: int = 12) -> str:
         lines = [f"{'primitive':28s} {'count':>7s} {'GFLOPs':>12s} "
                  f"{'GB moved':>10s} {'flop/B':>8s}"]

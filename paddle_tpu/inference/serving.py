@@ -351,6 +351,10 @@ class ContinuousBatchingEngine:
         self._decode_raw = decode
         self._decode = jax.jit(decode, donate_argnums=(2,))
         self._fwd = fwd
+        # AOT executables from aot_warmup(): decode + one prefill per
+        # bucket; dispatch prefers them (no first-request compile spike)
+        self._decode_compiled = None
+        self._prefill_compiled: Dict[int, object] = {}
 
         from paddle_tpu.analysis import analysis_mode
         mode = analyze if analyze is not None else analysis_mode()
@@ -359,6 +363,42 @@ class ContinuousBatchingEngine:
             report = self.analyze(strict=(mode == "strict"))
             if len(report):
                 print(report.format(), file=sys.stderr)
+
+    def aot_warmup(self, buckets: Optional[Sequence[int]] = None):
+        """Explicitly compile the serving executables up front — the
+        decode step and one prefill per prompt bucket — with full
+        compile observability (``compile.lower``/``compile.xla`` spans,
+        ``paddle_tpu_compile_total{target}`` counters, per-executable
+        FLOPs / HBM bytes / peak-memory gauges).  The engine then
+        dispatches through the compiled objects: no first-request
+        compile spike, a shape drift raises instead of silently
+        recompiling, and a restarting replica's warmup cost is a
+        measured number (ROADMAP item 5's cold-start budget).  Returns
+        ``{target: ExecutableStats}``."""
+        from paddle_tpu.observability.device_profiler import aot_compile
+        stats = {}
+        toks = jnp.zeros((self.slots,), jnp.int32)
+        pos = jnp.zeros((self.slots,), jnp.int32)
+        active = jnp.ones((self.slots,), jnp.bool_)
+        compiled, info = aot_compile(
+            self._decode, self._keep, self._quant, self._caches, toks,
+            pos, active, self._key, target="serving.decode")
+        self._decode_compiled = compiled
+        stats["serving.decode"] = info.stats
+        cfgm = self.model.config
+        shape1 = (1, self.max_len, cfgm.num_key_value_heads, cfgm.head_dim)
+        for b in (buckets or self.buckets):
+            ids = jnp.zeros((1, b), jnp.int32)
+            kv1 = [(jnp.zeros(shape1, self._dtype),
+                    jnp.zeros(shape1, self._dtype))
+                   for _ in range(cfgm.num_hidden_layers)]
+            target = f"serving.prefill[{b}]"
+            compiled, info = aot_compile(
+                self._prefill, self._keep, self._quant, ids, kv1,
+                jnp.asarray(b, jnp.int32), self._key, target=target)
+            self._prefill_compiled[b] = compiled
+            stats[target] = info.stats
+        return stats
 
     def analyze(self, strict: bool = False, passes=None, options=None):
         """Lint the compiled decode step (the hot serving path) with the
@@ -473,12 +513,13 @@ class ContinuousBatchingEngine:
         sub = self._next_key()
         # prefill child span under the request's root: covers the
         # bucketed forward AND the slot insert (both block admission)
+        prefill = self._prefill_compiled.get(Lb, self._prefill)
         with self._tracer.span("serving.prefill", parent=req.span,
                                rid=req.rid, bucket=Lb, prompt_len=Lp):
-            first, caches1 = self._prefill(self._keep, self._quant,
-                                           jnp.asarray(ids), kv1,
-                                           jnp.asarray(Lp, jnp.int32),
-                                           sub)
+            first, caches1 = prefill(self._keep, self._quant,
+                                     jnp.asarray(ids), kv1,
+                                     jnp.asarray(Lp, jnp.int32),
+                                     sub)
             self._caches = self._insert(self._caches, caches1,
                                         jnp.asarray(slot, jnp.int32))
             first = int(first)
@@ -628,8 +669,9 @@ class ContinuousBatchingEngine:
         chunk_reqs = [r for r in self._active if r is not None]
         sub = self._next_key()
         t0 = time.perf_counter()
+        decode = self._decode_compiled or self._decode
         with self._recorder.instrumented("serving.decode"):
-            toks, self._caches = self._decode(
+            toks, self._caches = decode(
                 self._keep, self._quant, self._caches,
                 jnp.asarray(self._last_tok), jnp.asarray(pos),
                 jnp.asarray(active), sub)

@@ -59,6 +59,11 @@ def _train_metrics():
             "optimizer updates skipped by the non-finite step-guard "
             "(params and optimizer state left unchanged)",
             labelnames=("reason",)),
+        "mfu": reg.gauge(
+            "paddle_tpu_train_mfu",
+            "measured model-FLOPs utilisation of the most recent step "
+            "(XLA executable FLOPs / step time / device peak; set once "
+            "TrainStep.compile() has introspected the executable)"),
     }
 
 
@@ -101,9 +106,16 @@ class CompiledStepBase:
                 for n, st in self.opt_state.items()}
         self.step_count = jnp.zeros((), jnp.int32)
 
+    def _dispatch_fn(self, *step_args):
+        """The callable that executes this step — subclasses may return
+        an AOT-compiled executable when the call signature matches it
+        (TrainStep.compile)."""
+        return self._jitted
+
     def _run_jitted(self, *step_args):
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.params, self.opt_state, self.step_count = self._jitted(
+        fn = self._dispatch_fn(*step_args)
+        loss, self.params, self.opt_state, self.step_count = fn(
             self.params, self.opt_state, self.step_count, *step_args, lr)
         if self.optimizer._lr_scheduler is not None:
             self.optimizer._lr_scheduler.step()
@@ -286,6 +298,24 @@ class TrainStep(CompiledStepBase):
 
         self._init_step_state(optimizer, params, param_sh)
         self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
+        # AOT path (device-profiler tentpole): compile(batch) stores the
+        # explicit lower().compile() executable here; calls whose batch
+        # signature matches dispatch through it (no retrace hazard, and
+        # the executable's cost/memory analysis feeds the MFU gauge)
+        self._compiled = None
+        self._compiled_sig = None
+        self._exe_flops = None
+        self._peak_flops = None
+        # per-step HBM watermark sampling (leak detection rides on it);
+        # PADDLE_TPU_DEVICE_WATERMARK=0 disables, _WATERMARK_INTERVAL
+        # thins it (the sweep is O(live arrays))
+        self._memmon = None
+        self._watermark_every = max(1, int(_os.environ.get(
+            "PADDLE_TPU_WATERMARK_INTERVAL", "1")))
+        if _os.environ.get("PADDLE_TPU_DEVICE_WATERMARK", "1") != "0":
+            from paddle_tpu.observability.device_profiler import \
+                device_memory_monitor
+            self._memmon = device_memory_monitor()
 
         # always-on telemetry (observability tentpole): metric writes are
         # dict lookups + float adds; the loss / grad-norm gauges hold the
@@ -381,6 +411,49 @@ class TrainStep(CompiledStepBase):
         return (loss, gnorm, skip_code), new_params, new_opt_state, \
             step_count
 
+    def _place_batch(self, batch):
+        """Device placement shared by the call path and compile():
+        sharded device_put under a mesh, plain asarray otherwise
+        (device-prefetched batches are already resident — no-op)."""
+        if self._batch_sh is not None:
+            return jax.tree.map(
+                lambda a: jax.device_put(jnp.asarray(a), self._batch_sh),
+                batch)
+        return jax.tree.map(jnp.asarray, batch)
+
+    def compile(self, batch):
+        """AOT-compile the step for this batch signature with full
+        compile observability: ``train.compile`` span (with
+        ``compile.lower`` / ``compile.xla`` children), the per-target
+        compile counter, and the executable's measured FLOPs / HBM
+        bytes / peak memory exposed as ``paddle_tpu_xla_*`` gauges.
+        Subsequent calls whose batch matches dispatch through the
+        compiled executable (no retrace), and the step starts setting
+        the ``paddle_tpu_train_mfu`` gauge.  Returns the
+        :class:`~paddle_tpu.observability.device_profiler.CompileInfo`.
+        """
+        from paddle_tpu.observability.device_profiler import (
+            aot_compile, signature_of)
+        batch = self._place_batch(batch)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        target = f"TrainStep({type(self.model).__name__})"
+        with self._tracer.span("train.compile", target=target):
+            compiled, info = aot_compile(
+                self._jitted, self.params, self.opt_state,
+                self.step_count, batch, self._key, lr, target=target)
+        self._compiled = compiled
+        self._compiled_sig = signature_of(batch)
+        self._exe_flops = info.stats.flops or None
+        return info
+
+    def _dispatch_fn(self, *step_args):
+        if self._compiled is not None:
+            from paddle_tpu.observability.device_profiler import \
+                signature_of
+            if signature_of(step_args[0]) == self._compiled_sig:
+                return self._compiled
+        return self._jitted
+
     def __call__(self, batch):
         # step span: children cover h2d placement, the compiled dispatch
         # (with the accum scan as a nested level), and the step-guard's
@@ -401,15 +474,7 @@ class TrainStep(CompiledStepBase):
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
                 else a, batch)
         with self._tracer.span("train.h2d"):
-            if self._batch_sh is not None:
-                batch = jax.tree.map(
-                    lambda a: jax.device_put(jnp.asarray(a),
-                                             self._batch_sh),
-                    batch)
-            else:
-                # device-prefetched batches are already on device;
-                # asarray is a no-op for those, a copy for host numpy
-                batch = jax.tree.map(jnp.asarray, batch)
+            batch = self._place_batch(batch)
         if self._accum_steps > 1:
             for leaf in jax.tree.leaves(batch):
                 if getattr(leaf, "ndim", 0) and \
@@ -462,6 +527,18 @@ class TrainStep(CompiledStepBase):
             m["tokens"].inc(tokens)
             if dt > 0:
                 m["tps"].set(tokens / dt)
+        # measured MFU: the AOT executable's XLA-counted FLOPs over this
+        # step's wall time — the drift gauge the mfu_drift SLO rule
+        # watches (only armed once compile(batch) introspected the step)
+        if self._exe_flops and dt > 0:
+            if self._peak_flops is None:
+                from paddle_tpu.observability.device_profiler import \
+                    detect_roofline
+                self._peak_flops = detect_roofline()[0]
+            m["mfu"].set(self._exe_flops / dt / self._peak_flops)
+        if self._memmon is not None and \
+                (self._host_steps % self._watermark_every) == 0:
+            self._memmon.sample(step=self._host_steps)
         return loss
 
     def _account_skip(self, code: int):

@@ -28,6 +28,13 @@ package):
   histograms) at ``/metrics`` over stdlib ``http.server``
   (``PADDLE_TPU_METRICS_PORT``) and a JSONL snapshot sink that keeps
   the pre-computed quantile summaries (``PADDLE_TPU_METRICS_JSONL``).
+* **device profiler** — explicit ``lower→compile`` observability
+  (phase spans, per-target counters, per-executable FLOPs / HBM bytes
+  / peak-memory gauges from XLA's cost/memory analysis), segment-level
+  device timing under ``block_until_ready``, a **roofline-gap
+  attribution table** joining measured device time against the static
+  cost model (the fusion target list), and an HBM live-buffer census /
+  watermark with leak detection.
 
 Relationship to its siblings: ``paddle_tpu.analysis`` predicts cost
 statically, ``paddle_tpu.profiler`` measures a window you open by hand,
@@ -57,6 +64,11 @@ from paddle_tpu.observability.tracing import (Span, SpanContext, Tracer,
 from paddle_tpu.observability.watchdog import (Alert, Watchdog,
                                                default_rules,
                                                rules_from_spec)
+from paddle_tpu.observability.device_profiler import (
+    AttributionResult, CompileInfo, DeviceMemoryMonitor, DeviceProfiler,
+    ExecutableStats, Segment, aot_compile, compile_records,
+    compiled_stats, detect_roofline, device_memory_monitor,
+    llama_step_segments, signature_of)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -67,4 +79,8 @@ __all__ = [
     "Span", "SpanContext", "Tracer", "tracer", "trace_span",
     "inject_context", "extract_context",
     "Alert", "Watchdog", "default_rules", "rules_from_spec",
+    "AttributionResult", "CompileInfo", "DeviceMemoryMonitor",
+    "DeviceProfiler", "ExecutableStats", "Segment", "aot_compile",
+    "compile_records", "compiled_stats", "detect_roofline",
+    "device_memory_monitor", "llama_step_segments", "signature_of",
 ]

@@ -88,6 +88,36 @@ def main(argv=None) -> int:
     print(f"[demo] trained {args.train_steps} steps, "
           f"loss={float(loss):.4f}", file=sys.stderr)
 
+    # -- device profiler: decompose the step into op groups, time them
+    # on device, and join against the static roofline — the ranked
+    # attribution table is the fusion target list (ROADMAP item 2)
+    from paddle_tpu.observability.device_profiler import (
+        DeviceProfiler, device_memory_monitor, llama_step_segments)
+    prof = DeviceProfiler()
+    for seg in llama_step_segments(model, {"input_ids": ids,
+                                           "labels": ids}):
+        prof.add(seg)
+    attribution = prof.profile(reps=2, warmup=1,
+                               parent_span="train.step")
+    print(attribution.table(), file=sys.stderr)
+    rows = attribution.ranked()
+    if len(rows) < 5 or not all(
+            r.device_s > 0 and r.predicted_s > 0 and r.gap > 0
+            for r in rows):
+        print(f"[demo] FAIL: attribution table incomplete "
+              f"({len(rows)} rows)", file=sys.stderr)
+        return 1
+    mem = device_memory_monitor()
+    live = mem.sample()
+    census = mem.census(top=3)
+    print(f"[demo] device memory: {live} live bytes "
+          f"(watermark {mem.watermark}); census top: "
+          + ", ".join(f"{r['dtype']}{r['shape']}x{r['count']}"
+                      for r in census), file=sys.stderr)
+    if live <= 0 or not census:
+        print("[demo] FAIL: live-buffer census empty", file=sys.stderr)
+        return 1
+
     # -- serve: 4-slot continuous batching populates the serving counters
     with ContinuousBatchingEngine(model, slots=args.slots, max_len=64,
                                   prefill_buckets=(16, 32)) as eng:
@@ -135,13 +165,30 @@ def main(argv=None) -> int:
           f"ids -> {args.trace_out}", file=sys.stderr)
     if not {"train.step", "train.dispatch",
             "serving.request", "serving.prefill",
-            "serving.decode_step"} <= names:
+            "serving.decode_step", "compile.lower", "compile.xla"} <= names:
         print(f"[demo] FAIL: expected spans missing from {sorted(names)}",
               file=sys.stderr)
         return 1
     if depth < 3 or not stamped:
         print(f"[demo] FAIL: nesting depth {depth} < 3 or no stamped "
               "recorder events", file=sys.stderr)
+        return 1
+    # device segments must nest under a train.step span — host and
+    # device time in ONE Perfetto view is the tentpole acceptance
+    def _ancestors(e):
+        out, p = [], e["args"].get("parent_id")
+        while p and p in spans:
+            out.append(spans[p]["name"])
+            p = spans[p]["args"].get("parent_id")
+        return out
+    dev_spans = [e for e in spans.values()
+                 if e["name"].startswith("device.")]
+    nested = [e for e in dev_spans if "train.step" in _ancestors(e)]
+    print(f"[demo] {len(dev_spans)} device segments in trace, "
+          f"{len(nested)} nested under train.step", file=sys.stderr)
+    if len(nested) < 5:
+        print("[demo] FAIL: device segments not nested under train.step",
+              file=sys.stderr)
         return 1
 
     # -- watchdog: baseline from the real steps, then a forced step-time
@@ -179,6 +226,10 @@ def main(argv=None) -> int:
                 "paddle_tpu_serving_ttft_seconds_bucket{le=",
                 "paddle_tpu_serving_decode_token_seconds_bucket{le=",
                 "paddle_tpu_serving_prefill_bucket_total",
+                "paddle_tpu_compile_total",
+                "paddle_tpu_xla_flops",
+                "paddle_tpu_device_live_bytes",
+                "paddle_tpu_device_segment_seconds_bucket{",
                 'paddle_tpu_slo_breaches_total{rule="step_time_drift"} 1')
     missing = [name for name in expected if name not in text]
     if missing:

@@ -29,6 +29,10 @@ Built-in rule types (see ``default_rules()``):
                       ``max_delta`` per interval
 ``heartbeat_gap``     a progress counter (train steps by default) that
                       stopped moving for ``max_gap_s`` seconds
+``mfu_drift``         measured MFU gauge (``paddle_tpu_train_mfu``)
+                      dropping below ``factor``× its EMA baseline
+``compile_storm``     fresh XLA compiles (``paddle_tpu_compile_total``)
+                      rising faster than ``max_delta`` per interval
 =================  =======================================================
 
 Rules are also constructible from a spec string (the env-var syntax,
@@ -54,6 +58,7 @@ from typing import Dict, List, Optional
 
 __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
+           "MfuDriftRule", "CompileStormRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -247,18 +252,85 @@ class HeartbeatGapRule(Rule):
         return None
 
 
+class MfuDriftRule(Rule):
+    """Measured MFU (the ``paddle_tpu_train_mfu`` gauge an AOT-compiled
+    TrainStep sets from XLA-counted executable FLOPs / step time /
+    device peak) dropping below ``factor``× an EMA baseline.  Catches
+    the step getting slower *relative to the work it does* — a
+    regression step_time_drift misses when batch shape changed too, and
+    the direct watch on the number the benchmark trajectory tracks."""
+
+    def __init__(self, metric: str = "paddle_tpu_train_mfu",
+                 factor: float = 0.8, alpha: float = 0.3,
+                 name: str = "mfu_drift"):
+        self.name = name
+        self.metric = metric
+        self.factor = float(factor)
+        self.alpha = float(alpha)
+        self.baseline: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        value = _series_total(m)
+        if value != value or value <= 0:
+            return None            # gauge not armed yet (no AOT compile)
+        if self.baseline is None:
+            self.baseline = value
+            return None
+        if value < self.factor * self.baseline:
+            return (f"measured MFU {value:.4f} < {self.factor:g}x "
+                    f"baseline {self.baseline:.4f} — the step got "
+                    "slower relative to its executable FLOPs")
+        self.baseline = (1 - self.alpha) * self.baseline \
+            + self.alpha * value
+        return None
+
+
+class CompileStormRule(Rule):
+    """More than ``max_delta`` fresh XLA compiles per interval
+    (``paddle_tpu_compile_total`` across all targets) — executables are
+    churning: shape drift is defeating the AOT path, or serving bucket
+    config makes every prompt a novel prefill."""
+
+    def __init__(self, metric: str = "paddle_tpu_compile_total",
+                 max_delta: float = 3, name: str = "compile_storm"):
+        self.name = name
+        self.metric = metric
+        self.max_delta = float(max_delta)
+        self._last: Optional[float] = None
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        value = _series_total(m)
+        last, self._last = self._last, value
+        if last is None:
+            return None
+        delta = value - last
+        if delta > self.max_delta:
+            return (f"{int(delta)} fresh XLA compiles in one interval "
+                    f"(> {self.max_delta:g}) — executables are churning")
+        return None
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
     "queue_saturation": QueueSaturationRule,
     "skip_streak": SkipStreakRule,
     "heartbeat_gap": HeartbeatGapRule,
+    "mfu_drift": MfuDriftRule,
+    "compile_storm": CompileStormRule,
 }
 
 
 def default_rules() -> List[Rule]:
     return [StepTimeDriftRule(), RecompileStormRule(),
-            QueueSaturationRule(), SkipStreakRule(), HeartbeatGapRule()]
+            QueueSaturationRule(), SkipStreakRule(), HeartbeatGapRule(),
+            MfuDriftRule(), CompileStormRule()]
 
 
 def _coerce(v: str):

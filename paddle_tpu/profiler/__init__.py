@@ -200,6 +200,7 @@ class Profiler:
         self._last_step_t = None
         self._diagnostics = []
         self._cost_summaries = []   # (target, CostSummary) pairs
+        self._device_profiles = []  # AttributionResult objects
         # private host-event sink for this session (start() registers it,
         # stop() unregisters + drains) — concurrent profilers each see
         # their own events instead of racing over the module global
@@ -218,6 +219,13 @@ class Profiler:
         if cost is not None:
             self._diagnostics.extend(cost.to_diagnostics())
             self._cost_summaries.append((report.target, cost))
+
+    def add_device_profile(self, result):
+        """Attach a device-profiler ``AttributionResult``
+        (observability.device_profiler): the measured-device-time /
+        roofline-gap attribution table renders in ``summary()`` next to
+        the host-annotation and runtime-metrics sections."""
+        self._device_profiles.append(result)
 
     # device trace control
     def _start_trace(self):
@@ -317,6 +325,9 @@ class Profiler:
         for target, cost in self._cost_summaries:
             lines.append(f"-- static cost model: {target} " + "-" * 20)
             lines.append(cost.table())
+        for result in self._device_profiles:
+            lines.append("-- device time / roofline " + "-" * 34)
+            lines.append(result.table())
         metrics = self._format_metrics()
         if metrics:
             lines.append(metrics)
