@@ -1,0 +1,48 @@
+"""paddle_tpu.analysis.autoshard — GSPMD-style automatic parallelism
+planner.
+
+Given a traced program (any Layer / TrainStep / serving forward) and a
+physical mesh shape, the planner
+
+1. runs a sharding-**propagation** pass over the jaxpr (forward/backward
+   sweeps to a fixed point over dot_general / conv / reshape / transpose /
+   elementwise / scan equations, tracking per-dim Shard/Replicate
+   placements and the implicit all-gather / all-reduce / all-to-all each
+   placement mismatch induces — GSPMD, arxiv 2105.04663 §3);
+2. **enumerates** candidate DP/FSDP/TP/PP/sequence-parallel assignments
+   (mesh-axis factorizations × per-parameter placement templates for
+   attention, MLP, embedding and lm-head weights), pruned by the
+   propagation pass (uneven shards, indivisible batch);
+3. **scores** every candidate with the roofline cost model extended with
+   a collective-cost term (``cost_model.collective_seconds``: ring-
+   algorithm bytes × axis size over the link-bandwidth table) plus a
+   per-device peak-HBM estimate (``distributed.planner.
+   estimate_peak_hbm`` for the top candidates) to reject OOM layouts;
+4. **emits** the winning plan as concrete ``NamedSharding``s through the
+   ``distributed.auto_parallel`` ProcessMesh API — consumable by
+   ``TrainStep(shardings=plan)`` and ``jit.to_static(shardings=plan)``.
+
+    from paddle_tpu.analysis import autoshard
+    result = autoshard.plan(step, batch, n_devices=8)
+    print(result.table())            # ranked: layout, ms, coll GB, HBM
+    step = TrainStep(model, opt, shardings=result.top)
+
+CLI: ``python -m paddle_tpu.analysis.lint <target> --autoshard``.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis.autoshard.propagation import (Collective,
+                                                       Propagator)
+from paddle_tpu.analysis.autoshard.candidates import (MeshCandidate,
+                                                      enumerate_candidates,
+                                                      specs_for_candidate)
+from paddle_tpu.analysis.autoshard.planner import (AutoShardPlan,
+                                                   PlanResult, plan,
+                                                   plan_trace, score_layout)
+
+__all__ = [
+    "Collective", "Propagator",
+    "MeshCandidate", "enumerate_candidates", "specs_for_candidate",
+    "AutoShardPlan", "PlanResult", "plan", "plan_trace", "score_layout",
+]

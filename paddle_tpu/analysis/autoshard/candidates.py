@@ -1,0 +1,174 @@
+"""Candidate DP/FSDP/TP/PP/sequence-parallel layout enumeration.
+
+A candidate is a mesh-axis factorization of the device count onto the
+canonical ``("dp", "fsdp", "tp")`` GSPMD mesh (plus an optional pipeline
+factor scored analytically and a sequence-parallel flag that shards the
+batch's sequence dim over tp), together with the per-parameter placement
+template it induces:
+
+* attention / MLP projections: Megatron column/row parallel on ``tp``
+  with the other weight dim ZeRO-3-sharded on ``fsdp``;
+* embedding: vocab on ``tp``, hidden on ``fsdp``; lm-head column
+  parallel; norms replicated;
+* anything unrecognised: largest dim on ``fsdp`` when it divides.
+
+Template entries whose shard factor does not divide the tensor dim are
+DEGRADED to replicated (never padded) — the scorer then charges the lost
+parallelism honestly instead of the checker flagging pad waste.
+Candidates whose batch cannot divide over (dp × fsdp) are pruned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+__all__ = ["MeshCandidate", "enumerate_candidates", "specs_for_candidate",
+           "AXIS_NAMES"]
+
+AXIS_NAMES = ("dp", "fsdp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshCandidate:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    pp: int = 1                  # >1 → pipeline candidate (analytic score)
+    seq_parallel: bool = False   # shard batch seq dim over tp
+
+    @property
+    def n_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.pp
+
+    def mesh_shape(self) -> Dict[str, int]:
+        """The GSPMD mesh the per-stage program runs on (pp is a stage
+        split, not a GSPMD axis here)."""
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp}
+
+    def batch_spec(self):
+        from jax.sharding import PartitionSpec as P
+        if self.seq_parallel:
+            return P(("dp", "fsdp"), "tp")
+        return P(("dp", "fsdp"))
+
+    @property
+    def label(self) -> str:
+        parts = [f"dp{self.dp}", f"fsdp{self.fsdp}", f"tp{self.tp}"]
+        if self.pp > 1:
+            parts.insert(0, f"pp{self.pp}")
+        s = "x".join(parts)
+        return s + "+sp" if self.seq_parallel else s
+
+
+def _factorizations(n: int):
+    """All ordered (dp, fsdp, tp) with dp*fsdp*tp == n."""
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rem = n // dp
+        for fsdp in range(1, rem + 1):
+            if rem % fsdp:
+                continue
+            yield dp, fsdp, rem // fsdp
+
+
+def enumerate_candidates(n_devices: int, *, max_pp: int = 1,
+                         seq_len: Optional[int] = None):
+    """Yield every candidate for ``n_devices``: all (dp, fsdp, tp)
+    factorizations, their sequence-parallel variants (tp > 1 and the
+    sequence divides), and — when ``max_pp`` > 1 — pipeline splits of
+    each with the remaining devices factorized the same way."""
+    pps = [p for p in range(1, max_pp + 1)
+           if n_devices % p == 0]
+    for pp in pps:
+        inner = n_devices // pp
+        for dp, fsdp, tp in _factorizations(inner):
+            yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp)
+            if tp > 1 and (seq_len is None or seq_len % tp == 0):
+                yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp,
+                                    seq_parallel=True)
+
+
+# -- per-parameter placement template ----------------------------------------
+
+def _llama_rules():
+    """{name pattern → spec builder}: Megatron col/row parallel + ZeRO-3,
+    mirroring ``LlamaForCausalLM.partition_specs`` so the hand-written
+    layout is always inside the search space."""
+    from jax.sharding import PartitionSpec as P
+    col = P("fsdp", "tp")       # [in, out] weight, shard out on tp
+    row = P("tp", "fsdp")       # [in, out] weight, shard in on tp
+    return {
+        "embed_tokens.weight": P("tp", "fsdp"),
+        "lm_head.weight": col,
+        ".q_proj.weight": col,
+        ".k_proj.weight": col,
+        ".v_proj.weight": col,
+        ".o_proj.weight": row,
+        ".gate_proj.weight": col,
+        ".up_proj.weight": col,
+        ".down_proj.weight": row,
+        # Megatron-naming variants (mpu layers, ernie, planner stacks)
+        ".wq": col, ".wk": col, ".wv": col, ".wo": row,
+        ".w1": col, ".w3": col, ".w2": row,
+        "norm.weight": P(),
+        "layernorm.weight": P(),
+    }
+
+
+def _match(name: str, rules: Dict):
+    for pat, spec in rules.items():
+        if name.endswith(pat) or pat in name:
+            return spec
+    return None
+
+
+def _degrade(spec, shape, mesh_shape):
+    """Replace entries whose shard factor does not divide the dim with
+    None; drop trailing entries beyond the tensor rank."""
+    from jax.sharding import PartitionSpec as P
+    entries = list(spec)[:len(shape)]
+    out = []
+    for d, e in enumerate(entries):
+        axes = (e,) if isinstance(e, str) else tuple(e or ())
+        total = 1
+        for a in axes:
+            total *= mesh_shape.get(a, 1)
+        out.append(None if (total > 1 and shape[d] % total) else e)
+    return P(*out)
+
+
+def specs_for_candidate(cand: MeshCandidate,
+                        param_shapes: Dict[str, Tuple[int, ...]],
+                        batch_shape: Optional[Tuple[int, ...]] = None,
+                        rules: Optional[Dict] = None):
+    """(exact-name specs, pruned reason or None) for one candidate.
+
+    ``rules`` overrides the llama-family template (same pattern-dict
+    shape as ``LlamaForCausalLM.partition_specs``)."""
+    from jax.sharding import PartitionSpec as P
+    mesh_shape = cand.mesh_shape()
+    data = cand.dp * cand.fsdp
+    if batch_shape:
+        if batch_shape[0] % max(data, 1):
+            return {}, (f"batch {batch_shape[0]} not divisible by "
+                        f"dp*fsdp={data}")
+        if cand.seq_parallel and len(batch_shape) > 1 and \
+                batch_shape[1] % cand.tp:
+            return {}, (f"seq {batch_shape[1]} not divisible by "
+                        f"tp={cand.tp} (sequence parallel)")
+    table = dict(rules) if rules is not None else _llama_rules()
+    specs = {}
+    for name, shape in param_shapes.items():
+        spec = _match(name, table)
+        if spec is None:
+            if len(shape) >= 2 and cand.fsdp > 1:
+                big = max(range(len(shape)), key=lambda d: shape[d])
+                ent = [None] * len(shape)
+                ent[big] = "fsdp"
+                spec = P(*ent)
+            else:
+                spec = P()
+        specs[name] = _degrade(spec, shape, mesh_shape)
+    return specs, None

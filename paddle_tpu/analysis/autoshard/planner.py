@@ -1,0 +1,511 @@
+"""Autoshard search driver: propagate → enumerate → score → emit.
+
+``plan(step_or_model, batch, n_devices=8)`` traces the target ONCE
+(abstract — no FLOPs run), then for every candidate layout re-runs the
+sharding-propagation engine with the candidate's placements and scores
+
+    predicted_step = max(flops_eff/peak, bytes_eff/hbm_bw)
+                     + Σ collective_seconds(kind, bytes, axis)
+                     [× pipeline bubble + boundary p2p for pp > 1]
+
+where ``flops_eff``/``bytes_eff`` divide every equation's roofline cost
+by the mesh-axis product that parallelises it, and the collective term
+prices the propagation's implicit all-gather/all-reduce/all-to-all set
+over the ``cost_model.LINK_BANDWIDTH`` table.  Candidates whose
+analytic per-device peak HBM exceeds ``hbm_gb`` are rejected; the top
+candidates can be re-checked against XLA's own buffer assignment via
+``distributed.planner.estimate_peak_hbm``.
+
+The winner emits as concrete ``NamedSharding``s through the
+``distributed.auto_parallel.ProcessMesh`` API and round-trips the
+``sharding-consistency`` checker clean (its induced collectives ride
+along as ``expected_collectives``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.passes import PassContext, register_pass
+from paddle_tpu.analysis.autoshard.candidates import (AXIS_NAMES,
+                                                      MeshCandidate,
+                                                      enumerate_candidates,
+                                                      specs_for_candidate)
+from paddle_tpu.analysis.autoshard.propagation import (Propagator,
+                                                       norm_spec,
+                                                       spec_for_name)
+
+__all__ = ["CandidateScore", "AutoShardPlan", "PlanResult", "plan",
+           "plan_trace", "score_layout"]
+
+_RESERVED = ("step_count", "rng_key", "lr")
+
+
+@dataclasses.dataclass
+class CandidateScore:
+    candidate: MeshCandidate
+    compute_s: float = 0.0
+    memory_s: float = 0.0
+    collective_s: float = 0.0
+    collective_bytes: int = 0
+    n_collectives: int = 0
+    peak_hbm_bytes: int = 0              # analytic (resident + working set)
+    refined_hbm_bytes: Optional[int] = None   # XLA buffer assignment
+    pp_overhead_s: float = 0.0
+    pruned: Optional[str] = None
+
+    @property
+    def step_seconds(self) -> float:
+        return (max(self.compute_s, self.memory_s) + self.collective_s
+                + self.pp_overhead_s)
+
+    @property
+    def hbm_bytes(self) -> int:
+        return self.refined_hbm_bytes or self.peak_hbm_bytes
+
+
+@dataclasses.dataclass
+class AutoShardPlan:
+    """One emitted layout: concrete per-parameter PartitionSpecs on the
+    canonical (dp, fsdp, tp) mesh, consumable by
+    ``TrainStep(shardings=plan)`` / ``to_static(shardings=plan)`` or by
+    hand through ``plan.shardings()``."""
+    candidate: MeshCandidate
+    score: CandidateScore
+    param_specs: Dict[str, Any]
+    batch_spec: Any
+    expected_collectives: frozenset      # {(kind, axes tuple)}
+
+    @property
+    def mesh_shape(self) -> Dict[str, int]:
+        return self.candidate.mesh_shape()
+
+    @property
+    def is_pipeline(self) -> bool:
+        return self.candidate.pp > 1
+
+    def process_mesh(self, devices=None):
+        """The plan's mesh through the auto_parallel annotation API."""
+        from paddle_tpu.distributed.auto_parallel import ProcessMesh
+        if self.is_pipeline:
+            raise NotImplementedError(
+                "pp>1 plans target distributed.PipelineTrainStep; the "
+                "GSPMD ProcessMesh covers the per-stage (dp, fsdp, tp)")
+        shape = tuple(self.mesh_shape[a] for a in AXIS_NAMES)
+        n = int(np.prod(shape))
+        return ProcessMesh(np.arange(n).reshape(shape), list(AXIS_NAMES),
+                           _devices=list(devices)[:n] if devices else None)
+
+    def jax_mesh(self, devices=None):
+        return self.process_mesh(devices=devices).jax_mesh
+
+    def shardings(self, devices=None) -> Dict[str, Any]:
+        """{param name → NamedSharding} on the plan's mesh."""
+        from jax.sharding import NamedSharding
+        mesh = self.jax_mesh(devices=devices)
+        return {n: NamedSharding(mesh, s)
+                for n, s in self.param_specs.items()}
+
+    def shard_params(self, params, devices=None):
+        """device_put a {name → array} pytree under the plan (the
+        ``shard_tensor`` path of the annotation API)."""
+        import jax
+        sh = self.shardings(devices=devices)
+        return {n: jax.device_put(a, sh[n]) if n in sh else a
+                for n, a in params.items()}
+
+    def verify(self, target, *example_args, devices=None):
+        """Round-trip the emitted layout through the sharding-
+        consistency checker; returns the AnalysisReport.  Clean means
+        no ERROR and no WARNING findings (the plan's own collectives
+        are expected and demoted to INFO)."""
+        import paddle_tpu.analysis as analysis
+        return analysis.check(
+            target, *example_args, passes=["sharding-consistency"],
+            param_specs=dict(self.param_specs),
+            mesh=self.jax_mesh(devices=devices),
+            options={"expected_collectives": self.expected_collectives})
+
+    def summary(self) -> str:
+        s = self.score
+        return (f"{self.candidate.label}: predicted "
+                f"{s.step_seconds * 1e3:.3f} ms/step "
+                f"(compute {s.compute_s * 1e3:.3f}, memory "
+                f"{s.memory_s * 1e3:.3f}, collectives "
+                f"{s.collective_s * 1e3:.3f} over "
+                f"{s.collective_bytes / 1e6:.1f} MB), peak HBM "
+                f"{s.hbm_bytes / (1 << 20):.1f} MiB")
+
+
+@dataclasses.dataclass
+class PlanResult:
+    plans: List[AutoShardPlan]           # ranked, best first
+    scored: List[CandidateScore]         # every candidate, pruned included
+    n_devices: int
+    manual: Optional[CandidateScore] = None
+
+    @property
+    def top(self) -> AutoShardPlan:
+        if not self.plans:
+            raise RuntimeError("autoshard: no viable candidate survived "
+                               "pruning")
+        return self.plans[0]
+
+    def beats_manual(self) -> Optional[bool]:
+        if self.manual is None or not self.plans:
+            return None
+        return self.top.score.step_seconds <= self.manual.step_seconds
+
+    def table(self, top: Optional[int] = None) -> str:
+        rows = [f"{'rank':>4s} {'layout':22s} {'pred ms':>9s} "
+                f"{'compute':>8s} {'memory':>8s} {'coll ms':>8s} "
+                f"{'coll MB':>8s} {'HBM MiB':>8s}  note"]
+        live = [s for s in self.scored if s.pruned is None]
+        live.sort(key=lambda s: s.step_seconds)
+        for i, s in enumerate(live[:top] if top else live):
+            rows.append(
+                f"{i + 1:4d} {s.candidate.label:22s} "
+                f"{s.step_seconds * 1e3:9.3f} {s.compute_s * 1e3:8.3f} "
+                f"{s.memory_s * 1e3:8.3f} {s.collective_s * 1e3:8.3f} "
+                f"{s.collective_bytes / 1e6:8.1f} "
+                f"{s.hbm_bytes / (1 << 20):8.1f}  "
+                f"{'<- emit' if i == 0 else ''}")
+        for s in self.scored:
+            if s.pruned is not None:
+                rows.append(f"   - {s.candidate.label:22s} "
+                            f"{'pruned':>9s}  {s.pruned}")
+        if self.manual is not None:
+            rows.append(
+                f"   * {'manual layout':22s} "
+                f"{self.manual.step_seconds * 1e3:9.3f} "
+                f"{self.manual.compute_s * 1e3:8.3f} "
+                f"{self.manual.memory_s * 1e3:8.3f} "
+                f"{self.manual.collective_s * 1e3:8.3f} "
+                f"{self.manual.collective_bytes / 1e6:8.1f} "
+                f"{self.manual.hbm_bytes / (1 << 20):8.1f}  "
+                f"{'beaten' if self.beats_manual() else 'NOT beaten'}")
+        return "\n".join(rows)
+
+
+# -- scoring ------------------------------------------------------------------
+
+def _param_shapes(tr) -> Dict[str, Tuple[int, ...]]:
+    """Invar-name → shape for the trace's parameter leaves (everything
+    that is not opt state, batch, positional arg or step plumbing)."""
+    out = {}
+    for name, var in zip(tr.invar_names, tr.jaxpr.invars):
+        if name.startswith(("opt_state.", "batch.", "arg")) or \
+                name in _RESERVED:
+            continue
+        out[name] = tuple(getattr(var.aval, "shape", ()))
+    return out
+
+
+def _placements_for(tr, specs: Dict, batch_spec) -> List[Optional[Tuple]]:
+    """Per-invar normalized placements: exact param names first, pattern
+    fallback (manual rule dicts), batch/arg leaves from batch_spec,
+    opt-state leaves inherit their param's spec when shapes match."""
+    placements: List[Optional[Tuple]] = []
+    param_shape: Dict[str, Tuple] = {}
+    for name, var in zip(tr.invar_names, tr.jaxpr.invars):
+        shape = tuple(getattr(var.aval, "shape", ()))
+        spec = None
+        if name in _RESERVED:
+            spec = None
+        elif name in specs:             # exact names win (plain-fn args
+            spec = specs[name]          # can be params too)
+            param_shape[name] = shape
+        elif name.startswith("batch.") or name.startswith("arg"):
+            spec = batch_spec if len(shape) else None
+        elif name.startswith("opt_state."):
+            pname = name[len("opt_state."):].rsplit(".", 1)[0]
+            if shape and shape == param_shape.get(pname):
+                spec = specs.get(pname) or spec_for_name(pname, specs)
+        else:
+            param_shape[name] = shape
+            spec = specs.get(name)
+            if spec is None:
+                spec = spec_for_name(name, specs)
+            if spec is not None and len(list(spec)) > len(shape) and \
+                    name not in specs:
+                spec = None          # pattern hit a lower-rank leaf
+        placements.append(norm_spec(spec, len(shape))
+                          if spec is not None else None)
+    return placements
+
+
+def _options(options):
+    from paddle_tpu.analysis.passes.cost_model import (DEFAULT_HBM_BW,
+                                                       DEFAULT_LINK_BW,
+                                                       DEFAULT_PEAK_FLOPS)
+    o = dict(options or {})
+    return (float(o.get("peak_flops", DEFAULT_PEAK_FLOPS)),
+            float(o.get("hbm_bw", DEFAULT_HBM_BW)),
+            float(o.get("link_bw", DEFAULT_LINK_BW)))
+
+
+def score_layout(tr, specs: Dict, mesh_shape: Dict[str, int],
+                 batch_spec=None, *, options: Optional[Dict] = None,
+                 candidate: Optional[MeshCandidate] = None):
+    """Score ONE layout on the traced program.  Returns
+    ``(CandidateScore, collectives)`` — reusable for the manual-layout
+    baseline and the autoshard pass's current-layout report."""
+    peak_flops, hbm_bw, link_bw = _options(options)
+    placements = _placements_for(tr, specs, batch_spec)
+    prop = Propagator(mesh_shape, track_cost=True)
+    prop.run(tr.jaxpr, placements)
+    coll_s = sum(c.seconds(mesh_shape, link_bw) for c in prop.collectives)
+    coll_b = sum(c.total_bytes for c in prop.collectives)
+    resident = 0
+    for pl, var in zip(placements, tr.jaxpr.invars):
+        aval = var.aval
+        try:
+            nb = int(np.prod(aval.shape)) * aval.dtype.itemsize
+        except Exception:
+            continue
+        factor = 1
+        for e in (pl or ()):
+            for a in (e or ()):
+                factor *= mesh_shape.get(a, 1)
+        resident += nb // max(factor, 1)
+    # analytic working set: a few live copies of the largest per-device
+    # eqn output (fwd activation + its cotangent + XLA slack)
+    peak_hbm = int(resident + 4 * prop.peak_eqn_bytes)
+    sc = CandidateScore(
+        candidate=candidate or MeshCandidate(),
+        compute_s=prop.eff_flops / peak_flops if peak_flops else 0.0,
+        memory_s=prop.eff_bytes / hbm_bw if hbm_bw else 0.0,
+        collective_s=coll_s, collective_bytes=int(coll_b),
+        n_collectives=len(prop.collectives), peak_hbm_bytes=peak_hbm)
+    return sc, prop.collectives
+
+
+def _d_model(param_shapes: Dict[str, Tuple[int, ...]]) -> int:
+    """Hidden size guess for pipeline boundary bytes: the most common
+    1-D parameter length (norm weights)."""
+    from collections import Counter
+    ones = [s[0] for s in param_shapes.values() if len(s) == 1 and s[0] > 1]
+    if ones:
+        return Counter(ones).most_common(1)[0][0]
+    return 0
+
+
+def _apply_pp(sc: CandidateScore, cand: MeshCandidate, batch_shape,
+              d_model: int, link_bw: float):
+    """Analytic pipeline scaling: stages split layers pp-ways (compute,
+    memory and per-stage collectives all divide), the 1F1B bubble
+    stretches the step by (M + pp - 1)/M, and each microbatch boundary
+    crosses a link twice (fwd activation + bwd cotangent)."""
+    pp = cand.pp
+    M = 2 * pp
+    bubble = (M + pp - 1) / M
+    sc.compute_s /= pp
+    sc.memory_s /= pp
+    sc.collective_s /= pp
+    sc.collective_bytes = int(sc.collective_bytes / pp)
+    sc.peak_hbm_bytes = int(sc.peak_hbm_bytes / pp)
+    base = max(sc.compute_s, sc.memory_s) + sc.collective_s
+    p2p_s = 0.0
+    if batch_shape and d_model and link_bw:
+        tokens = int(np.prod(batch_shape[:2])) // max(
+            cand.dp * cand.fsdp, 1)
+        boundary = tokens * d_model * 4            # fp32 wire bytes
+        p2p_s = 2.0 * (pp - 1) * boundary / link_bw
+    sc.pp_overhead_s = base * (bubble - 1.0) + p2p_s
+    return sc
+
+
+# -- search driver ------------------------------------------------------------
+
+def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
+               hbm_gb: Optional[float] = None,
+               manual_specs: Optional[Dict] = None,
+               manual_batch_spec=None, manual_mesh_shape=None,
+               rules: Optional[Dict] = None,
+               options: Optional[Dict] = None) -> PlanResult:
+    """Search layouts for an existing ``TraceResult``."""
+    _, _, link_bw = _options(options)
+    param_shapes = _param_shapes(tr)
+    batch_shape = None
+    for name, var in zip(tr.invar_names, tr.jaxpr.invars):
+        if name.startswith(("batch.", "arg")):
+            shape = tuple(getattr(var.aval, "shape", ()))
+            if shape:
+                batch_shape = shape
+                break
+    seq_len = batch_shape[1] if batch_shape and len(batch_shape) > 1 \
+        else None
+    dm = _d_model(param_shapes)
+
+    scored: List[CandidateScore] = []
+    colls_of: Dict[MeshCandidate, tuple] = {}
+    for cand in enumerate_candidates(n_devices, max_pp=max_pp,
+                                     seq_len=seq_len):
+        specs, prune = specs_for_candidate(cand, param_shapes,
+                                           batch_shape=batch_shape,
+                                           rules=rules)
+        if prune is not None:
+            scored.append(CandidateScore(candidate=cand, pruned=prune))
+            continue
+        sc, colls = score_layout(tr, specs, cand.mesh_shape(),
+                                 cand.batch_spec(), options=options,
+                                 candidate=cand)
+        if cand.pp > 1:
+            _apply_pp(sc, cand, batch_shape, dm, link_bw)
+        if hbm_gb is not None and sc.peak_hbm_bytes > hbm_gb * (1 << 30):
+            sc.pruned = (f"analytic peak HBM "
+                         f"{sc.peak_hbm_bytes / (1 << 30):.2f} GiB > "
+                         f"{hbm_gb} GiB")
+        scored.append(sc)
+        colls_of[cand] = (specs, colls)
+
+    live = sorted((s for s in scored if s.pruned is None),
+                  key=lambda s: s.step_seconds)
+    plans = []
+    for sc in live[:topk]:
+        specs, colls = colls_of[sc.candidate]
+        expected = frozenset((c.kind, tuple(c.axes)) for c in colls)
+        plans.append(AutoShardPlan(
+            candidate=sc.candidate, score=sc, param_specs=specs,
+            batch_spec=sc.candidate.batch_spec(),
+            expected_collectives=expected))
+
+    manual = None
+    if manual_specs:
+        mesh_shape = dict(manual_mesh_shape or {}) or \
+            dict(getattr(tr.mesh, "shape", {}) or {})
+        if not mesh_shape:
+            # the harness's hand-pick heuristic: favor tp, then fsdp
+            mesh_shape = _manual_mesh_shape(n_devices)
+        manual, _ = score_layout(
+            tr, manual_specs, mesh_shape,
+            manual_batch_spec
+            if manual_batch_spec is not None else _default_batch_spec(),
+            options=options)
+    return PlanResult(plans=plans, scored=scored, n_devices=n_devices,
+                      manual=manual)
+
+
+def _default_batch_spec():
+    from jax.sharding import PartitionSpec as P
+    return P(("dp", "fsdp"))
+
+
+def _manual_mesh_shape(n: int) -> Dict[str, int]:
+    """The hand-written harness factorization (__graft_entry__._factor):
+    tp=2 when even, fsdp=2 when the remainder is even, dp takes the
+    rest — what a human picked before the planner existed."""
+    tp = 2 if n % 2 == 0 else 1
+    rem = n // tp
+    fsdp = 2 if rem % 2 == 0 else 1
+    return {"dp": rem // fsdp, "fsdp": fsdp, "tp": tp}
+
+
+def plan(target, *example_args, n_devices: Optional[int] = None,
+         max_pp: int = 1, topk: int = 5, hbm_gb: Optional[float] = None,
+         refine_top: int = 0, manual_specs: Optional[Dict] = None,
+         manual_batch_spec=None, manual_mesh_shape=None,
+         rules: Optional[Dict] = None,
+         options: Optional[Dict] = None, method: Optional[str] = None,
+         devices=None) -> PlanResult:
+    """Trace ``target`` (TrainStep with one example batch, Layer with
+    example inputs, or plain fn) and search layouts for ``n_devices``.
+
+    ``refine_top``: re-check the analytic peak-HBM of the N best plans
+    against XLA's buffer assignment (``distributed.planner.
+    estimate_peak_hbm``) — needs a TrainStep target and enough local
+    (virtual) devices to build the real mesh.
+    """
+    import paddle_tpu.analysis as analysis
+    if n_devices is None:
+        import jax
+        n_devices = len(jax.devices())
+    tr = analysis.trace(target, *example_args, method=method)
+    result = plan_trace(tr, n_devices, max_pp=max_pp, topk=topk,
+                        hbm_gb=hbm_gb, manual_specs=manual_specs,
+                        manual_batch_spec=manual_batch_spec,
+                        manual_mesh_shape=manual_mesh_shape, rules=rules,
+                        options=options)
+    if refine_top:
+        _refine_hbm(result, target, example_args, refine_top, hbm_gb,
+                    devices=devices)
+    return result
+
+
+def _refine_hbm(result: PlanResult, target, example_args, refine_top: int,
+                hbm_gb: Optional[float], devices=None):
+    """Replace the analytic HBM figure of the top plans with XLA's own
+    buffer assignment; drop plans that exceed the budget for real."""
+    from paddle_tpu.jit.train_step import CompiledStepBase
+    if not isinstance(target, CompiledStepBase) or not example_args:
+        return
+    from paddle_tpu.distributed.planner import estimate_peak_hbm
+
+    kept = []
+    for p in result.plans:
+        if len(kept) >= refine_top or p.is_pipeline:
+            kept.append(p)
+            continue
+        try:
+            mesh = p.jax_mesh(devices=devices)
+        except Exception:
+            kept.append(p)
+            continue
+        try:
+            bytes_ = estimate_peak_hbm(
+                target, p.param_specs, mesh, example_args[0],
+                batch_spec=p.batch_spec)
+        except Exception:           # lowering failed — keep analytic
+            kept.append(p)
+            continue
+        p.score.refined_hbm_bytes = int(bytes_)
+        if hbm_gb is not None and bytes_ > hbm_gb * (1 << 30):
+            p.score.pruned = (f"XLA peak {bytes_ / (1 << 30):.2f} GiB > "
+                              f"{hbm_gb} GiB")
+        else:
+            kept.append(p)
+    result.plans = kept
+
+
+# -- registered pass ----------------------------------------------------------
+
+@register_pass("autoshard")
+def autoshard_pass(ctx: PassContext):
+    """Score the CURRENT layout (the trace's own specs + mesh) with the
+    collective-aware cost model and report the induced resharding set;
+    with ``options={'autoshard_search': N}`` also search N-device
+    layouts and report whether a better one exists.  INFO-only: the
+    planner advises, the checker enforces."""
+    tr = ctx.trace
+    diags: List[Diagnostic] = []
+    specs = tr.param_specs or {}
+    mesh_shape = dict(getattr(tr.mesh, "shape", {}) or {})
+    if specs and mesh_shape:
+        sc, colls = score_layout(tr, specs, mesh_shape,
+                                 options=ctx.options)
+        ctx.extras["autoshard_current"] = sc
+        diags.append(Diagnostic(
+            "autoshard", Severity.INFO,
+            f"current layout: predicted {sc.step_seconds * 1e3:.3f} "
+            f"ms/step ({sc.n_collectives} implicit collectives moving "
+            f"{sc.collective_bytes / 1e6:.1f} MB)"))
+    n = ctx.opt("autoshard_search")
+    if n:
+        result = plan_trace(tr, int(n), options=ctx.options)
+        ctx.extras["autoshard_plans"] = result
+        if result.plans:
+            top = result.top
+            msg = (f"best {int(n)}-device layout: {top.candidate.label} "
+                   f"predicted {top.score.step_seconds * 1e3:.3f} ms/step")
+            cur = ctx.extras.get("autoshard_current")
+            if cur is not None and \
+                    cur.step_seconds > 1.25 * top.score.step_seconds:
+                msg += (f" — current layout is "
+                        f"{cur.step_seconds / top.score.step_seconds:.2f}x"
+                        f" slower; consider the plan")
+            diags.append(Diagnostic("autoshard", Severity.INFO, msg))
+    return diags

@@ -1,0 +1,834 @@
+"""GSPMD-style sharding propagation over a jaxpr.
+
+The dataflow engine behind both the ``sharding-consistency`` checker pass
+and the autoshard planner: placements (per tensor dim, a tuple of mesh
+axis names or ``None``) flow forward through every equation; a backward
+sweep then fills placements the forward rules could not reach (inverse
+transpose/reshape/elementwise); a final forward sweep records the
+diagnostics and the **implicit collectives** — every placement mismatch
+GSPMD would silently "fix" becomes an explicit ``Collective`` record
+(kind, payload bytes, mesh axes) that the planner's scorer converts to
+seconds via ``cost_model.collective_seconds``.
+
+Covered equations: dot_general (contraction match/mismatch → all-reduce /
+all-gather), conv, transpose, reshape (split/merge factor matching),
+broadcast, squeeze/expand, concatenate, slice, reductions (sharded
+reduced dim → all-reduce), elementwise/binary merge, sharding_constraint
+(drop → all-gather, change → all-to-all), explicit psum, and the
+containers: scan/while (carry placements iterated to a fixed point),
+cond (branch join), pjit/remat/custom_jvp/custom_vjp (recursed), and
+pallas_call (shape-matched pass-through — a hand-written kernel neither
+hides its operands' placements nor invents new ones).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
+from paddle_tpu.analysis.tracing import where_of
+
+__all__ = ["Collective", "Propagator", "norm_spec", "spec_for_name"]
+
+_ELEMENTWISE_HINT = ("integer_pow", "neg", "exp", "log", "tanh", "rsqrt",
+                     "sqrt", "logistic", "sin", "cos", "abs", "sign",
+                     "floor", "ceil", "round", "erf", "not", "is_finite",
+                     "stop_gradient", "convert_element_type", "copy",
+                     "reduce_precision", "real", "imag", "square")
+_BINARY = ("add", "sub", "mul", "div", "max", "min", "pow", "rem",
+           "atan2", "and", "or", "xor", "shift_left",
+           "shift_right_logical", "shift_right_arithmetic", "nextafter",
+           "eq", "ne", "lt", "le", "gt", "ge", "select_n")
+_REDUCE = {"reduce_sum", "reduce_prod", "reduce_max", "reduce_min",
+           "reduce_and", "reduce_or", "argmax", "argmin"}
+
+
+def norm_spec(spec, ndim: int) -> Tuple:
+    """PartitionSpec → per-dim tuple of axis-name tuples (or None),
+    padded to the tensor's rank."""
+    entries = list(spec) if spec is not None else []
+    out = []
+    for e in entries[:ndim]:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append(tuple(e) if e else None)
+        else:
+            out.append((e,))
+    out += [None] * (ndim - len(out))
+    return tuple(out)
+
+
+def spec_for_name(name: str, specs: Dict):
+    if name in specs:
+        return specs[name]
+    for pat, spec in specs.items():
+        if name.endswith(pat) or pat in name:
+            return spec
+    return None
+
+
+@dataclasses.dataclass
+class Collective:
+    """One implicit collective the propagated layout induces.
+
+    ``bytes`` is the logical payload moved by ONE occurrence (already
+    divided by the shard factor of the axes NOT being communicated);
+    ``count`` multiplies through enclosing scans."""
+    kind: str                       # all_gather|all_reduce|all_to_all|...
+    bytes: int
+    axes: Tuple[str, ...]
+    where: str = ""
+    count: int = 1
+
+    def axis_size(self, mesh_shape: Dict[str, int]) -> int:
+        k = 1
+        for a in self.axes:
+            k *= int(mesh_shape.get(a, 1))
+        return k
+
+    def seconds(self, mesh_shape: Dict[str, int],
+                bandwidth: Optional[float] = None) -> float:
+        from paddle_tpu.analysis.passes.cost_model import collective_seconds
+        return collective_seconds(self.kind, self.bytes,
+                                  self.axis_size(mesh_shape),
+                                  bandwidth=bandwidth) * self.count
+
+    @property
+    def total_bytes(self) -> int:
+        return self.bytes * self.count
+
+
+def _nbytes(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) * aval.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _axes_of(dims) -> Tuple[str, ...]:
+    out: List[str] = []
+    for e in dims or ():
+        if e:
+            out.extend(e)
+    return tuple(out)
+
+
+class Propagator:
+    """Propagate placements over a jaxpr; collect diagnostics and the
+    induced implicit collectives.
+
+    ``mesh_shape``: {axis name: size} (a jax ``Mesh.shape`` mapping or a
+    plain dict — the planner's abstract candidate meshes have no
+    devices).  ``diags``: sink list for checker diagnostics (None → the
+    engine stays silent, planner mode).  ``expected``: iterable of
+    ``(kind, axes)`` pairs — collectives a plan deliberately induces;
+    matching WARNING diagnostics are demoted to INFO so a planner-emitted
+    layout round-trips the checker clean while staying auditable.
+    ``track_cost``: accumulate per-device effective FLOPs/bytes (each
+    eqn's cost divided by the product of mesh-axis sizes that parallelise
+    it) for the scorer."""
+
+    _MAX_FIXED_POINT = 4
+
+    def __init__(self, mesh_shape: Optional[Dict[str, int]] = None, *,
+                 diags: Optional[List[Diagnostic]] = None,
+                 expected=None, track_cost: bool = False):
+        self.mesh = {str(k): int(v) for k, v in (mesh_shape or {}).items()}
+        self.diags = diags
+        self.expected = {(k, frozenset(a)) for k, a in (expected or ())}
+        self.collectives: List[Collective] = []
+        self.track_cost = bool(track_cost)
+        self.eff_flops = 0.0
+        self.eff_bytes = 0.0
+        self.peak_eqn_bytes = 0.0   # largest single-eqn per-device bytes
+
+    # -- public entry ---------------------------------------------------------
+
+    def _clean(self, dims):
+        """Drop axes the mesh KNOWS have size 1 — a "collective" over a
+        one-device axis is a no-op, and keeping the axis in the dataflow
+        manufactures phantom mismatches (false positives on planner-
+        degraded layouts).  Unknown axes are kept (no mesh → the old
+        purely-symbolic behavior)."""
+        if dims is None:
+            return None
+        out = []
+        for e in dims:
+            if e:
+                kept = tuple(a for a in e if self.mesh.get(a, 2) > 1)
+                out.append(kept or None)
+            else:
+                out.append(None)
+        return tuple(out)
+
+    def run(self, jaxpr, in_placements: Sequence[Optional[Tuple]],
+            weight: int = 1) -> List[Optional[Tuple]]:
+        """Propagate over ``jaxpr`` (a raw Jaxpr or ClosedJaxpr) from the
+        given invar placements; returns outvar placements.  One silent
+        forward sweep, one backward refinement sweep, then a recording
+        forward sweep (diagnostics + collectives + cost)."""
+        if hasattr(jaxpr, "jaxpr"):
+            jaxpr = jaxpr.jaxpr
+        env: Dict[int, Tuple] = {}
+        for v, pl in zip(jaxpr.invars, in_placements):
+            if pl is not None:
+                env[id(v)] = self._clean(
+                    norm_spec(pl, len(getattr(v.aval, "shape", ()))))
+        self._forward(jaxpr, env, weight, record=False)
+        self._backward(jaxpr, env)
+        self._forward(jaxpr, env, weight, record=True)
+        return [env.get(id(v)) for v in jaxpr.outvars]
+
+    # -- recording ------------------------------------------------------------
+
+    def _factor(self, axes) -> int:
+        f = 1
+        for a in set(axes):
+            f *= self.mesh.get(a, 1)
+        return max(f, 1)
+
+    def _sharded_nbytes(self, aval, dims, comm_axes) -> int:
+        """Payload of a collective over ``comm_axes``: the tensor's bytes
+        per shard of every OTHER axis it is sharded on."""
+        other = [a for a in _axes_of(dims) if a not in comm_axes]
+        return _nbytes(aval) // self._factor(other)
+
+    def _collect(self, kind, nbytes, axes, where, weight):
+        if nbytes <= 0 or not axes or self._factor(axes) <= 1:
+            return
+        self.collectives.append(Collective(kind, int(nbytes), tuple(axes),
+                                           where, weight))
+
+    def _is_expected(self, kind, axes) -> bool:
+        return (kind, frozenset(axes)) in self.expected
+
+    def _diag(self, severity, message, where, hint=None, *,
+              collective=None):
+        if self.diags is None:
+            return
+        if collective is not None and severity == Severity.WARNING and \
+                self._is_expected(*collective):
+            severity = Severity.INFO
+            message += " [expected by the autoshard plan]"
+        self.diags.append(Diagnostic("sharding-consistency", severity,
+                                     message, where, hint=hint))
+
+    def _charge(self, eqn, weight, cost_axes):
+        if not self.track_cost:
+            return
+        from paddle_tpu.analysis.passes.cost_model import (_eqn_bytes,
+                                                           _eqn_flops,
+                                                           _pallas_flops)
+        if eqn.primitive.name == "pallas_call":
+            fl, by = _pallas_flops(eqn), _eqn_bytes(eqn)
+        else:
+            fl, by = _eqn_flops(eqn), _eqn_bytes(eqn)
+        f = self._factor(cost_axes)
+        self.eff_flops += fl * weight / f
+        self.eff_bytes += by * weight / f
+        if by / f > self.peak_eqn_bytes:
+            self.peak_eqn_bytes = by / f
+
+    # -- sweeps ---------------------------------------------------------------
+
+    def _forward(self, jaxpr, env, weight, record):
+        for eqn in jaxpr.eqns:
+            self._eqn(eqn, env, weight, record)
+
+    def _pl(self, env, v):
+        if hasattr(v, "val"):          # literal
+            return None
+        return env.get(id(v))
+
+    def _set(self, env, v, dims):
+        if v is not None and dims is not None and \
+                not type(v).__name__ == "DropVar":
+            env[id(v)] = tuple(dims)
+
+    def _eqn(self, eqn, env, weight, record):
+        prim = eqn.primitive.name
+        where = where_of(eqn)
+        in_pl = [self._pl(env, v) for v in eqn.invars]
+        in_shapes = [tuple(getattr(v.aval, "shape", ()))
+                     for v in eqn.invars]
+        out = eqn.outvars[0] if eqn.outvars else None
+        handler = self._HANDLERS.get(prim)
+        if handler is not None:
+            cost_axes = handler(self, eqn, env, in_pl, in_shapes, where,
+                                weight, record)
+        elif self._container(eqn, env, in_pl, weight, record):
+            return                      # children charge their own cost
+        else:
+            cost_axes = self._default(eqn, env, in_pl, in_shapes, where,
+                                      record)
+        if record:
+            out_pl = self._pl(env, out) if out is not None else None
+            axes = set(_axes_of(out_pl))
+            if cost_axes:
+                axes |= set(cost_axes)
+            self._charge(eqn, weight, axes)
+
+    # -- leaf handlers (each returns extra cost axes or None) ----------------
+
+    def _dot_general(self, eqn, env, in_pl, in_shapes, where, weight,
+                     record):
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        ls, rs = in_pl[0], in_pl[1]
+        out = eqn.outvars[0]
+        matched_axes: List[str] = []
+        for ld, rd in zip(lc, rc):
+            le = ls[ld] if ls else None
+            re_ = rs[rd] if rs else None
+            if le == re_:
+                if le:                  # matched sharded contraction →
+                    matched_axes.extend(le)   # partial sums + all-reduce
+                continue
+            gathered = "lhs" if (le and not re_) else \
+                "rhs" if (re_ and not le) else "one operand"
+            g_idx = 0 if (le and not re_) else 1 if (re_ and not le) else 0
+            g_axes = le or re_ or ()
+            if record:
+                self._collect(
+                    "all_gather",
+                    self._sharded_nbytes(eqn.invars[g_idx].aval,
+                                         in_pl[g_idx], g_axes),
+                    g_axes, where, weight)
+                self._diag(
+                    Severity.WARNING,
+                    f"contracting dim of dot_general sharded "
+                    f"{le or '(replicated)'} on lhs vs "
+                    f"{re_ or '(replicated)'} on rhs — GSPMD "
+                    f"all-gathers {gathered} before the matmul", where,
+                    hint="shard both contraction dims on the same "
+                         "axis (partial-sums + one psum) or neither",
+                    collective=("all_gather", g_axes))
+        if ls or rs:
+            lfree = [d for d in range(len(in_shapes[0]))
+                     if d not in lc and d not in lb]
+            rfree = [d for d in range(len(in_shapes[1]))
+                     if d not in rc and d not in rb]
+            o = [(ls[d] if ls else None) for d in lb]
+            o += [(ls[d] if ls else None) for d in lfree]
+            o += [(rs[d] if rs else None) for d in rfree]
+            self._set(env, out, o)
+            if matched_axes and record:
+                self._collect(
+                    "all_reduce",
+                    self._sharded_nbytes(out.aval, tuple(o), matched_axes),
+                    tuple(matched_axes), where, weight)
+        return matched_axes or None
+
+    def _conv(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        # conv_general_dilated: batch/feature dims propagate; a sharded
+        # contracted (input-feature) dim mismatching the kernel's is an
+        # all-gather, spatial sharding is halo territory — treated as a
+        # gather of the kernel side for costing
+        dn = eqn.params["dimension_numbers"]
+        ls, rs = in_pl[0], in_pl[1]
+        out = eqn.outvars[0]
+        lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+        o = [None] * len(out_spec)
+        if ls:
+            o[out_spec[0]] = ls[lhs_spec[0]]          # batch dim
+        if rs:
+            o[out_spec[1]] = rs[rhs_spec[0]]          # out-feature dim
+        matched: List[str] = []
+        le = ls[lhs_spec[1]] if ls else None          # in-feature dims
+        re_ = rs[rhs_spec[1]] if rs else None
+        if le == re_ and le:
+            matched.extend(le)
+            if record:
+                self._collect("all_reduce",
+                              self._sharded_nbytes(out.aval, tuple(o),
+                                                   matched),
+                              tuple(matched), where, weight)
+        elif le != re_ and record:
+            g_axes = le or re_ or ()
+            g_idx = 0 if le else 1
+            self._collect("all_gather",
+                          self._sharded_nbytes(eqn.invars[g_idx].aval,
+                                               in_pl[g_idx], g_axes),
+                          g_axes, where, weight)
+        if ls or rs:
+            self._set(env, out, o)
+        return matched or None
+
+    def _sharding_constraint(self, eqn, env, in_pl, in_shapes, where,
+                             weight, record):
+        target = eqn.params.get("sharding")
+        tspec = getattr(target, "spec", None)
+        ndim = len(in_shapes[0])
+        norm_t = self._clean(norm_spec(tspec, ndim)) \
+            if tspec is not None else None
+        incoming = in_pl[0]
+        out = eqn.outvars[0]
+        if norm_t is not None and incoming is not None and record:
+            for d, (i_e, t_e) in enumerate(zip(incoming, norm_t)):
+                if i_e and not t_e:
+                    self._collect(
+                        "all_gather",
+                        self._sharded_nbytes(eqn.invars[0].aval, incoming,
+                                             i_e),
+                        i_e, where, weight)
+                    self._diag(
+                        Severity.INFO,
+                        f"sharding_constraint drops axis {i_e} on "
+                        f"dim {d} — an all-gather materializes the "
+                        f"replicated value here", where,
+                        hint="intended for gather_output-style "
+                             "layers; remove the constraint to keep "
+                             "the value sharded")
+                elif i_e and t_e and i_e != t_e:
+                    self._collect(
+                        "all_to_all",
+                        self._sharded_nbytes(eqn.invars[0].aval, incoming,
+                                             tuple(i_e) + tuple(t_e)),
+                        tuple(set(i_e) | set(t_e)), where, weight)
+                    self._diag(
+                        Severity.WARNING,
+                        f"sharding_constraint reshards dim {d} "
+                        f"from {i_e} to {t_e} (all-to-all)", where,
+                        collective=("all_to_all",
+                                    tuple(set(i_e) | set(t_e))))
+        if norm_t is not None:
+            self._set(env, out, norm_t)
+        return None
+
+    def _transpose(self, eqn, env, in_pl, in_shapes, where, weight,
+                   record):
+        if in_pl[0] is not None:
+            perm = eqn.params["permutation"]
+            self._set(env, eqn.outvars[0],
+                      tuple(in_pl[0][p] for p in perm))
+        return None
+
+    def _broadcast(self, eqn, env, in_pl, in_shapes, where, weight,
+                   record):
+        if in_pl[0] is not None:
+            bcast = eqn.params["broadcast_dimensions"]
+            o = [None] * len(eqn.params["shape"])
+            for src, dst in enumerate(bcast):
+                if src < len(in_pl[0]) and \
+                        in_shapes[0][src] == eqn.params["shape"][dst]:
+                    o[dst] = in_pl[0][src]
+            self._set(env, eqn.outvars[0], o)
+        return None
+
+    def _reshape(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        if in_pl[0] is None:
+            return None
+        out = eqn.outvars[0]
+        o = _map_reshape(in_pl[0], in_shapes[0],
+                         tuple(out.aval.shape), self.mesh)
+        if o is not None:
+            self._set(env, out, o)
+        return None
+
+    def _squeeze(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        if in_pl[0] is None:
+            return None
+        drop = set(eqn.params["dimensions"])
+        self._set(env, eqn.outvars[0],
+                  tuple(e for d, e in enumerate(in_pl[0])
+                        if d not in drop))
+        return None
+
+    def _expand(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        if in_pl[0] is None:
+            return None
+        dims = set(eqn.params["dimensions"])
+        ndim = len(eqn.outvars[0].aval.shape)
+        src = iter(in_pl[0])
+        self._set(env, eqn.outvars[0],
+                  tuple(None if d in dims else next(src, None)
+                        for d in range(ndim)))
+        return None
+
+    def _concat(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        known = [(p, s) for p, s in zip(in_pl, in_shapes) if p is not None]
+        if not known:
+            return None
+        d_cat = eqn.params["dimension"]
+        ndim = len(eqn.outvars[0].aval.shape)
+        o: List = [None] * ndim
+        for d in range(ndim):
+            if d == d_cat:
+                continue                # concat dim stays unsharded
+            entries = {p[d] for p, _ in known if p[d] is not None}
+            if len(entries) == 1 and len(known) == len(in_pl):
+                o[d] = entries.pop()
+        self._set(env, eqn.outvars[0], o)
+        return None
+
+    def _slice_like(self, eqn, env, in_pl, in_shapes, where, weight,
+                    record):
+        # keep placements only on dims whose size is unchanged
+        if in_pl[0] is None:
+            return None
+        out = eqn.outvars[0]
+        out_shape = tuple(out.aval.shape)
+        if len(out_shape) != len(in_shapes[0]):
+            return None
+        self._set(env, out,
+                  tuple(e if out_shape[d] == in_shapes[0][d] else None
+                        for d, e in enumerate(in_pl[0])))
+        return None
+
+    def _reduction(self, eqn, env, in_pl, in_shapes, where, weight,
+                   record):
+        if in_pl[0] is None:
+            return None
+        axes = set(eqn.params.get("axes", ()))
+        reduced_axes: List[str] = []
+        o = []
+        for d, e in enumerate(in_pl[0]):
+            if d in axes:
+                if e:
+                    reduced_axes.extend(e)
+            else:
+                o.append(e)
+        out = eqn.outvars[0]
+        self._set(env, out, o)
+        if reduced_axes and record:
+            self._collect("all_reduce",
+                          self._sharded_nbytes(out.aval, tuple(o),
+                                               reduced_axes),
+                          tuple(reduced_axes), where, weight)
+        return reduced_axes or None
+
+    def _psum(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        axes = eqn.params.get("axes", ())
+        named = tuple(a for a in axes if isinstance(a, str))
+        if record and named:
+            for v, pl in zip(eqn.invars, in_pl):
+                self._collect("all_reduce",
+                              self._sharded_nbytes(v.aval, pl, named),
+                              named, where, weight)
+        for v, o, pl in zip(eqn.invars, eqn.outvars, in_pl):
+            if pl is not None:
+                self._set(env, o, pl)
+        return named or None
+
+    def _pallas(self, eqn, env, in_pl, in_shapes, where, weight, record):
+        # pass-through: a kernel's output adopts the placement of a
+        # shape/dtype-matched input (flash-attention o ~ q); nothing is
+        # invented for mismatched shapes
+        for o in eqn.outvars:
+            o_shape = tuple(getattr(o.aval, "shape", ()))
+            o_dtype = getattr(o.aval, "dtype", None)
+            for v, pl in zip(eqn.invars, in_pl):
+                if pl is not None and \
+                        tuple(getattr(v.aval, "shape", ())) == o_shape \
+                        and getattr(v.aval, "dtype", None) == o_dtype:
+                    self._set(env, o, pl)
+                    break
+        return None
+
+    def _default(self, eqn, env, in_pl, in_shapes, where, record):
+        known = [p for p in in_pl if p is not None]
+        out = eqn.outvars[0] if eqn.outvars else None
+        if not known or out is None:
+            return None
+        prim = eqn.primitive.name
+        out_shape = tuple(getattr(out.aval, "shape", ()))
+        same_rank = all(len(s) == len(out_shape) or s == ()
+                        for s in in_shapes)
+        unary_like = prim in _ELEMENTWISE_HINT or (
+            prim in _BINARY or len(eqn.invars) == 1)
+        if unary_like and same_rank:
+            pairs = [(p, s) for p, s in zip(in_pl, in_shapes)
+                     if p is not None]
+            self._set(env, out, self._merge_elementwise(
+                prim, [p[0] for p in pairs], [p[1] for p in pairs],
+                where, record,
+                avals=[v.aval for v, p in zip(eqn.invars, in_pl)
+                       if p is not None]))
+        return None
+
+    def _merge_elementwise(self, prim, specs_in, shapes, where, record,
+                           avals=None):
+        """Same-shape operands: conflicting non-None dims = resharding."""
+        ndim = max((len(s) for s in shapes), default=0)
+        out: List = [None] * ndim
+        for i, (spec, shape) in enumerate(zip(specs_in, shapes)):
+            if spec is None:
+                continue
+            offset = ndim - len(shape)          # numpy broadcasting
+            for d, e in enumerate(spec):
+                if e is None or (d < len(shape) and shape[d] == 1):
+                    continue
+                slot = offset + d
+                if out[slot] is None:
+                    out[slot] = e
+                elif out[slot] != e and record:
+                    comm = tuple(set(out[slot]) | set(e))
+                    if avals and i < len(avals):
+                        self._collect(
+                            "all_to_all",
+                            self._sharded_nbytes(avals[i], spec, comm),
+                            comm, where, 1)
+                    self._diag(
+                        Severity.WARNING,
+                        f"operands of `{prim}` carry conflicting "
+                        f"shardings on dim {slot} ({out[slot]} vs {e}) — "
+                        f"GSPMD will reshard one side", where,
+                        hint="add a with_sharding_constraint "
+                             "(mpu.constrain) to pick the intended "
+                             "layout explicitly",
+                        collective=("all_to_all", comm))
+        return tuple(out)
+
+    # -- containers -----------------------------------------------------------
+
+    def _container(self, eqn, env, in_pl, weight, record) -> bool:
+        prim = eqn.primitive.name
+        if prim == "scan":
+            self._scan(eqn, env, in_pl, weight, record)
+            return True
+        if prim == "while":
+            self._while(eqn, env, in_pl, weight, record)
+            return True
+        if prim == "cond":
+            self._cond(eqn, env, in_pl, weight, record)
+            return True
+        sub = _single_subjaxpr(eqn)
+        if sub is not None:
+            body = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            n_in, n_eqn = len(body.invars), len(eqn.invars)
+            if n_in <= n_eqn:
+                # align trailing (leading eqn invars are closure consts)
+                outs = self._sub_run(body, in_pl[n_eqn - n_in:], weight,
+                                     record)
+                for o, pl in zip(eqn.outvars, outs):
+                    if pl is not None:
+                        self._set(env, o, pl)
+                return True
+        return False
+
+    def _sub_run(self, body, in_pl, weight, record):
+        env: Dict[int, Tuple] = {}
+        for v, pl in zip(body.invars, in_pl):
+            if pl is not None:
+                env[id(v)] = self._clean(
+                    norm_spec(pl, len(getattr(v.aval, "shape", ()))))
+        self._forward(body, env, weight, record)
+        return [env.get(id(v)) if not hasattr(v, "val")
+                else None for v in body.outvars]
+
+    def _scan(self, eqn, env, in_pl, weight, record):
+        p = eqn.params
+        n_consts, n_carry = p["num_consts"], p["num_carry"]
+        length = int(p.get("length", 1) or 1)
+        body = p["jaxpr"]
+        body = body.jaxpr if hasattr(body, "jaxpr") else body
+        consts = in_pl[:n_consts]
+        carry = list(in_pl[n_consts:n_consts + n_carry])
+        xs = [None if pl is None else tuple(pl[1:])
+              for pl in in_pl[n_consts + n_carry:]]     # drop scan dim
+        for _ in range(self._MAX_FIXED_POINT):
+            outs = self._sub_run(body, consts + carry + xs, 1, False)
+            new_carry = [_join(a, b) for a, b in zip(carry,
+                                                     outs[:n_carry])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        outs = self._sub_run(body, consts + carry + xs, weight * length,
+                             record)
+        for o, pl in zip(eqn.outvars[:n_carry], outs[:n_carry]):
+            if pl is not None:
+                self._set(env, o, pl)
+        for o, pl in zip(eqn.outvars[n_carry:], outs[n_carry:]):
+            if pl is not None:
+                self._set(env, o, (None,) + tuple(pl))  # stacked ys
+        return True
+
+    def _while(self, eqn, env, in_pl, weight, record):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        body = p["body_jaxpr"]
+        body = body.jaxpr if hasattr(body, "jaxpr") else body
+        consts = in_pl[cn:cn + bn]
+        carry = list(in_pl[cn + bn:])
+        for _ in range(self._MAX_FIXED_POINT):
+            outs = self._sub_run(body, consts + carry, 1, False)
+            new_carry = [_join(a, b) for a, b in zip(carry, outs)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        outs = self._sub_run(body, consts + carry, weight, record)
+        for o, pl in zip(eqn.outvars, outs):
+            if pl is not None:
+                self._set(env, o, pl)
+        return True
+
+    def _cond(self, eqn, env, in_pl, weight, record):
+        branches = eqn.params["branches"]
+        operands = in_pl[1:]
+        all_outs = []
+        for br in branches:
+            body = br.jaxpr if hasattr(br, "jaxpr") else br
+            all_outs.append(self._sub_run(body, operands, weight, record))
+        for i, o in enumerate(eqn.outvars):
+            pls = [outs[i] if i < len(outs) else None for outs in all_outs]
+            joined = pls[0]
+            for pl in pls[1:]:
+                joined = _join(joined, pl)
+            if joined is not None:
+                self._set(env, o, joined)
+        return True
+
+    # -- backward refinement --------------------------------------------------
+
+    def _backward(self, jaxpr, env):
+        """Reverse sweep: fill UNKNOWN input placements from known
+        outputs for structure-preserving eqns.  Never overwrites, never
+        records — it only seeds the final forward sweep."""
+        for eqn in reversed(jaxpr.eqns):
+            prim = eqn.primitive.name
+            if not eqn.outvars:
+                continue
+            out_pl = self._pl(env, eqn.outvars[0])
+            if out_pl is None:
+                continue
+            if prim == "sharding_constraint":
+                # the constraint states the layout its PRODUCER should
+                # arrive in — seed it backward so the final forward
+                # sweep sees the intended placement upstream
+                v = eqn.invars[0]
+                if self._pl(env, v) is None and not hasattr(v, "val"):
+                    self._set(env, v, out_pl)
+            elif prim == "transpose":
+                v = eqn.invars[0]
+                if self._pl(env, v) is None and not hasattr(v, "val"):
+                    perm = eqn.params["permutation"]
+                    inv = [0] * len(perm)
+                    for i, pp in enumerate(perm):
+                        inv[pp] = i
+                    self._set(env, v, tuple(out_pl[i] for i in inv))
+            elif prim == "reshape":
+                v = eqn.invars[0]
+                if self._pl(env, v) is None and not hasattr(v, "val"):
+                    o = _map_reshape(out_pl,
+                                     tuple(eqn.outvars[0].aval.shape),
+                                     tuple(v.aval.shape), self.mesh)
+                    if o is not None:
+                        self._set(env, v, o)
+            elif prim in _ELEMENTWISE_HINT or prim in _BINARY:
+                out_shape = tuple(eqn.outvars[0].aval.shape)
+                for v in eqn.invars:
+                    if hasattr(v, "val") or self._pl(env, v) is not None:
+                        continue
+                    if tuple(getattr(v.aval, "shape", ())) == out_shape:
+                        self._set(env, v, out_pl)
+
+    def _identity(self, eqn, env, in_pl, in_shapes, where, weight,
+                  record):
+        if in_pl[0] is not None:
+            self._set(env, eqn.outvars[0], in_pl[0])
+        return None
+
+    _HANDLERS: Dict[str, Callable] = {}
+
+
+Propagator._HANDLERS = {
+    "dot_general": Propagator._dot_general,
+    "conv_general_dilated": Propagator._conv,
+    "sharding_constraint": Propagator._sharding_constraint,
+    "transpose": Propagator._transpose,
+    "broadcast_in_dim": Propagator._broadcast,
+    "reshape": Propagator._reshape,
+    "squeeze": Propagator._squeeze,
+    "expand_dims": Propagator._expand,
+    "concatenate": Propagator._concat,
+    "slice": Propagator._slice_like,
+    "dynamic_slice": Propagator._slice_like,
+    "pad": Propagator._slice_like,
+    "rev": Propagator._identity,
+    "psum": Propagator._psum,
+    "pallas_call": Propagator._pallas,
+}
+for _p in _REDUCE:
+    Propagator._HANDLERS[_p] = Propagator._reduction
+
+
+def _single_subjaxpr(eqn):
+    """The eqn's one nested jaxpr (pjit/remat/custom_jvp/custom_vjp
+    bodies), or None when there are zero or several (cond branches are
+    handled explicitly)."""
+    subs = []
+    for v in eqn.params.values():
+        for item in (v if isinstance(v, (tuple, list)) else (v,)):
+            if hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                subs.append(item)
+            elif hasattr(item, "eqns") and hasattr(item, "invars"):
+                subs.append(item)
+    return subs[0] if len(subs) == 1 else None
+
+
+def _join(a, b):
+    """Pointwise agreement of two placements (disagree → None)."""
+    if a is None or b is None:
+        return a if b is None else b if a is None else None
+    if len(a) != len(b):
+        return None
+    return tuple(e if e == f else None for e, f in zip(a, b))
+
+
+def _map_reshape(dims, in_shape, out_shape, mesh):
+    """Placement through a reshape via factor-group matching: dims whose
+    sizes line up between the two shapes keep their axes; a sharded dim
+    that splits keeps its axes on the first out-dim of its group when
+    divisible; anything murkier drops to None (conservative)."""
+    groups = _reshape_groups(in_shape, out_shape)
+    if groups is None:
+        return None
+    out: List = [None] * len(out_shape)
+    for in_dims, out_dims in groups:
+        sharded = [(d, dims[d]) for d in in_dims
+                   if d < len(dims) and dims[d]]
+        if not sharded:
+            continue
+        if len(sharded) > 1 or not out_dims:
+            return None                   # give up on this reshape
+        d, axes = sharded[0]
+        if d != in_dims[0]:
+            continue                      # sharded dim not leading — drop
+        total = 1
+        for a in axes:
+            total *= mesh.get(a, 1)
+        if out_shape[out_dims[0]] % max(total, 1) == 0:
+            out[out_dims[0]] = axes
+    return tuple(out)
+
+
+def _reshape_groups(in_shape, out_shape):
+    """Greedy factor matching: partition both shapes into consecutive
+    groups of equal products.  Returns [(in_dims, out_dims), ...] or
+    None when sizes cannot be aligned (shouldn't happen — reshape
+    preserves element count)."""
+    i = j = 0
+    groups = []
+    while i < len(in_shape) or j < len(out_shape):
+        gi, gj = [i], [j] if j < len(out_shape) else []
+        pi = in_shape[i] if i < len(in_shape) else 1
+        pj = out_shape[j] if j < len(out_shape) else 1
+        i, j = i + 1, j + 1
+        while pi != pj:
+            if pi < pj:
+                if i >= len(in_shape):
+                    return None
+                gi.append(i)
+                pi *= in_shape[i]
+                i += 1
+            else:
+                if j >= len(out_shape):
+                    return None
+                gj.append(j)
+                pj *= out_shape[j]
+                j += 1
+        groups.append((gi, gj))
+    return groups

@@ -74,12 +74,34 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="non-zero exit on WARNINGs too")
     ap.add_argument("--no-cost-table", action="store_true")
+    ap.add_argument("--autoshard", action="store_true",
+                    help="run the GSPMD-style layout planner instead of "
+                         "the lint pipeline: enumerate DP/FSDP/TP(/PP) "
+                         "layouts for the target's train step, print the "
+                         "ranked plan table, and verify the winning plan "
+                         "round-trips the sharding checker clean")
+    ap.add_argument("--mesh-devices", type=int, default=None,
+                    help="device count to plan for (default: all local "
+                         "devices)")
+    ap.add_argument("--max-pp", type=int, default=1,
+                    help="also enumerate pipeline splits up to this "
+                         "factor (scored analytically)")
+    ap.add_argument("--topk", type=int, default=5)
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="reject layouts whose per-device peak HBM "
+                         "exceeds this budget")
+    ap.add_argument("--assert-beats-manual", action="store_true",
+                    help="exit non-zero unless the top plan's predicted "
+                         "cost <= the model's hand-written "
+                         "partition_specs layout (the CI planner gate)")
     args = ap.parse_args(argv)
 
     import paddle_tpu.analysis as analysis
 
     obj = resolve(args.target, args.init)
     example = [parse_spec(s) for s in args.spec]
+    if args.autoshard:
+        return _autoshard_main(obj, example, args)
     passes = args.passes.split(",") if args.passes else None
     report = analysis.check(obj, *example, method=args.method,
                             passes=passes)
@@ -92,6 +114,84 @@ def main(argv=None) -> int:
         return 1
     if args.strict and report.warnings():
         return 1
+    return 0
+
+
+def _autoshard_main(obj, example, args) -> int:
+    """``--autoshard``: plan layouts for the target's full train step.
+
+    A Layer target is wrapped in a ``TrainStep`` (AdamW) so the planner
+    scores the real fwd+bwd+update program; ``--spec`` supplies the
+    example batch (one spec → labels share its shape).  Exit is non-zero
+    when no candidate survives, when the winning plan fails the
+    round-trip sharding-consistency check, or — with
+    ``--assert-beats-manual`` — when the hand-written layout predicts
+    faster."""
+    from paddle_tpu.analysis import autoshard
+    from paddle_tpu.nn.layer import Layer
+
+    target = obj
+    manual_specs = None
+    if isinstance(obj, Layer):
+        import paddle_tpu as pp
+        from paddle_tpu.jit import TrainStep
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=obj.parameters())
+        target = TrainStep(obj, opt)
+        rules_fn = getattr(type(obj), "partition_specs", None)
+        if callable(rules_fn):
+            try:
+                manual_specs = rules_fn(obj.config, fsdp_axis="fsdp")
+            except TypeError:
+                try:
+                    manual_specs = rules_fn(obj.config)
+                except Exception:
+                    manual_specs = None
+    if not example:
+        raise SystemExit("--autoshard needs at least one --spec for the "
+                         "example batch (e.g. --spec int32[8,16])")
+    batch = {"input_ids": example[0],
+             "labels": example[1] if len(example) > 1 else example[0]}
+
+    result = autoshard.plan(target, batch, n_devices=args.mesh_devices,
+                            max_pp=args.max_pp, topk=args.topk,
+                            hbm_gb=args.hbm_gb,
+                            manual_specs=manual_specs)
+    print(f"autoshard: ranked plans for {result.n_devices} devices "
+          f"({len([s for s in result.scored if s.pruned is None])} "
+          f"candidates scored, "
+          f"{len([s for s in result.scored if s.pruned])} pruned)")
+    print(result.table())
+    if not result.plans:
+        print("autoshard: FAIL — no viable candidate", file=sys.stderr)
+        return 1
+
+    top = result.top
+    print()
+    print(f"emitting {top.summary()}")
+    if not top.is_pipeline:
+        rep = top.verify(target, batch)
+        bad = rep.errors() + rep.warnings()
+        if bad:
+            print("autoshard: FAIL — emitted plan does not round-trip "
+                  "the sharding-consistency checker:", file=sys.stderr)
+            print(rep.format(), file=sys.stderr)
+            return 1
+        print("sharding-consistency round-trip: clean "
+              f"({len(rep.by_pass('sharding-consistency'))} INFO "
+              f"findings)")
+    if args.assert_beats_manual:
+        if result.manual is None:
+            print("autoshard: FAIL — --assert-beats-manual but the "
+                  "target has no hand-written partition_specs",
+                  file=sys.stderr)
+            return 1
+        ok = result.beats_manual()
+        print(f"planner vs manual: {top.score.step_seconds * 1e3:.3f} ms "
+              f"vs {result.manual.step_seconds * 1e3:.3f} ms -> "
+              f"{'planner wins or ties' if ok else 'manual wins'}")
+        if not ok:
+            return 1
     return 0
 
 

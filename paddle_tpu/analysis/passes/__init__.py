@@ -85,3 +85,7 @@ def all_passes() -> Dict[str, Callable]:
 from paddle_tpu.analysis.passes import (  # noqa: E402,F401
     cost_model, dead_code, dtype_promotion, recompile, sharding_consistency,
 )
+# the autoshard planner pass registers itself too (not in DEFAULT_PASSES —
+# layout search is opt-in via `--passes autoshard` / the lint --autoshard
+# CLI mode / analysis.autoshard.plan())
+from paddle_tpu.analysis.autoshard import planner as _autoshard  # noqa: E402,F401

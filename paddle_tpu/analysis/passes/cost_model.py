@@ -28,6 +28,49 @@ from paddle_tpu.analysis.tracing import walk_eqns, where_of
 DEFAULT_PEAK_FLOPS = 197e12          # bf16
 DEFAULT_HBM_BW = 819e9               # bytes/s
 
+# per-direction link bandwidth (bytes/s) a collective's ring runs over.
+# "ici" is the intra-slice chip interconnect (v5e-class 2D torus, one
+# direction of one link); "dcn" is the cross-slice data-center network.
+# Override per call (collective_seconds(bandwidth=...)) or per run
+# (options={'link_bw': ...}) — the table is a ranking prior, not a
+# cycle-accurate model.
+LINK_BANDWIDTH = {
+    "ici": 9.0e10,
+    "dcn": 6.25e9,
+}
+DEFAULT_LINK_BW = LINK_BANDWIDTH["ici"]
+
+
+def collective_seconds(op: str, nbytes: int, axis_size: int,
+                       bandwidth: float = None, link: str = "ici") -> float:
+    """Ring-algorithm time of one collective over a mesh axis.
+
+    ``nbytes`` is the LOGICAL payload (the full gathered/reduced tensor,
+    per shard of any axis not being communicated), ``axis_size`` the
+    number of participants.  Standard ring costs: all-gather and
+    reduce-scatter move ``(k-1)/k`` of the payload over the slowest
+    link; all-reduce is reduce-scatter + all-gather (2x); all-to-all
+    moves ``1/k`` of what an all-gather would.  Reusable by the
+    autoshard scorer, the SLO watchdog and the device profiler —
+    anything that needs "how long should these collective bytes take".
+    """
+    k = max(int(axis_size), 1)
+    if k <= 1 or nbytes <= 0:
+        return 0.0
+    bw = float(bandwidth) if bandwidth else LINK_BANDWIDTH[link]
+    frac = (k - 1) / k
+    if op in ("all_gather", "reduce_scatter"):
+        return frac * nbytes / bw
+    if op in ("all_reduce", "psum"):
+        return 2.0 * frac * nbytes / bw
+    if op in ("all_to_all", "a2a"):
+        return frac * nbytes / (k * bw)
+    if op in ("p2p", "send", "recv", "ppermute"):
+        return nbytes / bw
+    raise ValueError(
+        f"unknown collective op {op!r}; expected all_gather/"
+        f"reduce_scatter/all_reduce/psum/all_to_all/p2p")
+
 _TRANSCENDENTAL = {
     "exp", "log", "log1p", "expm1", "tanh", "erf", "erfc", "erf_inv",
     "logistic", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",

@@ -9,13 +9,22 @@ makes them visible statically:
   one dim of a tensor, spec rank must fit the tensor, and sharded dims
   should divide evenly (padding otherwise);
 * dataflow: invar specs (param placements from TrainStep / mpu layer
-  annotations / caller-passed rules) propagate through elementwise ops,
-  transposes, broadcasts and constraints; at every ``dot_general`` the
-  contracting dims of both operands must agree — a dim sharded on one
-  side and not the other is an implicit all-gather of that operand;
+  annotations / caller-passed rules) propagate through the full
+  equation set — elementwise ops, transposes, reshapes, broadcasts,
+  reductions, scan/while carries (iterated to a fixed point), cond
+  branches, custom_vjp/pjit bodies and pallas_call pass-through — via
+  the shared engine in ``analysis.autoshard.propagation``; at every
+  ``dot_general`` the contracting dims of both operands must agree — a
+  dim sharded on one side and not the other is an implicit all-gather
+  of that operand;
 * ``sharding_constraint`` eqns that drop an incoming sharded dim are the
   explicit all-gathers (e.g. ColumnParallelLinear's gather_output) —
   reported INFO so intent stays auditable.
+
+An autoshard-emitted plan passes its induced collective set through
+``options={'expected_collectives': plan.expected_collectives}``;
+matching WARNING findings are demoted to INFO (still auditable, no
+longer failures) so every emitted plan round-trips the checker clean.
 """
 
 from __future__ import annotations
@@ -24,42 +33,16 @@ from typing import Dict, List, Optional, Tuple
 
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity, dedup
 from paddle_tpu.analysis.passes import PassContext, register_pass
-from paddle_tpu.analysis.tracing import where_of
-
-_ELEMENTWISE_HINT = ("integer_pow", "neg", "exp", "log", "tanh", "rsqrt",
-                     "sqrt", "logistic", "sin", "cos", "abs", "sign",
-                     "floor", "ceil", "round", "erf", "not", "is_finite",
-                     "stop_gradient", "convert_element_type", "copy",
-                     "reduce_precision")
-_BINARY = ("add", "sub", "mul", "div", "max", "min", "pow", "rem",
-           "atan2", "and", "or", "xor", "shift_left",
-           "shift_right_logical", "shift_right_arithmetic", "nextafter",
-           "eq", "ne", "lt", "le", "gt", "ge")
 
 
 def _norm(spec, ndim: int) -> Tuple:
-    """PartitionSpec → per-dim tuple of axis-name tuples (or None),
-    padded to the tensor's rank."""
-    entries = list(spec) if spec is not None else []
-    out = []
-    for e in entries[:ndim]:
-        if e is None:
-            out.append(None)
-        elif isinstance(e, (tuple, list)):
-            out.append(tuple(e) if e else None)
-        else:
-            out.append((e,))
-    out += [None] * (ndim - len(out))
-    return tuple(out)
+    from paddle_tpu.analysis.autoshard.propagation import norm_spec
+    return norm_spec(spec, ndim)
 
 
 def _spec_for_name(name: str, specs: Dict) -> Optional[object]:
-    if name in specs:
-        return specs[name]
-    for pat, spec in specs.items():
-        if name.endswith(pat) or pat in name:
-            return spec
-    return None
+    from paddle_tpu.analysis.autoshard.propagation import spec_for_name
+    return spec_for_name(name, specs)
 
 
 def _validate(name, spec, aval, mesh, diags):
@@ -105,32 +88,6 @@ def _validate(name, spec, aval, mesh, diags):
                     f"every shard", name))
 
 
-def _merge_elementwise(prim, specs_in, shapes, where, diags):
-    """Same-shape operands: conflicting non-None dims = resharding."""
-    ndim = max((len(s) for s in shapes), default=0)
-    out = [None] * ndim
-    for spec, shape in zip(specs_in, shapes):
-        if spec is None:
-            continue
-        # align trailing dims (numpy broadcasting)
-        offset = ndim - len(shape)
-        for d, e in enumerate(spec):
-            if e is None or shape[d] == 1:
-                continue
-            slot = offset + d
-            if out[slot] is None:
-                out[slot] = e
-            elif out[slot] != e:
-                diags.append(Diagnostic(
-                    "sharding-consistency", Severity.WARNING,
-                    f"operands of `{prim}` carry conflicting shardings "
-                    f"on dim {slot} ({out[slot]} vs {e}) — GSPMD will "
-                    f"reshard one side", where,
-                    hint="add a with_sharding_constraint (mpu.constrain) "
-                         "to pick the intended layout explicitly"))
-    return tuple(out)
-
-
 @register_pass("sharding-consistency")
 def sharding_consistency(ctx: PassContext) -> List[Diagnostic]:
     specs = ctx.trace.param_specs or {}
@@ -139,111 +96,29 @@ def sharding_consistency(ctx: PassContext) -> List[Diagnostic]:
     if not specs:
         return []  # unsharded program — nothing to verify
 
+    from paddle_tpu.analysis.autoshard.propagation import Propagator
+
     jaxpr = ctx.jaxpr
-    env: Dict[int, Tuple] = {}
+    placements = []
     for name, var in zip(ctx.trace.invar_names, jaxpr.invars):
         spec = _spec_for_name(name, specs)
+        ndim = len(getattr(var.aval, "shape", ()))
+        if spec is not None and len(list(spec)) > ndim:
+            # pattern matched a lower-rank leaf (e.g. an opt-state
+            # scalar whose name contains the param's) — not this
+            # tensor's spec; skip instead of flagging a false positive
+            if name not in specs:
+                placements.append(None)
+                continue
         if spec is None:
+            placements.append(None)
             continue
         _validate(name, spec, var.aval, mesh, diags)
-        env[id(var)] = _norm(spec, len(getattr(var.aval, "shape", ())))
+        placements.append(_norm(spec, ndim))
 
-    def spec_of(v):
-        if hasattr(v, "val"):
-            return None
-        return env.get(id(v))
-
-    for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
-        where = where_of(eqn)
-        out = eqn.outvars[0] if eqn.outvars else None
-        in_specs = [spec_of(v) for v in eqn.invars]
-        in_shapes = [tuple(getattr(v.aval, "shape", ()))
-                     for v in eqn.invars]
-
-        if prim == "dot_general":
-            (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
-            ls, rs = in_specs[0], in_specs[1]
-            for ld, rd in zip(lc, rc):
-                le = ls[ld] if ls else None
-                re_ = rs[rd] if rs else None
-                if le != re_:
-                    gathered = "lhs" if (le and not re_) else \
-                        "rhs" if (re_ and not le) else "one operand"
-                    diags.append(Diagnostic(
-                        "sharding-consistency", Severity.WARNING,
-                        f"contracting dim of dot_general sharded "
-                        f"{le or '(replicated)'} on lhs vs "
-                        f"{re_ or '(replicated)'} on rhs — GSPMD "
-                        f"all-gathers {gathered} before the matmul",
-                        where,
-                        hint="shard both contraction dims on the same "
-                             "axis (partial-sums + one psum) or neither"))
-            if out is not None and (ls or rs):
-                lfree = [d for d in range(len(in_shapes[0]))
-                         if d not in lc and d not in lb]
-                rfree = [d for d in range(len(in_shapes[1]))
-                         if d not in rc and d not in rb]
-                o = [(ls[d] if ls else None) for d in lb]
-                o += [(ls[d] if ls else None) for d in lfree]
-                o += [(rs[d] if rs else None) for d in rfree]
-                env[id(out)] = tuple(o)
-            continue
-
-        if prim == "sharding_constraint":
-            target = eqn.params.get("sharding")
-            tspec = getattr(target, "spec", None)
-            ndim = len(in_shapes[0])
-            norm_t = _norm(tspec, ndim) if tspec is not None else None
-            incoming = in_specs[0]
-            if norm_t is not None and incoming is not None:
-                for d, (i_e, t_e) in enumerate(zip(incoming, norm_t)):
-                    if i_e and not t_e:
-                        diags.append(Diagnostic(
-                            "sharding-consistency", Severity.INFO,
-                            f"sharding_constraint drops axis {i_e} on "
-                            f"dim {d} — an all-gather materializes the "
-                            f"replicated value here", where,
-                            hint="intended for gather_output-style "
-                                 "layers; remove the constraint to keep "
-                                 "the value sharded"))
-                    elif i_e and t_e and i_e != t_e:
-                        diags.append(Diagnostic(
-                            "sharding-consistency", Severity.WARNING,
-                            f"sharding_constraint reshards dim {d} "
-                            f"from {i_e} to {t_e} (all-to-all)", where))
-            if out is not None and norm_t is not None:
-                env[id(out)] = norm_t
-            continue
-
-        if prim == "transpose" and in_specs[0] is not None:
-            perm = eqn.params["permutation"]
-            env[id(out)] = tuple(in_specs[0][p] for p in perm)
-            continue
-
-        if prim == "broadcast_in_dim" and in_specs[0] is not None:
-            bcast = eqn.params["broadcast_dimensions"]
-            o = [None] * len(eqn.params["shape"])
-            for src, dst in enumerate(bcast):
-                o[dst] = in_specs[0][src]
-            env[id(out)] = tuple(o)
-            continue
-
-        known = [s for s in in_specs if s is not None]
-        if not known or out is None:
-            continue
-        out_shape = tuple(getattr(out.aval, "shape", ()))
-        same_rank = all(len(s) == len(out_shape) or s == ()
-                        for s in in_shapes)
-        unary_like = prim in _ELEMENTWISE_HINT or (
-            prim in _BINARY or len(eqn.invars) == 1)
-        if unary_like and same_rank:
-            pairs = [(s, sh) for s, sh in zip(in_specs, in_shapes)
-                     if s is not None]
-            env[id(out)] = _merge_elementwise(
-                prim, [p[0] for p in pairs], [p[1] for p in pairs],
-                where, diags)
-        # other prims (reshape/gather/reductions/…): spec unknown — the
-        # propagation is deliberately conservative, never guessing
-
+    mesh_shape = dict(getattr(mesh, "shape", {}) or {})
+    prop = Propagator(mesh_shape, diags=diags,
+                      expected=ctx.opt("expected_collectives"))
+    prop.run(jaxpr, placements)
+    ctx.extras["sharding_collectives"] = prop.collectives
     return dedup(diags)

@@ -145,7 +145,8 @@ def trace(target, *example_args, method: Optional[str] = None,
                             is_leaf=lambda t: hasattr(t, "_data"))
 
     if isinstance(target, CompiledStepBase):
-        return _trace_train_step(target, example_args, monitor)
+        return _trace_train_step(target, example_args, monitor,
+                                 param_specs=param_specs, mesh=mesh)
 
     if isinstance(target, Layer):
         from paddle_tpu.core.functional import functional_call, params_of
@@ -206,10 +207,13 @@ def _arg_leaf_names(args_abs, kwargs_abs=None) -> List[str]:
     return names
 
 
-def _trace_train_step(step, example_args, monitor) -> TraceResult:
+def _trace_train_step(step, example_args, monitor, param_specs=None,
+                      mesh=None) -> TraceResult:
     """Trace the whole compiled train step.  Example arg: one batch
     (dict/tuple of arrays); params/opt_state come abstract from the
-    step's own live state, shardings from its placement."""
+    step's own live state, shardings from its placement (explicit
+    ``param_specs``/``mesh`` — e.g. an autoshard plan under
+    verification — override it)."""
     import jax.numpy as jnp
 
     if not example_args:
@@ -240,8 +244,9 @@ def _trace_train_step(step, example_args, monitor) -> TraceResult:
     invar_names.extend(f"batch.{j}" for j in range(nbatch))
     invar_names.extend(["rng_key", "lr"])
 
-    specs, mesh = _specs_of_shardings(getattr(step, "_param_sh", None))
+    specs, own_mesh = _specs_of_shardings(getattr(step, "_param_sh", None))
+    specs.update(param_specs or {})
     return TraceResult(closed, invar_names, specs,
-                       mesh=mesh or getattr(step, "mesh", None),
+                       mesh=mesh or own_mesh or getattr(step, "mesh", None),
                        target_name=f"TrainStep({type(step.model).__name__})",
                        example_args=example_args, monitor=monitor)

@@ -59,6 +59,16 @@ def next_key():
     return sub
 
 
+def derive_seed() -> int:
+    """A fresh host-side integer seed drawn from the global RNG stream —
+    deterministic under ``seed()``, different on every call.  Host-side
+    consumers (data shuffling, worker seeding) hang off this instead of
+    OS entropy so a seeded run shuffles reproducibly."""
+    import numpy as np
+    return int(np.asarray(
+        jax.random.randint(next_key(), (), 0, np.iinfo(np.int32).max)))
+
+
 def get_rng_state():
     global _key
     with _lock:

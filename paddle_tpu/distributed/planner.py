@@ -42,9 +42,79 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["plan_llama", "plan_moe", "PlanReport",
+__all__ = ["plan_llama", "plan_moe", "PlanReport", "estimate_peak_hbm",
            "LLAMA3_8B", "LLAMA3_70B", "DEEPSEEK_MOE_16B",
            "ERNIE45_21B_A3B", "CONFIGS"]
+
+
+def estimate_peak_hbm(step_fn, shardings, mesh, *example_args,
+                      batch_spec=None, donate=True) -> int:
+    """Per-device peak-HBM estimate of one compiled step under a layout:
+    XLA's own buffer assignment (arguments + temps) from an abstract
+    ``jit(...).lower(avals).compile()`` — nothing is materialized.
+
+    ``step_fn`` is either a ``jit.TrainStep``-style compiled step (its
+    full fwd+bwd+update ``_step_impl`` and live param/opt-state shapes
+    are lowered; pass one example batch) or a plain jit-able callable
+    (``example_args`` are its arguments; ``shardings`` is then a
+    matching pytree of PartitionSpecs, with ``None`` leaves replicated).
+    ``shardings`` for a step is ``{param name → PartitionSpec |
+    NamedSharding}``; opt-state leaves shaped like their param inherit
+    its placement.  This is the AOT memory analysis the flagship-config
+    CLI runs, factored out so the autoshard pruner (and anything else)
+    can reject OOM layouts per candidate.  Same caveat as
+    ``PlanReport``: the host backend's assignment is a capacity
+    estimate, not kB-accurate TPU accounting.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def to_sharding(s):
+        if s is None:
+            return NamedSharding(mesh, P())
+        if isinstance(s, NamedSharding):
+            return s
+        return NamedSharding(mesh, s)
+
+    def aval_of(x, s):
+        if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+            return x
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                    sharding=to_sharding(s))
+
+    if hasattr(step_fn, "_step_impl"):           # a compiled train step
+        step = step_fn
+        sh = {n: to_sharding(shardings.get(n)) for n in step.params}
+        p_avals = {n: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype,
+                                           sharding=sh[n])
+                   for n, a in step.params.items()}
+        opt_avals = {
+            n: jax.tree.map(
+                lambda a, _n=n: aval_of(
+                    a, shardings.get(_n)
+                    if tuple(getattr(a, "shape", ())) ==
+                    tuple(step.params[_n].shape) else None),
+                st)
+            for n, st in step.opt_state.items()}
+        batch = example_args[0] if example_args else {}
+        batch_avals = jax.tree.map(lambda a: aval_of(a, batch_spec),
+                                   batch,
+                                   is_leaf=lambda t: hasattr(t, "_data"))
+        lowered = jax.jit(
+            step._step_impl,
+            donate_argnums=(0, 1, 2) if donate else ()).lower(
+            p_avals, opt_avals, jax.ShapeDtypeStruct((), jnp.int32),
+            batch_avals, jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32))
+    else:
+        # plain callable: flat positional args, shardings a matching
+        # flat sequence of PartitionSpec/NamedSharding/None
+        avals = tuple(aval_of(x, s)
+                      for x, s in zip(example_args, shardings))
+        lowered = jax.jit(step_fn).lower(*avals)
+    ma = lowered.compile().memory_analysis()
+    return int(ma.argument_size_in_bytes + ma.temp_size_in_bytes)
 
 
 # -- configs (public architecture numbers) -----------------------------------

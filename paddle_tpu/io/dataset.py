@@ -131,7 +131,13 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = self.generator or np.random.default_rng()
+        rng = self.generator
+        if rng is None:
+            # seeded-framework determinism: a paddle_tpu.seed(s) run
+            # must shuffle reproducibly (and still differently per
+            # epoch) — OS entropy here made every fit() non-repeatable
+            from paddle_tpu.core.state import derive_seed
+            rng = np.random.default_rng(derive_seed())
         if self.replacement:
             return iter(rng.integers(0, n, self.num_samples).tolist())
         return iter(rng.permutation(n)[:self.num_samples].tolist())

@@ -62,7 +62,7 @@ def _coerce_to_specs(args, specs):
 
 
 def to_static(obj=None, input_spec=None, full_graph=True, analyze=None,
-              **kwargs):
+              shardings=None, **kwargs):
     """Decorator/function: compile a Layer's forward or a plain function.
 
     For a Layer, parameters are captured fresh on every call (so eager
@@ -74,9 +74,43 @@ def to_static(obj=None, input_spec=None, full_graph=True, analyze=None,
     tracing.  ``analyze`` opts this callable into the
     ``paddle_tpu.analysis`` pass pipeline on first call ("warn" prints
     findings, "strict" raises on ERROR); default follows
-    ``PADDLE_TPU_ANALYZE``."""
+    ``PADDLE_TPU_ANALYZE``.  ``shardings`` accepts an autoshard plan
+    (``analysis.autoshard.AutoShardPlan``): for a Layer target, its
+    parameters are placed under the plan's NamedShardings before every
+    compiled call and array inputs under the plan's batch spec — GSPMD
+    propagates the layout from there."""
     from paddle_tpu.core.functional import functional_call, params_of
     from paddle_tpu.nn.layer import Layer
+
+    plan_sh = plan_batch_sh = None
+    if shardings is not None:
+        from jax.sharding import NamedSharding
+        if hasattr(shardings, "param_specs"):     # AutoShardPlan
+            plan_sh = shardings.shardings()
+            if shardings.batch_spec is not None:
+                plan_batch_sh = NamedSharding(shardings.jax_mesh(),
+                                              shardings.batch_spec)
+        elif isinstance(shardings, dict):
+            plan_sh = dict(shardings)
+        else:
+            raise TypeError(
+                f"shardings= expects an AutoShardPlan or a dict, "
+                f"got {type(shardings).__name__}")
+
+    def _place_params(ps):
+        if not plan_sh:
+            return ps
+        return {n: jax.device_put(a, plan_sh[n]) if n in plan_sh else a
+                for n, a in ps.items()}
+
+    def _place_input(x):
+        if plan_batch_sh is None or not hasattr(x, "ndim") or \
+                not getattr(x, "ndim", 0):
+            return x
+        try:
+            return jax.device_put(x, plan_batch_sh)
+        except ValueError:            # rank/spec mismatch — leave as-is
+            return x
 
     def wrap(target):
         from paddle_tpu.analysis.recompile import SignatureMonitor
@@ -115,9 +149,10 @@ def to_static(obj=None, input_spec=None, full_graph=True, analyze=None,
             def call(*a, **kw):
                 a, kw = prepare(a, kw)
                 maybe_analyze(target, a, kw)
-                a = tuple(_raw(x) for x in a)
+                a = tuple(_place_input(_raw(x)) for x in a)
                 kw = {k: _raw(v) for k, v in kw.items()}
-                return _wrap_tree(jfn(params_of(target), *a, **kw))
+                return _wrap_tree(jfn(_place_params(params_of(target)),
+                                      *a, **kw))
             call.__wrapped__ = target
             call._signature_monitor = monitor
             return call
@@ -126,7 +161,7 @@ def to_static(obj=None, input_spec=None, full_graph=True, analyze=None,
         def call(*a, **kw):
             a, kw = prepare(a, kw)
             maybe_analyze(target, a, kw)
-            a = tuple(_raw(x) for x in a)
+            a = tuple(_place_input(_raw(x)) for x in a)
             kw = {k: _raw(v) for k, v in kw.items()}
             return _wrap_tree(jfn(*a, **kw))
         call.__wrapped__ = target

@@ -26,6 +26,35 @@ from paddle_tpu.core.functional import functional_call, params_of, \
 __all__ = ["TrainStep", "CompiledStepBase"]
 
 
+def _resolve_plan(shardings, mesh, param_specs, batch_spec):
+    """Expand a ``shardings=`` argument — an AutoShardPlan or a plain
+    ``{name → PartitionSpec}`` dict — into (mesh, param_specs,
+    batch_spec), keeping any explicitly-passed value."""
+    if hasattr(shardings, "param_specs"):        # AutoShardPlan duck type
+        if getattr(shardings, "is_pipeline", False):
+            raise ValueError(
+                "autoshard plan has pp>1 — a pipeline layout targets "
+                "distributed.PipelineTrainStep, not TrainStep")
+        mesh = mesh if mesh is not None else shardings.jax_mesh()
+        param_specs = param_specs if param_specs is not None \
+            else dict(shardings.param_specs)
+        batch_spec = batch_spec if batch_spec is not None \
+            else shardings.batch_spec
+        return mesh, param_specs, batch_spec
+    if isinstance(shardings, dict):
+        if mesh is None:
+            for sh in shardings.values():
+                m = getattr(sh, "mesh", None)
+                if m is not None:
+                    mesh = m
+                    break
+        specs = {n: getattr(sh, "spec", sh) for n, sh in shardings.items()}
+        return mesh, (param_specs if param_specs is not None else specs), \
+            batch_spec
+    raise TypeError(f"shardings= expects an AutoShardPlan or a dict, "
+                    f"got {type(shardings).__name__}")
+
+
 def _train_metrics():
     """Lazily created instruments on the default registry (shared by
     every TrainStep in the process — that is what an operator scrapes)."""
@@ -208,7 +237,14 @@ class TrainStep(CompiledStepBase):
                  remat: bool = False, remat_policy: Optional[str] = None,
                  analyze: Optional[str] = None, accum_steps: int = 1,
                  guard_nonfinite: Optional[bool] = None,
-                 max_consecutive_skips: Optional[int] = None):
+                 max_consecutive_skips: Optional[int] = None,
+                 shardings=None):
+        # shardings=: an autoshard plan (analysis.autoshard.AutoShardPlan
+        # — carries mesh shape, per-param specs and the batch spec in one
+        # object) expands into the mesh/param_specs/batch_spec triple
+        if shardings is not None:
+            mesh, param_specs, batch_spec = _resolve_plan(
+                shardings, mesh, param_specs, batch_spec)
         self.model = model
         self.loss_fn = loss_fn
         self.mesh = mesh

@@ -1,0 +1,481 @@
+"""Autoshard planner: propagation fixed-point, candidate enumeration/
+pruning, scorer monotonicity, collective cost model, peak-HBM helper,
+plan-beats-manual on the 8-device llama harness, and determinism of the
+emitted plan.  Everything runs on the virtual 8-CPU-device mesh the
+conftest forces."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pp
+import paddle_tpu.analysis as analysis
+from paddle_tpu.analysis import autoshard
+from paddle_tpu.analysis.autoshard.candidates import (MeshCandidate,
+                                                      enumerate_candidates,
+                                                      specs_for_candidate)
+from paddle_tpu.analysis.autoshard.propagation import (Collective,
+                                                       Propagator,
+                                                       norm_spec)
+from paddle_tpu.analysis.passes.cost_model import (LINK_BANDWIDTH,
+                                                   collective_seconds)
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def _aval(shape, dtype=jnp.float32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ------------------------------------------------ collective cost model
+
+class TestCollectiveSeconds:
+    def test_ring_formulas(self):
+        bw = LINK_BANDWIDTH["ici"]
+        n, k = 8e9, 8
+        ag = collective_seconds("all_gather", n, k)
+        rs = collective_seconds("reduce_scatter", n, k)
+        ar = collective_seconds("all_reduce", n, k)
+        a2a = collective_seconds("all_to_all", n, k)
+        assert ag == pytest.approx((k - 1) / k * n / bw)
+        assert rs == ag
+        assert ar == pytest.approx(2 * ag)           # RS + AG
+        assert a2a == pytest.approx(ag / k)
+        assert collective_seconds("p2p", n, k) == pytest.approx(n / bw)
+
+    def test_degenerate_cases(self):
+        assert collective_seconds("all_gather", 1e9, 1) == 0.0
+        assert collective_seconds("all_reduce", 0, 8) == 0.0
+
+    def test_custom_bandwidth_and_link(self):
+        fast = collective_seconds("all_gather", 1e9, 4, bandwidth=1e12)
+        slow = collective_seconds("all_gather", 1e9, 4, link="dcn")
+        assert fast < collective_seconds("all_gather", 1e9, 4) < slow
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(ValueError):
+            collective_seconds("gossip", 1e9, 4)
+
+    def test_collective_record_seconds(self):
+        c = Collective("all_reduce", 1000, ("tp",), count=3)
+        assert c.seconds({"tp": 4}) == pytest.approx(
+            3 * collective_seconds("all_reduce", 1000, 4))
+        assert c.total_bytes == 3000
+
+
+# ------------------------------------------------ propagation engine
+
+class TestPropagation:
+    def test_matched_contraction_partial_allreduce(self):
+        closed = jax.make_jaxpr(lambda x, w: x @ w)(
+            _aval((8, 16)), _aval((16, 32)))
+        prop = Propagator({"x": 2}, track_cost=True)
+        prop.run(closed.jaxpr, [norm_spec(P(None, "x"), 2),
+                                norm_spec(P("x", None), 2)])
+        kinds = [c.kind for c in prop.collectives]
+        assert kinds == ["all_reduce"]
+        # contraction split 2-ways: flops halve
+        assert prop.eff_flops == pytest.approx(2 * 8 * 32 * 16 / 2)
+
+    def test_mismatched_contraction_allgather(self):
+        closed = jax.make_jaxpr(lambda x, w: x @ w)(
+            _aval((8, 16)), _aval((16, 32)))
+        prop = Propagator({"x": 2})
+        prop.run(closed.jaxpr, [None, norm_spec(P("x", None), 2)])
+        assert [c.kind for c in prop.collectives] == ["all_gather"]
+        assert prop.collectives[0].bytes == 16 * 32 * 4   # full weight
+
+    def test_scan_carry_fixed_point_and_weighting(self):
+        def f(x, ws):
+            def body(c, w):
+                return c @ w, ()
+            out, _ = jax.lax.scan(body, x, ws)
+            return out
+
+        closed = jax.make_jaxpr(f)(_aval((8, 16)), _aval((4, 16, 16)))
+        prop = Propagator({"x": 2})
+        outs = prop.run(closed.jaxpr,
+                        [norm_spec(P(None, "x"), 2),
+                         norm_spec(P(None, "x", None), 3)])
+        # carry [8,16] starts sharded on dim1 but the matmul output is
+        # replicated, so the fixed point settles on a replicated carry —
+        # every iteration then all-gathers the dim0-sharded weight: ONE
+        # record weighted by the scan length
+        ags = [c for c in prop.collectives if c.kind == "all_gather"]
+        assert ags and ags[0].count == 4
+        assert ags[0].bytes == 16 * 16 * 4
+        # carry placement is defined (loop-invariant) after the loop
+        assert outs[0] is not None
+
+    def test_scan_carry_converges_to_agreement(self):
+        # carry sharded in, body re-shards it via matmul free dims —
+        # the fixed point must settle (conflicting dims drop to None)
+        def f(x, w):
+            def body(c, _):
+                return c @ w, ()
+            out, _ = jax.lax.scan(body, x, jnp.arange(3))
+            return out
+
+        closed = jax.make_jaxpr(f)(_aval((8, 8)), _aval((8, 8)))
+        prop = Propagator({"x": 2})
+        outs = prop.run(closed.jaxpr,
+                        [norm_spec(P("x", None), 2),
+                         norm_spec(P(None, None), 2)])
+        assert outs[0] is not None        # terminated, placement defined
+
+    def test_while_carry(self):
+        def f(x):
+            return jax.lax.while_loop(
+                lambda c: jnp.sum(c) < 100.0, lambda c: c * 2.0, x)
+
+        closed = jax.make_jaxpr(f)(_aval((8, 4)))
+        prop = Propagator({"x": 2})
+        outs = prop.run(closed.jaxpr, [norm_spec(P("x", None), 2)])
+        assert outs[0] == (("x",), None)
+
+    def test_reshape_split_and_merge(self):
+        def f(x):
+            y = x.reshape(8, 4, 16)        # split dim0
+            return y.reshape(32, 16)       # merge back
+
+        closed = jax.make_jaxpr(f)(_aval((32, 16)))
+        prop = Propagator({"dp": 4})
+        outs = prop.run(closed.jaxpr, [norm_spec(P("dp", None), 2)])
+        assert outs[0] == (("dp",), None)
+
+    def test_backward_fill_through_constraint(self):
+        mesh = Mesh(np.array(jax.devices()[:2]), ("mp",))
+        from jax.sharding import NamedSharding
+
+        def f(x):
+            y = x * 2.0
+            return jax.lax.with_sharding_constraint(
+                y, NamedSharding(mesh, P("mp", None)))
+
+        closed = jax.make_jaxpr(f)(_aval((8, 4)))
+        prop = Propagator({"mp": 2}, track_cost=True)
+        outs = prop.run(closed.jaxpr, [None])
+        assert outs[0] == (("mp",), None)
+        # backward seeded the producer: the mul is charged as sharded
+        assert prop.eff_flops < 8 * 4
+
+    def test_elementwise_conflict_records_reshard(self):
+        def f(a, b):
+            return a + b
+
+        closed = jax.make_jaxpr(f)(_aval((8, 8)), _aval((8, 8)))
+        diags = []
+        prop = Propagator({"x": 2, "y": 2}, diags=diags)
+        prop.run(closed.jaxpr, [norm_spec(P("x", None), 2),
+                                norm_spec(P("y", None), 2)])
+        assert any("conflicting shardings" in d.message for d in diags)
+        assert any(c.kind == "all_to_all" for c in prop.collectives)
+
+    def test_reduction_over_sharded_dim_is_allreduce(self):
+        closed = jax.make_jaxpr(lambda x: jnp.sum(x, axis=0))(
+            _aval((8, 4)))
+        prop = Propagator({"x": 2})
+        outs = prop.run(closed.jaxpr, [norm_spec(P("x", None), 2)])
+        assert [c.kind for c in prop.collectives] == ["all_reduce"]
+        assert outs[0] == (None,)
+
+    def test_size_one_axis_is_noop(self):
+        # a "collective" over a one-device axis must produce neither a
+        # record nor a diagnostic (planner-degraded layouts hit this)
+        closed = jax.make_jaxpr(lambda x, w: x @ w)(
+            _aval((8, 16)), _aval((16, 32)))
+        diags = []
+        prop = Propagator({"fsdp": 1}, diags=diags)
+        prop.run(closed.jaxpr, [None, norm_spec(P("fsdp", None), 2)])
+        assert not prop.collectives and not diags
+
+    def test_pallas_call_passthrough(self):
+        pl = pytest.importorskip("jax.experimental.pallas")
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        def f(x):
+            return pl.pallas_call(
+                kernel, out_shape=jax.ShapeDtypeStruct((8, 128),
+                                                       jnp.float32),
+                interpret=True)(x)
+
+        closed = jax.make_jaxpr(f)(_aval((8, 128)))
+        prims = {e.primitive.name for e in closed.jaxpr.eqns}
+        if "pallas_call" not in prims:
+            pytest.skip("pallas_call not traced on this backend")
+        diags = []
+        prop = Propagator({"dp": 2}, diags=diags)
+        outs = prop.run(closed.jaxpr, [norm_spec(P("dp", None), 2)])
+        assert outs[0] == (("dp",), None)     # adopted, not invented
+        assert not diags
+
+
+# ------------------------------------------------ candidates
+
+class TestCandidates:
+    def test_factorizations_cover_8(self):
+        cands = list(enumerate_candidates(8))
+        labels = {c.label for c in cands}
+        assert "dp8xfsdp1xtp1" in labels
+        assert "dp1xfsdp8xtp1" in labels
+        assert "dp1xfsdp1xtp8" in labels
+        assert "dp2xfsdp2xtp2" in labels
+        # sp variants only for tp > 1
+        assert "dp2xfsdp2xtp2+sp" in labels
+        assert not any(c.seq_parallel and c.tp == 1 for c in cands)
+        assert all(c.n_devices == 8 for c in cands)
+
+    def test_pp_enumeration(self):
+        cands = list(enumerate_candidates(8, max_pp=2))
+        assert any(c.pp == 2 for c in cands)
+        assert all(c.n_devices == 8 for c in cands)
+
+    def test_sp_respects_seq_divisibility(self):
+        cands = list(enumerate_candidates(8, seq_len=6))
+        sp = [c for c in cands if c.seq_parallel]
+        assert all(c.tp in (2,) or 6 % c.tp == 0 for c in sp)
+        assert not any(c.tp == 4 and c.seq_parallel for c in cands)
+
+    def test_batch_indivisible_prunes(self):
+        cand = MeshCandidate(dp=4, fsdp=2, tp=1)
+        _, why = specs_for_candidate(cand, {"w": (8, 8)},
+                                     batch_shape=(6, 16))
+        assert why and "not divisible" in why
+
+    def test_indivisible_param_degrades_to_replicated(self):
+        cand = MeshCandidate(dp=1, fsdp=2, tp=4)
+        specs, why = specs_for_candidate(
+            cand, {"x.q_proj.weight": (8, 6)}, batch_shape=(8, 16))
+        assert why is None
+        # out dim 6 % tp=4 → tp dropped; in dim 8 % fsdp=2 ok → kept
+        assert specs["x.q_proj.weight"] == P("fsdp", None)
+
+    def test_llama_template_matches_handwritten(self):
+        cand = MeshCandidate(dp=2, fsdp=2, tp=2)
+        specs, _ = specs_for_candidate(
+            cand, {"model.layers.0.self_attn.q_proj.weight": (64, 64),
+                   "model.embed_tokens.weight": (512, 64),
+                   "model.norm.weight": (64,)})
+        assert specs["model.layers.0.self_attn.q_proj.weight"] == \
+            P("fsdp", "tp")
+        assert specs["model.embed_tokens.weight"] == P("tp", "fsdp")
+        assert specs["model.norm.weight"] == P()
+
+
+# ------------------------------------------------ scorer
+
+class TestScorerMonotonicity:
+    def _trace(self):
+        def f(x, w):
+            return jnp.sum(x @ w)
+        return analysis.trace(f, _aval((64, 256)), _aval((256, 512)),
+                              param_specs={})
+
+    def test_tp_trades_flops_for_allgather(self):
+        tr = self._trace()
+        base, _ = autoshard.score_layout(
+            tr, {"arg1": P()}, {"dp": 1, "fsdp": 1, "tp": 4})
+        tp, _ = autoshard.score_layout(
+            tr, {"arg1": P(None, "tp")}, {"dp": 1, "fsdp": 1, "tp": 4})
+        # column-parallel: per-device flops shrink...
+        assert tp.compute_s < base.compute_s
+        # ...but the zero-collective base stays zero while fsdp-style
+        # gathers appear once the weight is sharded on the contraction
+        zero3, _ = autoshard.score_layout(
+            tr, {"arg1": P("fsdp", None)}, {"dp": 1, "fsdp": 4, "tp": 1})
+        assert base.collective_bytes == 0
+        assert zero3.collective_bytes > 0          # weight all-gather
+
+    def test_dp_scales_compute_down(self):
+        tr = self._trace()
+        one, _ = autoshard.score_layout(
+            tr, {}, {"dp": 1, "fsdp": 1, "tp": 1}, P(("dp", "fsdp")))
+        eight, _ = autoshard.score_layout(
+            tr, {}, {"dp": 8, "fsdp": 1, "tp": 1}, P(("dp", "fsdp")))
+        assert eight.compute_s < one.compute_s
+        assert eight.memory_s < one.memory_s
+
+
+# ------------------------------------------------ peak-HBM helper
+
+class TestEstimatePeakHbm:
+    def test_plain_fn_sharding_shrinks_arguments(self):
+        from paddle_tpu.distributed.planner import estimate_peak_hbm
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("dp", "tp"))
+
+        def f(x, w):
+            return x @ w
+
+        x = _aval((64, 1024))
+        w = _aval((1024, 1024))
+        rep = estimate_peak_hbm(f, [None, None], mesh, x, w)
+        shard = estimate_peak_hbm(f, [P("dp", None), P(None, "tp")],
+                                  mesh, x, w)
+        assert rep > 0 and shard > 0
+        assert shard < rep
+
+
+# ------------------------------------------------ llama 8-device harness
+
+@pytest.fixture(scope="module")
+def llama_step():
+    pp.seed(0)
+    cfg = LlamaConfig.tiny()
+    model = LlamaForCausalLM(cfg)
+    opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = {"input_ids": jax.ShapeDtypeStruct((8, 16), jnp.int32),
+             "labels": jax.ShapeDtypeStruct((8, 16), jnp.int32)}
+    return cfg, model, step, batch
+
+
+class TestPlanLlama:
+    def test_plan_beats_or_ties_manual(self, llama_step):
+        cfg, model, step, batch = llama_step
+        manual = LlamaForCausalLM.partition_specs(cfg, fsdp_axis="fsdp")
+        res = autoshard.plan(step, batch, n_devices=8,
+                             manual_specs=manual,
+                             manual_mesh_shape={"dp": 2, "fsdp": 2,
+                                                "tp": 2})
+        assert res.plans
+        assert res.manual is not None
+        assert res.beats_manual() is True
+        assert res.top.score.step_seconds <= res.manual.step_seconds
+
+    def test_emitted_plans_roundtrip_checker_clean(self, llama_step):
+        _, _, step, batch = llama_step
+        res = autoshard.plan(step, batch, n_devices=8, topk=3)
+        for p in res.plans:
+            rep = p.verify(step, batch)
+            assert not rep.errors() and not rep.warnings(), (
+                p.candidate.label + "\n" + rep.format())
+
+    def test_plan_is_deterministic(self, llama_step):
+        _, _, step, batch = llama_step
+        a = autoshard.plan(step, batch, n_devices=8)
+        b = autoshard.plan(step, batch, n_devices=8)
+        assert a.top.candidate == b.top.candidate
+        assert a.top.score.step_seconds == b.top.score.step_seconds
+        assert a.top.param_specs == b.top.param_specs
+        assert [s.candidate.label for s in a.scored] == \
+            [s.candidate.label for s in b.scored]
+
+    def test_table_renders(self, llama_step):
+        _, _, step, batch = llama_step
+        res = autoshard.plan(step, batch, n_devices=8)
+        t = res.table()
+        assert "pred ms" in t and "<- emit" in t
+
+    def test_plan_runs_through_trainstep_shardings(self, llama_step):
+        cfg, model, step, batch = llama_step
+        res = autoshard.plan(step, batch, n_devices=8)
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        planned = TrainStep(model, opt, shardings=res.top)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, cfg.vocab_size, (8, 17))
+        l0 = planned({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+        l1 = planned({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+        assert np.isfinite(float(l0)) and float(l1) < float(l0)
+
+    def test_hbm_budget_prunes(self, llama_step):
+        _, _, step, batch = llama_step
+        res = autoshard.plan(step, batch, n_devices=8, hbm_gb=1e-6)
+        assert not res.plans
+        assert all(s.pruned for s in res.scored)
+
+
+class TestShardingsArg:
+    def test_trainstep_rejects_pp_plan(self, llama_step):
+        cfg, model, step, batch = llama_step
+        res = autoshard.plan(step, batch, n_devices=8, max_pp=2, topk=20)
+        pp_plans = [p for p in res.plans if p.is_pipeline]
+        if not pp_plans:
+            pytest.skip("no pipeline plan in top-k")
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        with pytest.raises(ValueError, match="PipelineTrainStep"):
+            TrainStep(model, opt, shardings=pp_plans[0])
+
+    def test_trainstep_shardings_dict(self, llama_step):
+        cfg, model, _, _ = llama_step
+        from jax.sharding import NamedSharding
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("dp", "fsdp", "tp"))
+        rules = LlamaForCausalLM.partition_specs(cfg, fsdp_axis="fsdp")
+        sh = {n: NamedSharding(mesh, LlamaForCausalLM.spec_for(n, rules))
+              for n in model.state_dict(keep_vars=True)}
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        step = TrainStep(model, opt, shardings=sh)
+        assert step.mesh is mesh or step._param_sh is not None
+        rng = np.random.default_rng(1)
+        ids = rng.integers(0, cfg.vocab_size, (8, 17))
+        loss = step({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+        assert np.isfinite(float(loss))
+
+    def test_shardings_bad_type_raises(self, llama_step):
+        cfg, model, _, _ = llama_step
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        with pytest.raises(TypeError):
+            TrainStep(model, opt, shardings=42)
+
+    def test_to_static_with_plan(self, llama_step):
+        cfg, model, step, batch = llama_step
+        from paddle_tpu.jit import to_static
+        res = autoshard.plan(step, batch, n_devices=8)
+        fn = to_static(model, shardings=res.top)
+        ids = pp.Tensor(np.zeros((8, 16), np.int32))
+        out = fn(ids)
+        ref = to_static(model)(ids)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), atol=2e-4)
+
+
+class TestAutoshardPass:
+    def test_registered_and_reports_current_layout(self, llama_step):
+        cfg, model, _, batch = llama_step
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                    ("dp", "fsdp", "tp"))
+        rules = LlamaForCausalLM.partition_specs(cfg, fsdp_axis="fsdp")
+        specs = {n: LlamaForCausalLM.spec_for(n, rules)
+                 for n in model.state_dict(keep_vars=True)}
+        opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+        from jax.sharding import PartitionSpec
+        step = TrainStep(model, opt, mesh=mesh, param_specs=specs,
+                         batch_spec=PartitionSpec(("dp", "fsdp")))
+        rep = analysis.check(step, batch, passes=["autoshard"],
+                             options={"autoshard_search": 8})
+        msgs = [d.message for d in rep.by_pass("autoshard")]
+        assert any("current layout" in m for m in msgs)
+        assert any("best 8-device layout" in m for m in msgs)
+        assert "autoshard_plans" in rep.extras
+        assert rep.extras["autoshard_current"].step_seconds > 0
+
+    def test_not_in_default_pipeline(self):
+        from paddle_tpu.analysis.passes import DEFAULT_PASSES, get_pass
+        assert "autoshard" not in DEFAULT_PASSES
+        assert get_pass("autoshard") is not None
+
+
+class TestAutoshardCLI:
+    def test_cli_plans_and_beats_manual(self, capsys):
+        from paddle_tpu.analysis.lint import main
+        rc = main(["paddle_tpu.models.llama:LlamaForCausalLM",
+                   "--init", "LlamaConfig.tiny()",
+                   "--spec", "int32[8,16]",
+                   "--autoshard", "--mesh-devices", "8",
+                   "--assert-beats-manual"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "ranked plans" in out
+        assert "round-trip: clean" in out
+        assert "planner wins or ties" in out
