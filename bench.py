@@ -196,6 +196,7 @@ def main(argv=None):
     from paddle_tpu.observability import default_registry
     from paddle_tpu.ops.pallas.cross_entropy import fused_ce_enabled
     from paddle_tpu.ops.pallas.flash_attention import flash_bwd_env
+    from paddle_tpu.ops.pallas.fused_block import fused_block_enabled
 
     def _series(name):
         m = default_registry().get(name)
@@ -209,6 +210,12 @@ def main(argv=None):
         "flash_bwd": "pallas" if pb else ("blockwise" if pb is not None
                                          else "blockwise(default)"),
         "flash_bwd_traces": _series("paddle_tpu_flash_bwd_path_total"),
+        # which block segments this run compiled fused vs reference, and
+        # whether tuned block sizes came from the persistent cache —
+        # BENCH trajectories can attribute wins to the exact code path
+        "fused_block_enabled": bool(fused_block_enabled()),
+        "fused_block_traces": _series("paddle_tpu_fused_block_path_total"),
+        "autotune_cache": _series("paddle_tpu_autotune_cache_total"),
         "accum_steps": accum,
         "device_prefetch": True,
     }

@@ -515,8 +515,11 @@ class Propagator:
 
     def _pallas(self, eqn, env, in_pl, in_shapes, where, weight, record):
         # pass-through: a kernel's output adopts the placement of a
-        # shape/dtype-matched input (flash-attention o ~ q); nothing is
-        # invented for mismatched shapes
+        # shape/dtype-matched input (flash-attention o ~ q); a
+        # projection-style output (fused rmsnorm+QKV q/k/v, fused MLP y
+        # — same leading dims, different trailing dim) inherits the
+        # leading-dim placement of the matching input and leaves the
+        # projected dim unplaced; nothing is invented otherwise
         for o in eqn.outvars:
             o_shape = tuple(getattr(o.aval, "shape", ()))
             o_dtype = getattr(o.aval, "dtype", None)
@@ -526,6 +529,14 @@ class Propagator:
                         and getattr(v.aval, "dtype", None) == o_dtype:
                     self._set(env, o, pl)
                     break
+            else:
+                for v, pl in zip(eqn.invars, in_pl):
+                    v_shape = tuple(getattr(v.aval, "shape", ()))
+                    if pl is not None and len(v_shape) == len(o_shape) \
+                            and len(o_shape) >= 2 \
+                            and v_shape[:-1] == o_shape[:-1]:
+                        self._set(env, o, tuple(pl[:-1]) + (None,))
+                        break
         return None
 
     def _default(self, eqn, env, in_pl, in_shapes, where, record):

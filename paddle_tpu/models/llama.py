@@ -94,10 +94,19 @@ class LlamaAttention(Layer):
 
     def forward(self, x, rope_cos, rope_sin, attn_mask=None, cache=None,
                 position_offset=0):
-        b, s = x.shape[0], x.shape[1]
-        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
-        k = M.reshape(self.k_proj(x), [b, s, self.num_kv_heads, self.head_dim])
-        v = M.reshape(self.v_proj(x), [b, s, self.num_kv_heads, self.head_dim])
+        return self.attend(self.q_proj(x), self.k_proj(x), self.v_proj(x),
+                           rope_cos, rope_sin, attn_mask, cache,
+                           position_offset)
+
+    def attend(self, q, k, v, rope_cos, rope_sin, attn_mask=None,
+               cache=None, position_offset=0):
+        """Everything after the projections (RoPE, cache, sdpa, o_proj)
+        — split out so the decoder layer's fused rmsnorm+QKV path can
+        feed projections straight from the Pallas kernel."""
+        b, s = q.shape[0], q.shape[1]
+        q = M.reshape(q, [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(k, [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(v, [b, s, self.num_kv_heads, self.head_dim])
         q = F.apply_rotary_emb(q, rope_cos, rope_sin, position_offset)
         k = F.apply_rotary_emb(k, rope_cos, rope_sin, position_offset)
         new_cache = None
@@ -131,8 +140,39 @@ class LlamaAttention(Layer):
         return out
 
 
+def _rows(shape):
+    n = 1
+    for dim in shape[:-1]:
+        n *= int(dim)
+    return n
+
+
+def _fused_norm_qkv(layer, x):
+    """(q, k, v) via the fused rmsnorm+QKV Pallas kernel when the
+    PADDLE_TPU_FUSED_BLOCK knob and the shapes allow; None → caller
+    takes the reference (unfused) path.  The routing decision happens
+    at trace time, so PADDLE_TPU_FUSED_BLOCK=0 reproduces the previous
+    jaxpr exactly."""
+    from paddle_tpu.ops.pallas import fused_block as FB
+    attn = layer.self_attn
+    d = int(x.shape[-1])
+    dq = attn.num_heads * attn.head_dim
+    dkv = attn.num_kv_heads * attn.head_dim
+    fused = FB.fused_block_enabled() and \
+        FB.fused_qkv_eligible(_rows(x.shape), d, dq, dkv, dkv, x.dtype)
+    FB.record_path("rmsnorm_qkv", fused)
+    if not fused:
+        return None
+    return F.fused_rmsnorm_qkv(
+        x, layer.input_layernorm.weight, attn.q_proj.weight,
+        attn.k_proj.weight, attn.v_proj.weight,
+        epsilon=layer.input_layernorm._epsilon)
+
+
 class LlamaMLP(Layer):
-    """SwiGLU: down(silu(gate(x)) * up(x))."""
+    """SwiGLU: down(silu(gate(x)) * up(x)) — routed through the fused
+    Pallas MLP kernel (hidden intermediate VMEM-resident) behind
+    PADDLE_TPU_FUSED_BLOCK; reference matmul chain otherwise."""
 
     def __init__(self, config: LlamaConfig):
         super().__init__(dtype=config.dtype)
@@ -145,6 +185,15 @@ class LlamaMLP(Layer):
                                 bias_attr=False)
 
     def forward(self, x):
+        from paddle_tpu.ops.pallas import fused_block as FB
+        d = int(x.shape[-1])
+        f = int(self.gate_proj.weight.shape[-1])
+        fused = FB.fused_block_enabled() and \
+            FB.fused_mlp_eligible(_rows(x.shape), d, f, x.dtype)
+        FB.record_path("mlp", fused)
+        if fused:
+            return F.fused_mlp(x, self.gate_proj.weight,
+                               self.up_proj.weight, self.down_proj.weight)
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 
@@ -160,8 +209,13 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, rope_cos, rope_sin, attn_mask=None, cache=None,
                 position_offset=0):
-        h = self.self_attn(self.input_layernorm(x), rope_cos, rope_sin,
-                           attn_mask, cache, position_offset)
+        qkv = _fused_norm_qkv(self, x)
+        if qkv is not None:
+            h = self.self_attn.attend(*qkv, rope_cos, rope_sin,
+                                      attn_mask, cache, position_offset)
+        else:
+            h = self.self_attn(self.input_layernorm(x), rope_cos, rope_sin,
+                               attn_mask, cache, position_offset)
         new_cache = None
         if cache is not None:
             h, new_cache = h

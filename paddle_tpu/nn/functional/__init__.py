@@ -5,6 +5,7 @@ from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.attention import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.conv import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.fused import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.norm import *  # noqa: F401,F403
 from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403

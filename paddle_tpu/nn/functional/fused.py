@@ -1,0 +1,40 @@
+"""Fused transformer-block functionals (reference role: the
+``fused_attention`` / ``fused_feedforward`` / ``fused_bias_act`` python
+APIs over phi/kernels/fusion) — thin dispatch wrappers over the Pallas
+megakernels in ``ops/pallas/fused_block.py``.
+
+These are the user-facing entry points; the llama decoder block and
+``nn.Transformer`` layers route through them automatically behind
+``PADDLE_TPU_FUSED_BLOCK`` (see the module docstring there for the
+VMEM-residency design and the knob semantics)."""
+
+from __future__ import annotations
+
+from paddle_tpu.core.dispatch import eager_op
+from paddle_tpu.ops.pallas import fused_block as _FB
+
+__all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn"]
+
+
+@eager_op
+def fused_rmsnorm_qkv(x, norm_weight, wq, wk, wv, epsilon=1e-5):
+    """``q, k, v = (rmsnorm(x) * norm_weight) @ (wq | wk | wv)`` — the
+    normalized activations never round-trip HBM (single Pallas pass on
+    TPU, reference math elsewhere/ineligible).  Differentiable wrt all
+    array inputs."""
+    return _FB.fused_rmsnorm_qkv(x, norm_weight, wq, wk, wv,
+                                 epsilon=epsilon)
+
+
+@eager_op
+def fused_mlp(x, w_gate, w_up, w_down, activation="silu"):
+    """SwiGLU ``down(act(gate(x)) * up(x))`` with the hidden
+    intermediate VMEM-resident."""
+    return _FB.fused_mlp(x, w_gate, w_up, w_down, activation=activation)
+
+
+@eager_op
+def fused_ffn(x, w1, w2, b1=None, b2=None, activation="relu"):
+    """Classic feed-forward ``act(x @ w1 + b1) @ w2 + b2`` with the
+    hidden intermediate VMEM-resident (non-gated :func:`fused_mlp`)."""
+    return _FB.fused_ffn(x, w1, w2, b1=b1, b2=b2, activation=activation)

@@ -22,6 +22,31 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "TransformerDecoder", "Transformer"]
 
 
+def _ffn_forward(layer, x, act_name, dropout_layer):
+    """linear1 → act → (act-)dropout → linear2, routed through the
+    fused Pallas feed-forward kernel (hidden intermediate
+    VMEM-resident, ops/pallas/fused_block.py) behind
+    PADDLE_TPU_FUSED_BLOCK when the activation is supported, dropout is
+    inactive and the shapes tile; the reference chain otherwise — with
+    the knob off the previous jaxpr is reproduced exactly."""
+    from paddle_tpu.ops.pallas import fused_block as FB
+    rows = 1
+    for dim in x.shape[:-1]:
+        rows *= int(dim)
+    fused = (FB.fused_block_enabled()
+             and act_name in FB.SUPPORTED_ACTS
+             and (not layer.training or dropout_layer.p == 0)
+             and FB.fused_mlp_eligible(rows, int(x.shape[-1]),
+                                       int(layer.linear1.weight.shape[-1]),
+                                       x.dtype))
+    FB.record_path("ffn", fused)
+    if fused:
+        return F.fused_ffn(x, layer.linear1.weight, layer.linear2.weight,
+                           layer.linear1.bias, layer.linear2.bias,
+                           activation=act_name)
+    return layer.linear2(dropout_layer(layer._act(layer.linear1(x))))
+
+
 class MultiHeadAttention(Layer):
     Cache = tuple
 
@@ -91,6 +116,7 @@ class TransformerEncoderLayer(Layer):
         self.dropout2 = Dropout(
             act_dropout if act_dropout is not None else dropout)
         self._act = getattr(F, activation)
+        self._act_name = activation
 
     def forward(self, src, src_mask=None, cache=None):
         residual = src
@@ -104,7 +130,7 @@ class TransformerEncoderLayer(Layer):
             x = self.norm1(x)
         residual = x
         y = self.norm2(x) if self.normalize_before else x
-        y = self.linear2(self.dropout2(self._act(self.linear1(y))))
+        y = _ffn_forward(self, y, self._act_name, self.dropout2)
         y = residual + self.dropout(y)
         if not self.normalize_before:
             y = self.norm2(y)
@@ -155,6 +181,7 @@ class TransformerDecoderLayer(Layer):
         self.dropout3 = Dropout(
             act_dropout if act_dropout is not None else dropout)
         self._act = getattr(F, activation)
+        self._act_name = activation
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
@@ -172,7 +199,7 @@ class TransformerDecoderLayer(Layer):
             y = self.norm2(y)
         residual = y
         z = self.norm3(y) if self.normalize_before else y
-        z = self.linear2(self.dropout3(self._act(self.linear1(z))))
+        z = _ffn_forward(self, z, self._act_name, self.dropout3)
         z = residual + self.dropout(z)
         if not self.normalize_before:
             z = self.norm3(z)

@@ -571,6 +571,26 @@ def llama_step_segments(model, batch: Dict[str, Any],
     def mlp_fn(p, h):
         return unwrap(functional_call(layer0.mlp, p, h))
 
+    def norm_qkv_fn(ps, h):
+        # the fusion boundary ROADMAP-2 targets: input rmsnorm + the
+        # three projections, routed exactly like the decoder layer
+        # (fused Pallas kernel when PADDLE_TPU_FUSED_BLOCK allows) —
+        # flip the knob between profiler runs for before/after numbers
+        pn, pa = ps
+        from paddle_tpu.ops.pallas import fused_block as FB
+        wq, wk, wv = (pa["q_proj.weight"], pa["k_proj.weight"],
+                      pa["v_proj.weight"])
+        rows = 1
+        for dim in h.shape[:-1]:
+            rows *= int(dim)
+        if FB.fused_block_enabled() and FB.fused_qkv_eligible(
+                rows, int(h.shape[-1]), int(wq.shape[-1]),
+                int(wk.shape[-1]), int(wv.shape[-1]), h.dtype):
+            return FB.fused_rmsnorm_qkv(h, pn["weight"], wq, wk, wv,
+                                        epsilon=cfg.rms_norm_eps)
+        xn = unwrap(functional_call(layer0.input_layernorm, pn, h))
+        return xn @ wq, xn @ wk, xn @ wv
+
     def block_fn(p, h, c, si):
         return unwrap(functional_call(layer0, p, h, c, si))
 
@@ -583,6 +603,8 @@ def llama_step_segments(model, batch: Dict[str, Any],
     segs = [
         Segment("embed", embed_fn, (embed_p, ids), count=1, group="memory"),
         Segment("rmsnorm", rmsnorm_fn, (norm_p, x), count=2 * L + 1),
+        Segment("rmsnorm_qkv", norm_qkv_fn, ((norm_p, attn_p), x),
+                count=L, group="fused_boundary"),
         Segment("attention", attn_fn, (attn_p, x, cos, sin), count=L),
         Segment("mlp", mlp_fn, (mlp_p, x), count=L),
         Segment("decoder_block", block_fn, (block_p, x, cos, sin),

@@ -1,0 +1,658 @@
+"""Transformer-block megakernels — fused rmsnorm+QKV and fused MLP.
+
+Reference parity: the block-level fusion ops the reference keeps in
+``phi/kernels/fusion`` (``fused_attention_op.cu`` front half,
+``fused_bias_act`` / ``fused_gate_attention``); MPK-style
+mega-kernelization (PAPERS.md) applied to the two segments PR 6's
+roofline-gap attribution ranks highest once flash attention and the
+fused lm-head CE are in place:
+
+* ``fused_rmsnorm_qkv`` — RMSNorm statistics and the normalized
+  activations are computed once per token block in VMEM and consumed by
+  the q/k/v projections without ever round-tripping HBM.  The unfused
+  lowering writes the normalized ``[T, d]`` activations and reads them
+  back three times; here they live in a VMEM scratch for the lifetime
+  of the token block.  Grid: (token_blocks, out_blocks) with the out
+  axis walking q's, then k's, then v's column blocks — each weight
+  block-spec clamps its index so a block is DMA'd exactly once.
+
+* ``fused_mlp`` — SwiGLU (``down(silu(gate(x)) * up(x))``) with the
+  ``[T, f]`` hidden intermediate VMEM-resident: the f axis is the inner
+  grid dimension; each step computes a ``[bt, bf]`` gate/up tile, the
+  activation product, and accumulates its contribution to the down
+  projection into a ``[bt, d]`` fp32 scratch.  Neither ``gate(x)``,
+  ``up(x)`` nor their product ever exists in HBM.  ``fused_ffn`` is the
+  non-gated variant (``act(x@w1 + b1) @ w2 + b2``) for the classic
+  Transformer encoder/decoder feed-forward.
+
+All three carry custom VJPs: the backward recomputes the cheap
+forward intermediates from the saved inputs (rmsnorm scale, gate/up
+activations) in plain jax — XLA fuses those chains well, and the HBM
+win lives in the forward, which inference/serving runs alone.
+
+Numerics: norm statistics, activation math and all matmul
+accumulation in fp32 (``preferred_element_type``) regardless of the
+io dtype, mirroring the rest of the Pallas layer.
+
+Env knobs:
+  PADDLE_TPU_FUSED_BLOCK=1|0  force-enable (interpret off-TPU) /
+                              disable; unset = auto (TPU backend only)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only; tests on CPU use interpret mode
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_TPU_PL = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAVE_TPU_PL = False
+
+__all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn",
+           "fused_block_enabled", "fused_qkv_eligible",
+           "fused_mlp_eligible", "record_path", "SUPPORTED_ACTS"]
+
+_ACT = {
+    "silu": jax.nn.silu,
+    # exact erf form — matches F.gelu (jax.nn.gelu defaults to tanh)
+    "gelu": functools.partial(jax.nn.gelu, approximate=False),
+    "relu": jax.nn.relu,
+}
+SUPPORTED_ACTS = tuple(_ACT)
+
+
+def fused_block_enabled() -> bool:
+    """Routing gate: env wins, else auto = TPU backend only (interpret
+    mode off-TPU is for tests, not the hot path)."""
+    env = os.environ.get("PADDLE_TPU_FUSED_BLOCK", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return False
+    if env in ("1", "true", "on", "yes"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _row_quantum(dtype) -> int:
+    """Min sublane tile: 8 rows for 4-byte dtypes, 16 for 16-bit."""
+    s = str(dtype)
+    return 16 if ("bfloat16" in s or "float16" in s) else 8
+
+
+def fused_qkv_eligible(t: int, d: int, dq: int, dk: int, dv: int,
+                       dtype="float32") -> bool:
+    """Shape gate: feature dims must tile the 128-lane VPU/MXU; the
+    token axis must tile the dtype's sublane minimum (serving decode
+    with t = batch falls back to the reference path)."""
+    q = _row_quantum(dtype)
+    return (t >= q and t % q == 0 and d % 128 == 0 and
+            dq % 128 == 0 and dk % 128 == 0 and dv % 128 == 0)
+
+
+def fused_mlp_eligible(t: int, d: int, f: int, dtype="float32") -> bool:
+    q = _row_quantum(dtype)
+    return t >= q and t % q == 0 and d % 128 == 0 and f % 128 == 0
+
+
+def _path_counter():
+    from paddle_tpu.observability import default_registry
+    return default_registry().counter(
+        "paddle_tpu_fused_block_path_total",
+        "fused-block kernel routing chosen at trace time",
+        labelnames=("kernel", "path"))
+
+
+def record_path(kernel: str, fused: bool):
+    """Trace-time telemetry: which implementation this compile will run
+    (same idiom as the flash-attention backward path counter)."""
+    _path_counter().labels(
+        kernel=kernel, path="fused" if fused else "reference").inc()
+
+
+# ---------------------------------------------------------------------------
+# fused rmsnorm + QKV projection
+# ---------------------------------------------------------------------------
+
+def _qkv_kernel(x_ref, wn_ref, wq_ref, wk_ref, wv_ref, *out_refs, eps, nq,
+                nk, residuals):
+    """Grid: (token_blocks, out_blocks); the out axis is innermost
+    (sequential) so the normalized activations computed at j == 0 stay
+    in VMEM scratch for every projection block of the token block.
+    With ``residuals`` the normalized activations and the inverse rms
+    are also emitted (once, at j == 0) for the custom VJP — the
+    forward-only (inference) variant keeps the pure
+    one-read/three-write form."""
+    if residuals:
+        q_ref, k_ref, v_ref, xn_out_ref, inv_ref, xn_ref = out_refs
+    else:
+        q_ref, k_ref, v_ref, xn_ref = out_refs
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _norm():
+        xf = x_ref[:].astype(jnp.float32)
+        ms = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(ms + eps)
+        xn_ref[:] = (xf * inv) * wn_ref[:].astype(jnp.float32)
+        if residuals:
+            xn_out_ref[:] = xn_ref[:].astype(xn_out_ref.dtype)
+            inv_ref[:] = inv
+
+    def _proj(w_ref, o_ref):
+        o_ref[:] = jax.lax.dot_general(
+            xn_ref[:].astype(w_ref.dtype), w_ref[:],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when(j < nq)
+    def _q():
+        _proj(wq_ref, q_ref)
+
+    @pl.when(jnp.logical_and(j >= nq, j < nq + nk))
+    def _k():
+        _proj(wk_ref, k_ref)
+
+    @pl.when(j >= nq + nk)
+    def _v():
+        _proj(wv_ref, v_ref)
+
+
+def _qkv_pallas(x2d, wn, wq, wk, wv, *, eps, block_t, block_o, interpret,
+                residuals):
+    t, d = x2d.shape
+    dq, dk, dv = wq.shape[1], wk.shape[1], wv.shape[1]
+    nt = t // block_t
+    nq, nkb, nvb = dq // block_o, dk // block_o, dv // block_o
+
+    # each weight/output spec clamps the out-axis index into its own
+    # range: while j walks another projection's blocks the index map
+    # returns the previous value, so Mosaic re-uses the resident block
+    # instead of issuing a DMA — every block is fetched/flushed once
+    def _clamped(lo, n):
+        return lambda i, j: (0, jnp.clip(j - lo, 0, n - 1))
+
+    def _clamped_out(lo, n):
+        return lambda i, j: (i, jnp.clip(j - lo, 0, n - 1))
+
+    out_specs = [
+        pl.BlockSpec((block_t, block_o), _clamped_out(0, nq)),
+        pl.BlockSpec((block_t, block_o), _clamped_out(nq, nkb)),
+        pl.BlockSpec((block_t, block_o), _clamped_out(nq + nkb, nvb)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((t, dq), x2d.dtype),
+        jax.ShapeDtypeStruct((t, dk), x2d.dtype),
+        jax.ShapeDtypeStruct((t, dv), x2d.dtype),
+    ]
+    if residuals:
+        out_specs += [pl.BlockSpec((block_t, d), lambda i, j: (i, 0)),
+                      pl.BlockSpec((block_t, 1), lambda i, j: (i, 0))]
+        out_shape += [jax.ShapeDtypeStruct((t, d), x2d.dtype),
+                      jax.ShapeDtypeStruct((t, 1), jnp.float32)]
+
+    params = {}
+    if _HAVE_TPU_PL and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+
+    return pl.pallas_call(
+        functools.partial(_qkv_kernel, eps=eps, nq=nq, nk=nkb,
+                          residuals=residuals),
+        grid=(nt, nq + nkb + nvb),
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, d), lambda i, j: (0, 0)),
+            pl.BlockSpec((d, block_o), _clamped(0, nq)),
+            pl.BlockSpec((d, block_o), _clamped(nq, nkb)),
+            pl.BlockSpec((d, block_o), _clamped(nq + nkb, nvb)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((block_t, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(x2d, wn.reshape(1, d), wq, wk, wv)
+
+
+def _qkv_reference(x2d, wn, wq, wk, wv, eps, residuals=False):
+    xf = x2d.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    xn = ((xf * inv) * wn.astype(jnp.float32)).astype(x2d.dtype)
+
+    def proj(w):
+        return jax.lax.dot_general(
+            xn, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(x2d.dtype)
+
+    out = (proj(wq), proj(wk), proj(wv))
+    return out + (xn, inv) if residuals else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _qkv_core(x2d, wn, wq, wk, wv, eps, use_pallas, interpret,
+              block_t, block_o):
+    # primal (forward-only) path: no residual outputs — inference keeps
+    # the pure one-read/three-write kernel
+    if use_pallas:
+        return tuple(_qkv_pallas(x2d, wn, wq, wk, wv, eps=eps,
+                                 block_t=block_t, block_o=block_o,
+                                 interpret=interpret, residuals=False))
+    return _qkv_reference(x2d, wn, wq, wk, wv, eps)
+
+
+def _qkv_fwd(x2d, wn, wq, wk, wv, eps, use_pallas, interpret,
+             block_t, block_o):
+    # differentiated path: the kernel additionally emits the normalized
+    # activations and the inverse rms (flash-attention saved-lse style),
+    # so the backward never recomputes the norm chain
+    if use_pallas:
+        q, k, v, xn, inv = _qkv_pallas(
+            x2d, wn, wq, wk, wv, eps=eps, block_t=block_t,
+            block_o=block_o, interpret=interpret, residuals=True)
+    else:
+        q, k, v, xn, inv = _qkv_reference(x2d, wn, wq, wk, wv, eps,
+                                          residuals=True)
+    return (q, k, v), (x2d, wn, wq, wk, wv, xn, inv)
+
+
+def _qkv_bwd(eps, use_pallas, interpret, block_t, block_o, res, cts):
+    # mixed-precision discipline matches what autodiff of the unfused
+    # chain produces: matmuls accumulate fp32 on the MXU but cotangents
+    # materialize in the io dtype (bf16 in training) — only the fused
+    # rmsnorm-backward elementwise chain runs fp32, and XLA fuses it
+    x2d, wn, wq, wk, wv, xn, inv = res
+    dq, dk, dv = cts
+    dt = x2d.dtype
+    wnf = wn.astype(jnp.float32)
+
+    def back(g, w):                                     # g @ w.T
+        return jax.lax.dot_general(g, w, (((1,), (1,)), ((), ())))
+
+    def wgrad(g):                                       # xn.T @ g, fp32
+        return jax.lax.dot_general(
+            xn, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dxn = (back(dq, wq) + back(dk, wk) + back(dv, wv)) \
+        .astype(jnp.float32)                            # [T, d]
+    dwq = wgrad(dq).astype(wq.dtype)
+    dwk = wgrad(dk).astype(wk.dtype)
+    dwv = wgrad(dv).astype(wv.dtype)
+    xf = x2d.astype(jnp.float32)
+    xhat = xf * inv                                     # saved inv: no
+    dwn = jnp.sum(dxn * xhat, axis=0).astype(wn.dtype)  # stat recompute
+    # rmsnorm backward (same equations as ops/pallas/rmsnorm.py):
+    # dx = inv * g - x * inv^3 * mean(g * x), with g = dxn * w
+    gx = dxn * wnf
+    dot = jnp.mean(gx * xf, axis=-1, keepdims=True)
+    dx = (inv * gx - xf * (inv ** 3) * dot).astype(dt)
+    return dx, dwn, dwq, dwk, dwv
+
+
+_qkv_core.defvjp(_qkv_fwd, _qkv_bwd)
+
+
+def _default_qkv_blocks(t, d, dq, dk, dv, dtype):
+    """Heuristic fallback: the first (token, out) block pair — widest
+    out block first, then tallest token block — whose working set (x +
+    fp32 normalized scratch + weight/out blocks, double-buffered io)
+    stays under ~10 MB of VMEM."""
+    itemsize = 2 if "bfloat16" in dtype or "float16" in dtype else 4
+    # 16-bit dtypes tile (16, 128): never offer an 8-row block there
+    bts = (512, 256, 128, 64, 32, 16) if itemsize == 2 else \
+        (512, 256, 128, 64, 32, 16, 8)
+    for bo in (512, 256, 128):
+        if dq % bo or dk % bo or dv % bo:
+            continue
+        for bt in bts:
+            if t % bt:
+                continue
+            vmem = (2 * bt * d * itemsize        # x, double-buffered
+                    + bt * d * 4                 # fp32 xn scratch
+                    + 6 * d * bo * itemsize      # 3 weight blocks, 2x
+                    + 6 * bt * bo * itemsize)    # 3 out blocks, 2x
+            if vmem < 10 * (1 << 20):
+                return bt, bo
+    return bts[-1], 128
+
+
+def fused_rmsnorm_qkv(x, norm_weight, wq, wk, wv, epsilon: float = 1e-5,
+                      block_t: int = None, block_o: int = None,
+                      interpret: bool = None, autotune: bool = None,
+                      use_pallas: bool = None):
+    """``q, k, v = (rmsnorm(x) * norm_weight) @ (wq | wk | wv)`` in one
+    fused pass — the normalized activations never round-trip HBM.
+
+    x: [..., d]; norm_weight: [d]; wq/wk/wv: [d, dq/dk/dv] (paddle
+    [in, out] layout).  Returns projections with x's leading dims.
+    Differentiable wrt every array input.  Ineligible shapes fall back
+    to reference math inside the same custom VJP (the API is total)."""
+    shape = x.shape
+    d = shape[-1]
+    x2d = x.reshape(-1, d)
+    t = x2d.shape[0]
+    dq, dk, dv = int(wq.shape[-1]), int(wk.shape[-1]), int(wv.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if use_pallas is None:
+        use_pallas = fused_qkv_eligible(t, d, dq, dk, dv, x.dtype)
+    if autotune is None:
+        autotune = not interpret
+    if use_pallas and (block_t is None or block_o is None):
+        if autotune and not interpret:
+            from paddle_tpu.ops.pallas.autotune import qkv_block_sizes
+            bt, bo = qkv_block_sizes(t, d, dq, dk, dv, str(x.dtype))
+        else:
+            bt, bo = _default_qkv_blocks(t, d, dq, dk, dv, str(x.dtype))
+        block_t = block_t or bt
+        block_o = block_o or bo
+    if use_pallas and (t % block_t or dq % block_o or dk % block_o
+                       or dv % block_o):
+        raise ValueError(
+            f"shapes t={t} dq={dq} dk={dk} dv={dv} not divisible by "
+            f"blocks ({block_t}, {block_o})")
+    q, k, v = _qkv_core(x2d, norm_weight, wq, wk, wv, float(epsilon),
+                        bool(use_pallas), bool(interpret),
+                        int(block_t or 0), int(block_o or 0))
+    lead = shape[:-1]
+    return (q.reshape(*lead, dq), k.reshape(*lead, dk),
+            v.reshape(*lead, dv))
+
+
+# ---------------------------------------------------------------------------
+# fused MLP (gated SwiGLU and plain act+bias feed-forward)
+# ---------------------------------------------------------------------------
+
+def _mlp_kernel(*refs, act, gated, has_bias):
+    """Grid: (token_blocks, hidden_blocks); the hidden (f) axis is the
+    innermost (sequential) dim — each step materializes only a
+    [bt, bf] tile of the hidden activations in VMEM and folds it into
+    the fp32 down-projection accumulator."""
+    if gated:
+        x_ref, wg_ref, wu_ref, wd_ref, y_ref, acc_ref = refs
+        bu_ref = bd_ref = None
+    else:
+        x_ref, wu_ref, wd_ref, bu_ref, bd_ref, y_ref, acc_ref = refs
+        wg_ref = None
+    j = pl.program_id(1)
+    nf = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    xb = x_ref[:]
+    u = jax.lax.dot_general(
+        xb, wu_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bt, bf]
+    if has_bias:
+        u = u + bu_ref[:].astype(jnp.float32)
+    if gated:
+        g = jax.lax.dot_general(
+            xb, wg_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        h = _ACT[act](g) * u
+    else:
+        h = _ACT[act](u)
+    acc_ref[:] += jax.lax.dot_general(
+        h.astype(wd_ref.dtype), wd_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)              # [bt, d]
+
+    @pl.when(j == nf - 1)
+    def _finalize():
+        out = acc_ref[:]
+        if has_bias:
+            out = out + bd_ref[:].astype(jnp.float32)
+        y_ref[:] = out.astype(y_ref.dtype)
+
+
+def _mlp_pallas(x2d, weights, biases, *, act, gated, block_t, block_f,
+                interpret):
+    t, d = x2d.shape
+    f = weights[-2].shape[1] if gated else weights[0].shape[1]
+    nt = t // block_t
+    nf = f // block_f
+
+    in_specs = [pl.BlockSpec((block_t, d), lambda i, j: (i, 0))]
+    args = [x2d]
+    for w in weights[:-1]:                               # gate/up: [d, f]
+        in_specs.append(pl.BlockSpec((d, block_f), lambda i, j: (0, j)))
+        args.append(w)
+    in_specs.append(pl.BlockSpec((block_f, d), lambda i, j: (j, 0)))
+    args.append(weights[-1])                             # down: [f, d]
+    if biases is not None:
+        b1, b2 = biases
+        in_specs.append(pl.BlockSpec((1, block_f), lambda i, j: (0, j)))
+        args.append(b1.reshape(1, f))
+        in_specs.append(pl.BlockSpec((1, d), lambda i, j: (0, 0)))
+        args.append(b2.reshape(1, d))
+
+    params = {}
+    if _HAVE_TPU_PL and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"))
+
+    return pl.pallas_call(
+        functools.partial(_mlp_kernel, act=act, gated=gated,
+                          has_bias=biases is not None),
+        grid=(nt, nf),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((block_t, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((t, d), x2d.dtype),
+        scratch_shapes=[pltpu.VMEM((block_t, d), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(*args)
+
+
+def _dot(a, b, contract):
+    return jax.lax.dot_general(a, b, (contract, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _mlp_gated_reference(x2d, wg, wu, wd, act):
+    g = _dot(x2d, wg, ((1,), (0,)))
+    u = _dot(x2d, wu, ((1,), (0,)))
+    h = (_ACT[act](g) * u).astype(x2d.dtype)
+    return _dot(h, wd, ((1,), (0,))).astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _mlp_gated_core(x2d, wg, wu, wd, act, use_pallas, interpret,
+                    block_t, block_f):
+    return _mlp_gated_fwd(x2d, wg, wu, wd, act, use_pallas, interpret,
+                          block_t, block_f)[0]
+
+
+def _mlp_gated_fwd(x2d, wg, wu, wd, act, use_pallas, interpret,
+                   block_t, block_f):
+    if use_pallas:
+        y = _mlp_pallas(x2d, (wg, wu, wd), None, act=act, gated=True,
+                        block_t=block_t, block_f=block_f,
+                        interpret=interpret)
+    else:
+        y = _mlp_gated_reference(x2d, wg, wu, wd, act)
+    return y, (x2d, wg, wu, wd)
+
+
+def _mlp_gated_bwd(act, use_pallas, interpret, block_t, block_f, res, dy):
+    # recompute in the io dtype (matmuls still accumulate fp32 on the
+    # MXU) — the materialized [T, f] intermediates cost the same HBM
+    # bytes autodiff of the unfused bf16 chain would spend
+    x2d, wg, wu, wd = res
+    dt = x2d.dtype
+
+    def dot_t(a, b, contract):      # io-dtype out, fp32 MXU accumulate
+        return jax.lax.dot_general(a, b, (contract, ((), ())))
+
+    g = dot_t(x2d, wg, ((1,), (0,)))                    # recompute
+    u = dot_t(x2d, wu, ((1,), (0,)))
+    s, act_vjp = jax.vjp(_ACT[act], g)
+    h = s * u
+    dh = dot_t(dy, wd, ((1,), (1,)))                    # [T, f]
+    dwd = _dot(h, dy, ((0,), (0,))).astype(wd.dtype)
+    du = dh * s
+    dg = act_vjp(dh * u)[0].astype(dt)
+    dx = dot_t(dg, wg, ((1,), (1,))) + dot_t(du, wu, ((1,), (1,)))
+    dwg = _dot(x2d, dg, ((0,), (0,))).astype(wg.dtype)
+    dwu = _dot(x2d, du, ((0,), (0,))).astype(wu.dtype)
+    return dx.astype(dt), dwg, dwu, dwd
+
+
+_mlp_gated_core.defvjp(_mlp_gated_fwd, _mlp_gated_bwd)
+
+
+def _ffn_reference(x2d, w1, b1, w2, b2, act):
+    u = _dot(x2d, w1, ((1,), (0,))) + b1.astype(jnp.float32)
+    h = _ACT[act](u).astype(x2d.dtype)
+    y = _dot(h, w2, ((1,), (0,))) + b2.astype(jnp.float32)
+    return y.astype(x2d.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ffn_core(x2d, w1, b1, w2, b2, act, use_pallas, interpret,
+              block_t, block_f):
+    return _ffn_fwd(x2d, w1, b1, w2, b2, act, use_pallas, interpret,
+                    block_t, block_f)[0]
+
+
+def _ffn_fwd(x2d, w1, b1, w2, b2, act, use_pallas, interpret,
+             block_t, block_f):
+    if use_pallas:
+        y = _mlp_pallas(x2d, (w1, w2), (b1, b2), act=act, gated=False,
+                        block_t=block_t, block_f=block_f,
+                        interpret=interpret)
+    else:
+        y = _ffn_reference(x2d, w1, b1, w2, b2, act)
+    return y, (x2d, w1, b1, w2, b2)
+
+
+def _ffn_bwd(act, use_pallas, interpret, block_t, block_f, res, dy):
+    x2d, w1, b1, w2, b2 = res
+    dt = x2d.dtype
+    u = (_dot(x2d, w1, ((1,), (0,))) + b1.astype(jnp.float32)).astype(dt)
+    h, act_vjp = jax.vjp(_ACT[act], u)
+    dh = jax.lax.dot_general(dy, w2,
+                             ((((1,), (1,))), ((), ()))).astype(dt)
+    dw2 = _dot(h, dy, ((0,), (0,))).astype(w2.dtype)
+    db2 = jnp.sum(dy.astype(jnp.float32), axis=0).astype(b2.dtype)
+    du = act_vjp(dh)[0].astype(dt)
+    dx = _dot(du, w1, ((1,), (1,))).astype(dt)
+    dw1 = _dot(x2d, du, ((0,), (0,))).astype(w1.dtype)
+    db1 = jnp.sum(du.astype(jnp.float32), axis=0).astype(b1.dtype)
+    return dx, dw1, db1, dw2, db2
+
+
+_ffn_core.defvjp(_ffn_fwd, _ffn_bwd)
+
+
+def _default_mlp_blocks(t, d, f, dtype):
+    """Heuristic fallback: the first (token, hidden) block pair — widest
+    hidden block first, then tallest token block — whose working set (x
+    + y + fp32 accumulator + gate/up/down weight blocks, double-buffered
+    io) stays under ~10 MB of VMEM."""
+    itemsize = 2 if "bfloat16" in dtype or "float16" in dtype else 4
+    # 16-bit dtypes tile (16, 128): never offer an 8-row block there
+    bts = (512, 256, 128, 64, 32, 16) if itemsize == 2 else \
+        (512, 256, 128, 64, 32, 16, 8)
+    for bf in (512, 256, 128):
+        if f % bf:
+            continue
+        for bt in bts:
+            if t % bt:
+                continue
+            vmem = (2 * bt * d * itemsize        # x, double-buffered
+                    + bt * d * 4                 # fp32 accumulator
+                    + 2 * bt * d * itemsize      # y, double-buffered
+                    + 6 * d * bf * itemsize)     # 3 weight blocks, 2x
+            if vmem < 10 * (1 << 20):
+                return bt, bf
+    return bts[-1], 128
+
+
+def _mlp_blocks(t, d, f, dtype, block_t, block_f, interpret, autotune):
+    if block_t is None or block_f is None:
+        if autotune and not interpret:
+            from paddle_tpu.ops.pallas.autotune import mlp_block_sizes
+            bt, bf = mlp_block_sizes(t, d, f, dtype)
+        else:
+            bt, bf = _default_mlp_blocks(t, d, f, dtype)
+        block_t = block_t or bt
+        block_f = block_f or bf
+    if t % block_t or f % block_f:
+        raise ValueError(f"shapes t={t} f={f} not divisible by blocks "
+                         f"({block_t}, {block_f})")
+    return int(block_t), int(block_f)
+
+
+def fused_mlp(x, w_gate, w_up, w_down, activation: str = "silu",
+              block_t: int = None, block_f: int = None,
+              interpret: bool = None, autotune: bool = None,
+              use_pallas: bool = None):
+    """``y = (act(x @ w_gate) * (x @ w_up)) @ w_down`` with the [T, f]
+    hidden intermediate VMEM-resident (SwiGLU when ``activation='silu'``).
+
+    x: [..., d]; w_gate/w_up: [d, f]; w_down: [f, d].  Differentiable
+    wrt every array input; ineligible shapes take reference math inside
+    the same custom VJP."""
+    if activation not in _ACT:
+        raise ValueError(f"unsupported activation {activation!r}; "
+                         f"expected one of {SUPPORTED_ACTS}")
+    shape = x.shape
+    d = shape[-1]
+    x2d = x.reshape(-1, d)
+    t = x2d.shape[0]
+    f = int(w_up.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if use_pallas is None:
+        use_pallas = fused_mlp_eligible(t, d, f, x.dtype)
+    if autotune is None:
+        autotune = not interpret
+    if use_pallas:
+        block_t, block_f = _mlp_blocks(t, d, f, str(x.dtype), block_t,
+                                       block_f, interpret, autotune)
+    y = _mlp_gated_core(x2d, w_gate, w_up, w_down, str(activation),
+                        bool(use_pallas), bool(interpret),
+                        int(block_t or 0), int(block_f or 0))
+    return y.reshape(shape)
+
+
+def fused_ffn(x, w1, w2, b1=None, b2=None, activation: str = "relu",
+              block_t: int = None, block_f: int = None,
+              interpret: bool = None, autotune: bool = None,
+              use_pallas: bool = None):
+    """``y = act(x @ w1 + b1) @ w2 + b2`` — the classic Transformer
+    feed-forward, hidden intermediate VMEM-resident (non-gated variant
+    of :func:`fused_mlp`).  ``b1``/``b2`` may be None."""
+    if activation not in _ACT:
+        raise ValueError(f"unsupported activation {activation!r}; "
+                         f"expected one of {SUPPORTED_ACTS}")
+    shape = x.shape
+    d = shape[-1]
+    x2d = x.reshape(-1, d)
+    t = x2d.shape[0]
+    f = int(w1.shape[-1])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if use_pallas is None:
+        use_pallas = fused_mlp_eligible(t, d, f, x.dtype)
+    if autotune is None:
+        autotune = not interpret
+    if use_pallas:
+        block_t, block_f = _mlp_blocks(t, d, f, str(x.dtype), block_t,
+                                       block_f, interpret, autotune)
+    if b1 is None:
+        b1 = jnp.zeros((f,), x2d.dtype)
+    if b2 is None:
+        b2 = jnp.zeros((int(w2.shape[-1]),), x2d.dtype)
+    y = _ffn_core(x2d, w1, b1, w2, b2, str(activation),
+                  bool(use_pallas), bool(interpret),
+                  int(block_t or 0), int(block_f or 0))
+    return y.reshape(shape)
