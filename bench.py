@@ -69,6 +69,50 @@ def _prev_value():
     return float(prev["value"]) if prev else None
 
 
+def _prev_serve_record():
+    """Parsed payload of the latest BENCH_serve_r*.json — the serving
+    trajectory's newest point (bench_serve.py emits them)."""
+    best_round, best = -1, None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "BENCH_serve_r*.json")):
+        m = re.search(r"BENCH_serve_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or rec   # raw result files too
+            val = parsed.get("value")
+        except Exception:
+            continue
+        if val is not None and int(m.group(1)) > best_round:
+            best_round, best = int(m.group(1)), parsed
+    return best
+
+
+def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
+    """Serving regression check: tokens/s (headline value) is
+    better-higher; TTFT/TPOT p99 latencies are better-lower.  Returns
+    human-readable regression strings (empty = within tolerance).  The
+    default tolerance is wider than training's — serving latency on a
+    shared CI host is noisier than a dedicated chip's step time."""
+    regressions = []
+    pv, cv = prev.get("value"), cur.get("value")
+    if pv and cv is not None and cv < float(pv) * (1.0 - tolerance):
+        regressions.append(
+            f"tokens_per_s {cv:.2f} < prev {float(pv):.2f} - "
+            f"{tolerance:.0%} tolerance (ratio {cv / float(pv):.3f})")
+    pd = prev.get("detail") or {}
+    cd = cur.get("detail") or {}
+    for key in ("ttft_p99_s", "tpot_p99_s"):
+        pl, cl = pd.get(key), cd.get(key)
+        if pl and cl and float(cl) > float(pl) * (1.0 + tolerance):
+            regressions.append(
+                f"{key} {float(cl):.4f} > prev {float(pl):.4f} + "
+                f"{tolerance:.0%} tolerance")
+    return regressions
+
+
 def compare_records(cur: dict, prev: dict, tolerance: float = 0.05):
     """Regression check of a fresh result against a previous BENCH
     payload.  Returns a list of human-readable regression strings
@@ -95,11 +139,36 @@ def main(argv=None):
     ap.add_argument("--compare", action="store_true",
                     help="flag regressions vs the newest BENCH_r*.json "
                          "(exit 1 beyond --tolerance)")
-    ap.add_argument("--tolerance", type=float, default=0.05,
-                    help="relative regression tolerance for --compare")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="relative regression tolerance for --compare "
+                         "(default 0.05; 0.25 for --compare-serve)")
     ap.add_argument("--no-device-profile", action="store_true",
                     help="skip the roofline-gap segment profiling pass")
+    ap.add_argument("--compare-serve", metavar="RESULT_JSON",
+                    help="instead of running the training bench, "
+                         "regression-check a bench_serve.py result file "
+                         "against the newest BENCH_serve_r*.json "
+                         "(TTFT/TPOT p99 + tokens/s, exit 1 beyond "
+                         "--tolerance)")
     args = ap.parse_args(argv)
+
+    if args.compare_serve:
+        with open(args.compare_serve) as f:
+            rec = json.load(f)
+        cur = rec.get("parsed") or rec
+        prev = _prev_serve_record()
+        if prev is None:
+            print(json.dumps({"bench_compare": {
+                "ok": True, "note": "no previous BENCH_serve artifact"}}),
+                file=sys.stderr)
+            return 0
+        tol = 0.25 if args.tolerance is None else args.tolerance
+        regressions = compare_serve_records(cur, prev, tol)
+        print(json.dumps({"bench_compare": {
+            "ok": not regressions, "tolerance": tol,
+            "prev_value": prev.get("value"),
+            "regressions": regressions}}), file=sys.stderr)
+        return 1 if regressions else 0
 
     import jax
 
@@ -278,10 +347,11 @@ def main(argv=None):
                 "ok": True, "note": "no previous BENCH artifact"}}),
                 file=sys.stderr)
             return 0
-        regressions = compare_records(result, prev_rec, args.tolerance)
+        tol = 0.05 if args.tolerance is None else args.tolerance
+        regressions = compare_records(result, prev_rec, tol)
         print(json.dumps({"bench_compare": {
             "ok": not regressions,
-            "tolerance": args.tolerance,
+            "tolerance": tol,
             "prev_value": prev_rec.get("value"),
             "regressions": regressions}}), file=sys.stderr)
         if regressions:

@@ -1,0 +1,298 @@
+"""Serving benchmark: Poisson arrivals over the continuous-batching engine.
+
+Serving joins the benchmark trajectory (training has BENCH_r*.json since
+r02; serving had nothing).  Prints ONE JSON line:
+
+    {"metric": "serving_tokens_per_s", "value", "unit", "detail": {...}}
+
+with TTFT/TPOT p50/p99 under Poisson load, prefix-cache hit counters,
+paged-block utilization, and speculative-decode accept counters in the
+detail payload.  ``--emit`` writes a ``BENCH_serve_r*.json`` artifact so
+``bench.py --compare-serve`` (or ``bench_serve.py --compare``) can guard
+the trajectory the way training's ``--compare`` does.
+
+The workload models the fleet case the paged KV cache exists for: every
+request shares a system-prompt prefix (``--shared-prefix``) and appends
+a short unique suffix, so with ``PADDLE_TPU_PAGED_KV=1`` the prefix
+prefills once and later requests reuse its blocks (watch
+``prefix_hit_tokens``).  ``--check-equivalence`` replays the workload
+through the slot-contiguous engine and asserts token-for-token greedy
+identity — the paged path must be a pure memory/scheduling optimization.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+
+def _percentiles(xs, ps=(50, 99)):
+    if not xs:
+        return {f"p{p}": None for p in ps}
+    return {f"p{p}": float(np.percentile(xs, p)) for p in ps}
+
+
+def _series(name):
+    from paddle_tpu.observability import default_registry
+    m = default_registry().get(name)
+    return {"/".join(k) or "all": c.value() for k, c in m.series()} \
+        if m is not None else {}
+
+
+def _next_serve_round(here):
+    rounds = [int(m.group(1)) for p in
+              glob.glob(os.path.join(here, "BENCH_serve_r*.json"))
+              if (m := re.search(r"BENCH_serve_r(\d+)\.json$", p))]
+    return max(rounds, default=0) + 1
+
+
+def _build_engine(model, args, paged):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(
+        model, slots=args.slots, max_len=args.max_len,
+        prefill_buckets=(args.max_len // 2,),
+        steps_per_sync=args.steps_per_sync if not args.spec else 1,
+        paged_kv=paged,
+        kv_block_size=args.block_size,
+        prefill_chunk=args.chunk,
+        spec_decode=args.spec if paged else 0)
+
+
+def _workload(args, vocab):
+    """(prompts, max_new, arrival_offsets): shared system prefix + unique
+    suffixes, Poisson inter-arrival gaps at --rps."""
+    rng = np.random.default_rng(args.seed)
+    shared = rng.integers(0, vocab, (args.shared_prefix,))
+    prompts = []
+    for _ in range(args.requests):
+        sfx = rng.integers(0, vocab,
+                           (int(rng.integers(2, args.suffix_max + 1)),))
+        prompts.append(np.concatenate([shared, sfx]).astype(np.int32))
+    gaps = rng.exponential(1.0 / args.rps, size=args.requests)
+    arrivals = np.cumsum(gaps)
+    arrivals[0] = 0.0
+    return prompts, arrivals
+
+
+def _run_workload(eng, prompts, arrivals, max_new):
+    """Drive the engine under the arrival schedule (wall clock).
+    Returns (results {rid: tokens}, rids, t_start, t_end)."""
+    from paddle_tpu.robustness import QueueFullError
+    results = {}
+    rids = [None] * len(prompts)
+    waiting = list(range(len(prompts)))
+    t0 = time.perf_counter()
+    while waiting or eng.pending:
+        now = time.perf_counter() - t0
+        while waiting and arrivals[waiting[0]] <= now:
+            i = waiting[0]
+            try:
+                rids[i] = eng.add_request(prompts[i],
+                                          max_new_tokens=max_new)
+                waiting.pop(0)
+            except QueueFullError:
+                break   # shed: retry on a later loop pass
+        if eng.pending:
+            eng.step()
+            for rid, _p, out in eng.finished():
+                results[rid] = out
+        elif waiting:
+            time.sleep(max(0.0, arrivals[waiting[0]] - now))
+    t1 = time.perf_counter()
+    return results, rids, t0, t1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--rps", type=float, default=20.0,
+                    help="Poisson arrival rate")
+    ap.add_argument("--max-new", type=int, default=12)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--shared-prefix", type=int, default=24,
+                    help="system-prompt tokens shared by every request")
+    ap.add_argument("--suffix-max", type=int, default=12)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16,
+                    help="chunked-prefill width (paged mode)")
+    ap.add_argument("--spec", type=int, default=0,
+                    help="n-gram speculative draft length (paged only)")
+    ap.add_argument("--steps-per-sync", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--paged", dest="paged", action="store_true",
+                    default=None, help="force paged KV on "
+                    "(default: PADDLE_TPU_PAGED_KV)")
+    ap.add_argument("--no-paged", dest="paged", action="store_false")
+    ap.add_argument("--check-equivalence", action="store_true",
+                    help="replay through the slot-contiguous engine and "
+                         "assert greedy outputs are identical")
+    ap.add_argument("--emit", metavar="PATH",
+                    help="write the artifact ('auto' → next "
+                         "BENCH_serve_rNN.json beside this script)")
+    ap.add_argument("--compare", action="store_true",
+                    help="regression-check vs the newest "
+                         "BENCH_serve_r*.json (exit 1 beyond tolerance)")
+    ap.add_argument("--tolerance", type=float, default=0.25)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    import paddle_tpu as pp
+    from paddle_tpu.inference.kv_cache import paged_kv_enabled
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paged = paged_kv_enabled() if args.paged is None else args.paged
+    dev = jax.devices()[0]
+    pp.seed(args.seed)
+    if dev.platform == "tpu":
+        # serving-proportioned model that decodes comfortably on one chip
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=3584,
+            num_hidden_layers=8, num_attention_heads=16,
+            num_key_value_heads=8,
+            max_position_embeddings=max(2 * args.max_len, 2048),
+            rope_theta=500000.0, dtype="bfloat16")
+    else:  # CI/CPU smoke
+        cfg = LlamaConfig.tiny(
+            max_position_embeddings=max(args.max_len, 128))
+    model = LlamaForCausalLM(cfg)
+
+    prompts, arrivals = _workload(args, cfg.vocab_size)
+    eng = _build_engine(model, args, paged)
+    # warmup outside the timed window: compile prefill/decode (and let
+    # the paged engine's first request pay the trace) on a throwaway
+    w = eng.add_request(prompts[0][: max(2, len(prompts[0]) // 2)],
+                        max_new_tokens=2)
+    eng.run()
+    eng.request_status(w)
+
+    results, rids, t0, t1 = _run_workload(eng, prompts, arrivals,
+                                          args.max_new)
+
+    ttfts, tpots, total_tokens = [], [], 0
+    reused_tokens = 0.0
+    accept_rates = []
+    for i, rid in enumerate(rids):
+        st = eng.request_status(rid)
+        out = results.get(rid, [])
+        total_tokens += len(out)
+        t = st.timings if st is not None else {}
+        if t.get("ttft_s"):
+            ttfts.append(t["ttft_s"])
+        if t.get("decode_s") and len(out) > 1:
+            tpots.append(t["decode_s"] / (len(out) - 1))
+        reused_tokens += t.get("prefix_tokens_reused", 0.0)
+        if args.spec:
+            accept_rates.append(t.get("speculative_accept_rate", 0.0))
+    wall = t1 - t0
+    tok_s = total_tokens / wall if wall > 0 else 0.0
+    ttft = _percentiles(ttfts)
+    tpot = _percentiles(tpots)
+
+    detail = {
+        "requests": args.requests,
+        "completed": len(results),
+        "rps": args.rps,
+        "wall_s": round(wall, 4),
+        "generated_tokens": total_tokens,
+        "ttft_p50_s": ttft["p50"], "ttft_p99_s": ttft["p99"],
+        "tpot_p50_s": tpot["p50"], "tpot_p99_s": tpot["p99"],
+        "paged": bool(paged),
+        "spec_decode": args.spec,
+        "steps_per_sync": args.steps_per_sync,
+        "shared_prefix": args.shared_prefix,
+        "device": getattr(dev, "device_kind", dev.platform),
+        "prefix_hit_tokens": reused_tokens,
+        "prefix_cache": _series("paddle_tpu_serving_prefix_cache_total"),
+        "spec_tokens": _series("paddle_tpu_serving_spec_tokens_total"),
+        "spec_accept_rate_mean": (float(np.mean(accept_rates))
+                                  if accept_rates else None),
+    }
+    if paged:
+        detail["kv_blocks_total"] = eng._num_blocks - 1
+        detail["kv_blocks_peak_used"] = eng._blocks_used_peak
+        detail["kv_block_utilization"] = round(
+            eng._blocks_used_peak / max(1, eng._num_blocks - 1), 4)
+        detail["kv_events"] = {
+            "evictions": _series("paddle_tpu_serving_kv_evictions_total"),
+            "cow": _series("paddle_tpu_serving_kv_cow_copies_total"),
+            "alloc_failures": _series(
+                "paddle_tpu_serving_kv_alloc_failures_total"),
+        }
+    result = {
+        "metric": "serving_tokens_per_s",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "detail": detail,
+    }
+
+    if args.check_equivalence:
+        # replay sequentially through the slot-contiguous engine: paged
+        # greedy decode must be token-for-token identical
+        base = _build_engine(model, argparse.Namespace(
+            **{**vars(args), "spec": 0}), paged=False)
+        mismatches = 0
+        for i, rid in enumerate(rids):
+            b = base.add_request(prompts[i], max_new_tokens=args.max_new)
+            got = base.run()[b][1]
+            if got != results.get(rid):
+                mismatches += 1
+                print(f"EQUIVALENCE MISMATCH req {i}: paged="
+                      f"{results.get(rid)} baseline={got}",
+                      file=sys.stderr)
+        result["detail"]["equivalence"] = {
+            "checked": len(rids), "mismatches": mismatches}
+        if paged and args.shared_prefix >= 2 * args.block_size and \
+                reused_tokens < 1:
+            print("EQUIVALENCE: expected >=1 prefix-cache hit on the "
+                  "shared-prompt workload, saw none", file=sys.stderr)
+            mismatches += 1
+        if mismatches:
+            print(json.dumps(result))
+            return 1
+        print(f"equivalence ok: {len(rids)} requests, paged == "
+              f"baseline, prefix_hit_tokens={reused_tokens}",
+              file=sys.stderr)
+
+    print(json.dumps(result))
+
+    if args.emit:
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = args.emit
+        if path == "auto":
+            path = os.path.join(
+                here, f"BENCH_serve_r{_next_serve_round(here):02d}.json")
+        with open(path, "w") as f:
+            json.dump({"schema": "bench_serve", "parsed": result}, f,
+                      indent=1)
+        print(f"wrote {path}", file=sys.stderr)
+
+    if args.compare:
+        import bench as _bench
+        prev = _bench._prev_serve_record()
+        if prev is None:
+            print(json.dumps({"bench_compare": {
+                "ok": True, "note": "no previous BENCH_serve artifact"}}),
+                file=sys.stderr)
+            return 0
+        regressions = _bench.compare_serve_records(result, prev,
+                                                   args.tolerance)
+        print(json.dumps({"bench_compare": {
+            "ok": not regressions, "tolerance": args.tolerance,
+            "prev_value": prev.get("value"),
+            "regressions": regressions}}), file=sys.stderr)
+        if regressions:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,11 @@ optimizer IS the compiler.  The Python ``Predictor`` wraps the deserialized
 ``jax.export`` artifact; the **native path** is csrc/predictor (C++ shim
 that drives the same artifact through the PJRT C API) for embedding in
 C++ services, matching the reference's C++ serving story.
+
+LLM serving lives in the sibling modules: ``serving.py`` (the
+continuous-batching engine) and ``kv_cache.py`` (the paged KV
+allocator, prefix cache, and paged attention path behind
+``PADDLE_TPU_PAGED_KV``) — see ``inference/README.md``.
 """
 
 from __future__ import annotations

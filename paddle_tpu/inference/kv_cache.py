@@ -1,0 +1,444 @@
+"""Paged KV cache: block allocator, prefix reuse, paged attention.
+
+Fleet-scale serving memory management (ROADMAP item 1).  The reference's
+serving stack sizes one contiguous KV region per batch slot
+(fused_multi_transformer's cache_kv tensors) — at `max_len` granularity
+every admitted request pays for its worst case, and two requests sharing
+a 2-kilotoken system prompt each prefill and store it twice.  This module
+rebuilds the memory path vLLM-style around fixed-size **token blocks**:
+
+* :class:`BlockAllocator` — host-side refcounted free list over a pool of
+  physical blocks.  Allocation/free is O(1); refcounts make a physical
+  block shareable by many sequences (prefix reuse, fork).
+* :class:`SequenceBlocks` — one sequence's logical→physical block list.
+  ``fork()`` is O(blocks) refcount bumps (no data movement);
+  ``ensure_writable()`` implements **copy-on-write**: the first divergent
+  write to a shared block allocates a private copy, so a fork never
+  observes its sibling's later writes.
+* :class:`PrefixCache` — a trie over *full* blocks keyed by the token ids
+  they hold (chain-keyed: a node's identity is its whole prefix, so equal
+  system prompts map to equal nodes).  A matched prefix hands the new
+  request refcounted references to the already-filled physical blocks —
+  repeated prefixes prefill **once**.  The cache holds its own reference
+  on every registered block and evicts LRU leaves when the allocator runs
+  dry.
+* :class:`PagedKVPool` — the device-side pools, one
+  ``[num_blocks, block_size, kv_heads, head_dim]`` pair (k, v) per layer.
+  Physical block ids are shared across layers: one logical allocation
+  covers a token's KV in every layer.
+* :func:`paged_cache_attention` — the decode/prefill attention path over
+  the pools: writes land through the block table
+  (``pool[bt[pos//bs], pos%bs] = kv``), reads gather the table back into
+  logical order.  Routes to the Pallas paged-decode kernel when eligible
+  (``ops/pallas/paged_attention.py``), ``jnp.take``-style gather
+  fallback elsewhere.  Numerics match ``static_cache_attention`` exactly:
+  the gather preserves values bitwise and the extra masked positions
+  contribute exact zeros, so greedy decode is token-for-token identical
+  to the slot-contiguous engine.
+
+The serving engine wires this behind ``PADDLE_TPU_PAGED_KV``
+(``inference/serving.py``); ``=0`` keeps the slot-contiguous path.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict, deque
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, \
+    Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["BlockAllocator", "SequenceBlocks", "PrefixCache",
+           "PagedKVPool", "PagedCache", "paged_cache_attention",
+           "paged_kv_enabled"]
+
+
+def paged_kv_enabled(default: bool = False) -> bool:
+    """The ``PADDLE_TPU_PAGED_KV`` knob.  Unset → `default` (off: the
+    slot-contiguous engine stays the shipped path until the paged one
+    has a perf trajectory)."""
+    raw = os.environ.get("PADDLE_TPU_PAGED_KV")
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+# -- host-side block bookkeeping ---------------------------------------------
+
+class BlockAllocator:
+    """Refcounted free list over ``num_blocks`` physical blocks.
+
+    Block 0 is reserved as the **scratch block**: inactive batch rows and
+    out-of-range padded writes are routed there by construction, so it is
+    never handed out.  ``free()`` is a decref — the block returns to the
+    free list only when the last holder lets go; freeing an unreferenced
+    block raises (the double-free invariant the chaos tests drill).
+    """
+
+    def __init__(self, num_blocks: int, reserved: int = 1):
+        if num_blocks <= reserved:
+            raise ValueError(f"num_blocks {num_blocks} must exceed the "
+                             f"{reserved} reserved scratch block(s)")
+        self.num_blocks = num_blocks
+        self.reserved = reserved
+        self._free: deque = deque(range(reserved, num_blocks))
+        self._ref = np.zeros((num_blocks,), np.int64)
+
+    def alloc(self) -> Optional[int]:
+        """One block with refcount 1, or None when exhausted (callers
+        shed load / evict; exhaustion is a normal serving condition,
+        not an error)."""
+        if not self._free:
+            return None
+        bid = self._free.popleft()
+        self._ref[bid] = 1
+        return bid
+
+    def ref(self, bid: int):
+        if self._ref[bid] <= 0:
+            raise RuntimeError(f"ref of unallocated block {bid}")
+        self._ref[bid] += 1
+
+    def refcount(self, bid: int) -> int:
+        return int(self._ref[bid])
+
+    def free(self, bid: int) -> bool:
+        """Decref; True when the block actually returned to the free
+        list.  Freeing a block with refcount 0 is a double free."""
+        if bid < self.reserved:
+            raise RuntimeError(f"free of reserved scratch block {bid}")
+        if self._ref[bid] <= 0:
+            raise RuntimeError(f"double free of block {bid}")
+        self._ref[bid] -= 1
+        if self._ref[bid] == 0:
+            self._free.append(bid)
+            return True
+        return False
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - self.reserved - len(self._free)
+
+
+class SequenceBlocks:
+    """One sequence's logical block list over a shared allocator.
+
+    Blocks arrive either fresh (``ensure_capacity``) or shared
+    (``adopt_shared`` from the prefix cache, ``fork`` from a sibling).
+    Writes must go through :meth:`ensure_writable` first: a shared block
+    is copied to a private one (COW) before the caller may touch it.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self._alloc = allocator
+        self.block_size = block_size
+        self.bids: List[int] = []
+
+    @property
+    def capacity(self) -> int:
+        return len(self.bids) * self.block_size
+
+    def adopt_shared(self, bids: Sequence[int]):
+        """Append already-allocated blocks, taking a reference on each
+        (prefix-cache hit: the physical blocks stay owned by the cache
+        too)."""
+        for b in bids:
+            self._alloc.ref(b)
+            self.bids.append(b)
+
+    def ensure_capacity(self, tokens: int) -> bool:
+        """Grow to >= `tokens` capacity.  All-or-nothing: on exhaustion
+        nothing is allocated and False returns (the caller sheds load)."""
+        need = -(-tokens // self.block_size) - len(self.bids)
+        if need <= 0:
+            return True
+        if self._alloc.free_blocks < need:
+            return False
+        for _ in range(need):
+            self.bids.append(self._alloc.alloc())
+        return True
+
+    def fork(self) -> "SequenceBlocks":
+        """Share every block with a child (refcount bump, zero copies).
+        Either side's next write triggers COW via ensure_writable."""
+        child = SequenceBlocks(self._alloc, self.block_size)
+        child.adopt_shared(self.bids)
+        return child
+
+    def ensure_writable(self, idx: int,
+                        copier: Optional[Callable[[int, int], None]]
+                        = None) -> Optional[Tuple[int, int]]:
+        """Copy-on-write: if logical block `idx` is shared, allocate a
+        private block, run `copier(src, dst)` (device block copy) and
+        swap it in.  Returns (src, dst) when a copy happened, None when
+        the block was already private.  Exhaustion here raises rather
+        than shedding — the caller has already committed writes to this
+        sequence, so sizing must reserve COW headroom (the engine
+        allocates private decode blocks up front; its steady state
+        never COWs)."""
+        bid = self.bids[idx]
+        if self._alloc.refcount(bid) == 1:
+            return None
+        new = self._alloc.alloc()
+        if new is None:
+            raise RuntimeError(
+                "allocator exhausted during copy-on-write — size the pool "
+                "with COW headroom or evict before writing")
+        if copier is not None:
+            copier(bid, new)
+        self.bids[idx] = new
+        self._alloc.free(bid)
+        return (bid, new)
+
+    def release(self):
+        """Drop every reference (retirement).  Shared blocks survive in
+        their other holders (prefix cache, forks)."""
+        for b in self.bids:
+            self._alloc.free(b)
+        self.bids.clear()
+
+
+class _TrieNode:
+    __slots__ = ("key", "bid", "children", "parent")
+
+    def __init__(self, key, bid, parent):
+        self.key = key          # tuple of this block's token ids
+        self.bid = bid
+        self.children: Dict[tuple, "_TrieNode"] = {}
+        self.parent: Optional["_TrieNode"] = parent
+
+
+class PrefixCache:
+    """Trie over full blocks of token ids → physical block ids.
+
+    A node's position in the trie encodes its whole prefix, so the
+    lookup key is effectively a chain hash of token-id blocks: two
+    requests share a physical block iff their prompts agree on every
+    token up to and including that block.  The cache owns one reference
+    per registered block; :meth:`evict` releases LRU leaves whose only
+    remaining holder is the cache (refcount 1), freeing real memory
+    without touching blocks any live sequence still reads.
+    """
+
+    def __init__(self, block_size: int, allocator: BlockAllocator):
+        self.block_size = block_size
+        self._alloc = allocator
+        self._root = _TrieNode((), -1, None)
+        # LRU over nodes: key id(node) → node, most-recently-used last
+        self._lru: "OrderedDict[int, _TrieNode]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self):
+        return len(self._lru)
+
+    def _touch(self, node: _TrieNode):
+        self._lru.move_to_end(id(node))
+
+    def match(self, tokens: np.ndarray) -> List[int]:
+        """Physical block ids covering the longest cached full-block
+        prefix of `tokens` (possibly empty).  Counts one hit (>=1 block)
+        or miss per lookup and refreshes LRU recency along the path."""
+        bs = self.block_size
+        node, bids = self._root, []
+        for i in range(len(tokens) // bs):
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            bids.append(child.bid)
+            self._touch(child)
+            node = child
+        if bids:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return bids
+
+    def register(self, tokens: np.ndarray, bids: Sequence[int],
+                 limit_tokens: Optional[int] = None) -> int:
+        """Insert every full block of `tokens` (bounded by
+        `limit_tokens`, e.g. the prompt length — generated tokens are
+        per-request and would pollute the shared trie).  The cache takes
+        its own reference on newly inserted blocks; blocks whose content
+        is already cached are left to their current physical id (dedupe
+        — the caller keeps its possibly-different copy).  Returns the
+        number of newly registered blocks."""
+        bs = self.block_size
+        n = len(tokens) if limit_tokens is None else min(limit_tokens,
+                                                        len(tokens))
+        node, new = self._root, 0
+        for i in range(n // bs):
+            if i >= len(bids):
+                break
+            key = tuple(int(t) for t in tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _TrieNode(key, int(bids[i]), node)
+                self._alloc.ref(child.bid)
+                node.children[key] = child
+                self._lru[id(child)] = child
+                new += 1
+            self._touch(child)
+            node = child
+        return new
+
+    def evict(self, n_blocks: int = 1) -> int:
+        """Release up to `n_blocks` LRU **leaf** blocks whose refcount is
+        1 (cache-only — nothing live reads them).  Returns blocks
+        actually freed."""
+        freed = 0
+        # repeated sweeps: freeing a leaf may expose its parent
+        while freed < n_blocks:
+            victim = None
+            for node in self._lru.values():           # oldest first
+                if not node.children and \
+                        self._alloc.refcount(node.bid) == 1:
+                    victim = node
+                    break
+            if victim is None:
+                break
+            self._alloc.free(victim.bid)
+            victim.parent.children.pop(victim.key, None)
+            del self._lru[id(victim)]
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def clear(self):
+        """Drop every cached block (engine error-recovery path)."""
+        for node in list(self._lru.values()):
+            if self._alloc.refcount(node.bid) > 0:
+                self._alloc.free(node.bid)
+        self._lru.clear()
+        self._root = _TrieNode((), -1, None)
+
+
+# -- device-side pools -------------------------------------------------------
+
+class PagedKVPool:
+    """Per-layer ``[num_blocks, block_size, kv_heads, head_dim]`` k/v
+    pools.  One physical block id addresses the same slice in every
+    layer, so host bookkeeping is per-token-block, not per-layer."""
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        shape = (num_blocks, block_size, kv_heads, head_dim)
+        self.kpools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.vpools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self._copy = jax.jit(
+            lambda pool, src, dst: pool.at[dst].set(pool[src]),
+            donate_argnums=(0,))
+        self.cow_copies = 0
+
+    def copy_block(self, src: int, dst: int):
+        """Device-side COW body: duplicate physical block `src` into
+        `dst` across every layer's k and v pool."""
+        s = jnp.asarray(src, jnp.int32)
+        d = jnp.asarray(dst, jnp.int32)
+        self.kpools = [self._copy(p, s, d) for p in self.kpools]
+        self.vpools = [self._copy(p, s, d) for p in self.vpools]
+        self.cow_copies += 1
+
+    def reset(self):
+        dtype = self.kpools[0].dtype
+        shape = self.kpools[0].shape
+        n = len(self.kpools)
+        self.kpools = [jnp.zeros(shape, dtype) for _ in range(n)]
+        self.vpools = [jnp.zeros(shape, dtype) for _ in range(n)]
+
+
+# -- the paged attention path ------------------------------------------------
+
+class PagedCache(NamedTuple):
+    """One layer's paged KV view: the physical pools plus this batch's
+    block table ``[B, max_blocks]`` (logical block index → physical
+    block id; unallocated entries point at scratch block 0)."""
+    k: object                   # [num_blocks, block_size, kv_heads, hd]
+    v: object
+    block_table: object         # [B, max_blocks] int32
+
+
+def paged_cache_attention(q, k, v, cache: PagedCache, position_offset,
+                          attn_mask=None):
+    """Paged analog of ``static_cache_attention``: write the step's k/v
+    through the block table, gather the table back into logical order,
+    attend under the causal bound.
+
+    q/k/v: ``[b, s, heads, head_dim]`` current-step projections.
+    ``position_offset``: scalar, or per-row ``[B]`` vector (continuous
+    batching / chunked prefill — each row sits at its own offset; unlike
+    the static path, s > 1 composes with per-row offsets, which is what
+    lets speculative drafts verify in ONE batched forward).
+
+    Returns ``(out, new_cache)``.  Decode (s == 1) routes to the Pallas
+    paged-attention kernel when eligible; the ``jnp.take`` gather
+    fallback runs elsewhere and is numerically identical (the gathered
+    values are bitwise the static buffer's, the extra masked tail
+    contributes exact zeros)."""
+    from paddle_tpu.core.dispatch import unwrap, wrap_like
+    from paddle_tpu.generation import reject_scalar_mask
+    from paddle_tpu.nn.functional.attention import \
+        scaled_dot_product_attention
+
+    B, S = q.shape[0], q.shape[1]
+    kp, vp = unwrap(cache.k), unwrap(cache.v)
+    bt = unwrap(cache.block_table)
+    bs = kp.shape[1]
+    mb = bt.shape[1]
+    if getattr(position_offset, "ndim", 0) == 1:
+        qpos = position_offset[:, None] + jnp.arange(S)[None]     # [B, S]
+    else:
+        qpos = jnp.broadcast_to(
+            position_offset + jnp.arange(S)[None], (B, S))
+    # write: logical position → (physical block, slot).  Positions past
+    # the table (padded chunk tails near max_len) are routed to the
+    # scratch block EXPLICITLY — clamping them into the row's last real
+    # block would let a pad row overwrite live prompt KV when a
+    # sequence has every block allocated.  Within the table,
+    # unallocated entries are 0 (scratch) by construction.
+    lb = qpos // bs
+    bids = jnp.take_along_axis(bt, jnp.minimum(lb, mb - 1),
+                               axis=1)                            # [B, S]
+    bids = jnp.where(lb < mb, bids, 0)
+    slot = qpos % bs
+    kp = kp.at[bids, slot].set(unwrap(k).astype(kp.dtype))
+    vp = vp.at[bids, slot].set(unwrap(v).astype(vp.dtype))
+    new_cache = PagedCache(wrap_like(kp), wrap_like(vp),
+                           cache.block_table)
+
+    from paddle_tpu.ops.pallas import paged_attention as PA
+    uq = unwrap(q)
+    if attn_mask is None and S == 1 and \
+            PA.paged_decode_eligible(kp.shape[-1], bs, uq.dtype):
+        PA.record_path("pallas")
+        lengths = qpos[:, 0] + 1
+        out = PA.paged_decode_attention(uq[:, 0], kp, vp, bt, lengths)
+        return wrap_like(out[:, None]), new_cache
+    PA.record_path("fallback")
+
+    # gather the block table back into logical order: [B, mb*bs, kvh, hd]
+    kb = jnp.reshape(kp[bt], (B, mb * bs) + kp.shape[2:])
+    vb = jnp.reshape(vp[bt], (B, mb * bs) + vp.shape[2:])
+    kpos = jnp.arange(mb * bs)
+    mask = kpos[None, None, None, :] <= qpos[:, None, :, None]  # [B,1,S,T]
+    if attn_mask is not None:
+        am = reject_scalar_mask(attn_mask)
+        if am.dtype == jnp.bool_:
+            mask = mask & am
+        else:
+            mask = jnp.where(mask, am.astype(jnp.float32), -1e30)
+    out = scaled_dot_product_attention(q, wrap_like(kb), wrap_like(vb),
+                                       attn_mask=mask, is_causal=False)
+    return out, new_cache

@@ -20,6 +20,21 @@ Weight-only int8: ``int8_weights=True`` stores every 2-D matmul weight
 as int8 with a per-output-channel fp32 scale and dequantizes INSIDE the
 compiled step (XLA fuses the convert+scale into the matmul prologue), so
 decode — a bandwidth-bound workload — reads half the bytes.
+
+Paged KV mode (``PADDLE_TPU_PAGED_KV=1`` / ``paged_kv=True``): the
+slot-contiguous per-slot cache is replaced by the block/paged allocator
+in ``inference/kv_cache.py`` — fixed-size token blocks with a refcounted
+free list, a prefix trie so requests sharing a system prompt map to the
+same physical blocks (prefill once, copy-on-write on divergence), and a
+block-table attention path (Pallas kernel where eligible).  On top of
+the paged cache: **chunked prefill** (long prompts advance one
+``prefill_chunk``-sized piece per engine step, interleaved with decode
+so in-flight TTFT/TPOT don't stall) and **n-gram speculative decoding**
+(``spec_decode=k`` drafts from the request's own history and verifies
+all drafts in ONE batched forward; greedy-equivalence guaranteed —
+accepted tokens are exactly what step-by-step argmax would emit).
+``PADDLE_TPU_PAGED_KV=0`` (the default) keeps the exact previous
+engine; greedy outputs are token-for-token identical either way.
 """
 
 from __future__ import annotations
@@ -89,6 +104,59 @@ def _serving_metrics():
     }
 
 
+def _paged_metrics():
+    """Paged-KV instruments, registered only when the paged engine is
+    in use so an unpaged process exposes the exact previous series."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "prefix_lookups": reg.counter(
+            "paddle_tpu_serving_prefix_cache_total",
+            "prefix-cache lookups at admission",
+            labelnames=("result",)),
+        "prefix_tokens": reg.counter(
+            "paddle_tpu_serving_prefix_tokens_reused_total",
+            "prompt tokens whose prefill was skipped because their "
+            "blocks were already in the prefix cache"),
+        "evictions": reg.counter(
+            "paddle_tpu_serving_kv_evictions_total",
+            "prefix-cache blocks evicted under allocator pressure"),
+        "cow": reg.counter(
+            "paddle_tpu_serving_kv_cow_copies_total",
+            "copy-on-write block copies (a shared block was written)"),
+        "alloc_failures": reg.counter(
+            "paddle_tpu_serving_kv_alloc_failures_total",
+            "admissions deferred because the block pool was exhausted "
+            "(load shed back into the bounded queue)"),
+        "chunks": reg.counter(
+            "paddle_tpu_serving_prefill_chunks_total",
+            "chunked-prefill dispatches"),
+        "spec": reg.counter(
+            "paddle_tpu_serving_spec_tokens_total",
+            "speculative-decoding draft tokens",
+            labelnames=("kind",)),
+    }
+
+
+def _ngram_propose(history: np.ndarray, k: int, max_n: int = 3):
+    """Draft up to `k` tokens by matching the tail n-gram of the
+    request's own history (prompt + generated) against its most recent
+    earlier occurrence — 'prompt lookup' decoding: free drafts that pay
+    off on extractive/repetitive spans, and the verify step guarantees
+    they never change the output.  Returns int32 drafts (possibly fewer
+    than k) or None.  The linear scan is fine at serving history
+    lengths; a production proposer would keep an n-gram index."""
+    L = len(history)
+    for n in range(min(max_n, L - 1), 0, -1):
+        pat = history[L - n:]
+        for i in range(L - n - 1, -1, -1):
+            if np.array_equal(history[i:i + n], pat):
+                cont = history[i + n:i + n + k]
+                if len(cont):
+                    return np.asarray(cont, np.int32)
+    return None
+
+
 def quantize_weights_int8(params: Dict[str, jnp.ndarray],
                           min_size: int = 1 << 16):
     """Split params into (passthrough, {name: (w8, scale)}) — every
@@ -129,6 +197,10 @@ class _Request:
     admitted_at: float = 0.0        # perf_counter at slot admission
     first_token_at: float = 0.0     # perf_counter when prefill emitted
     retired_at: float = 0.0         # perf_counter at retirement
+    prefix_reused: int = 0          # prompt tokens served from the
+    #                                 prefix cache (paged engine)
+    spec_proposed: int = 0          # speculative drafts proposed
+    spec_accepted: int = 0          # speculative drafts accepted
 
 
 class RequestStatus(str):
@@ -162,6 +234,14 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
         t["decode_s"] = req.retired_at - req.first_token_at
     if req.retired_at and req.enqueued_at:
         t["total_s"] = req.retired_at - req.enqueued_at
+    # paged-engine evidence: how much prefill the prefix cache skipped,
+    # and how much of the decode came from accepted speculative drafts
+    # (0 / 0.0 in the unpaged engine — the keys are always present so
+    # clients need no feature detection)
+    t["prefix_tokens_reused"] = float(req.prefix_reused)
+    t["speculative_accept_rate"] = (
+        req.spec_accepted / req.spec_proposed if req.spec_proposed
+        else 0.0)
     return t
 
 
@@ -186,7 +266,14 @@ class ContinuousBatchingEngine:
                  analyze: Optional[str] = None,
                  max_queue: Optional[int] = None,
                  request_timeout_s: Optional[float] = None,
-                 max_consecutive_errors: int = 3):
+                 max_consecutive_errors: int = 3,
+                 paged_kv: Optional[bool] = None,
+                 kv_block_size: int = 16,
+                 num_kv_blocks: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 spec_decode: int = 0,
+                 spec_ngram: int = 3):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -201,6 +288,25 @@ class ContinuousBatchingEngine:
         # finishing mid-chunk over-generate < K tokens (truncated by the
         # host; the wasted rows are unreachable for successors, see step())
         self.steps_per_sync = max(1, int(steps_per_sync))
+        # paged-KV mode (kv_cache.py): block allocator + prefix reuse +
+        # chunked prefill + optional n-gram speculative decoding.  The
+        # knob default is OFF: =0 (or unset) keeps the exact previous
+        # slot-contiguous engine.
+        from paddle_tpu.inference.kv_cache import paged_kv_enabled
+        self.paged = paged_kv_enabled() if paged_kv is None \
+            else bool(paged_kv)
+        self.spec_tokens = max(0, int(spec_decode))
+        self._spec_ngram = max(1, int(spec_ngram))
+        if self.spec_tokens:
+            if not self.paged:
+                raise ValueError(
+                    "spec_decode requires the paged KV engine "
+                    "(paged_kv=True or PADDLE_TPU_PAGED_KV=1)")
+            if do_sample:
+                raise ValueError(
+                    "n-gram speculative decoding is greedy-only "
+                    "(accepted tokens must equal step-by-step argmax); "
+                    "do_sample=True is incompatible")
         # sampling config shared by prefill + decode (the generation
         # module's _sample: temperature / top-k / nucleus; greedy when
         # do_sample=False).  One key stream serves the whole pool —
@@ -231,11 +337,45 @@ class ContinuousBatchingEngine:
         self.int8 = int8_weights
 
         cfgm = model.config
-        kv_shape = (slots, max_len, cfgm.num_key_value_heads, cfgm.head_dim)
-        self._caches = [
-            (jnp.zeros(kv_shape, self._dtype), jnp.zeros(kv_shape,
-                                                         self._dtype))
-            for _ in range(cfgm.num_hidden_layers)]
+        if not self.paged:
+            kv_shape = (slots, max_len, cfgm.num_key_value_heads,
+                        cfgm.head_dim)
+            self._caches = [
+                (jnp.zeros(kv_shape, self._dtype), jnp.zeros(kv_shape,
+                                                             self._dtype))
+                for _ in range(cfgm.num_hidden_layers)]
+        else:
+            from paddle_tpu.inference.kv_cache import (BlockAllocator,
+                                                       PagedKVPool,
+                                                       PrefixCache)
+            self._block_size = int(kv_block_size)
+            if self._block_size < 1:
+                raise ValueError(f"kv_block_size must be >= 1, got "
+                                 f"{kv_block_size}")
+            self._max_blocks = -(-max_len // self._block_size)
+            # default pool: every slot can hold a worst-case sequence,
+            # plus the reserved scratch block; prefix sharing then turns
+            # the saved blocks into prefix-cache headroom
+            self._num_blocks = int(num_kv_blocks) if num_kv_blocks \
+                else 1 + slots * self._max_blocks
+            self._allocator = BlockAllocator(self._num_blocks)
+            self._prefix = PrefixCache(self._block_size, self._allocator) \
+                if prefix_cache else None
+            self._pool = PagedKVPool(
+                cfgm.num_hidden_layers, self._num_blocks,
+                self._block_size, cfgm.num_key_value_heads,
+                cfgm.head_dim, self._dtype)
+            # per-slot block table rows; 0 = reserved scratch block
+            self._bt = np.zeros((slots, self._max_blocks), np.int32)
+            self._seq: List[Optional[object]] = [None] * slots
+            self._prefilling: Dict[int, int] = {}  # slot -> next pos
+            self._chunk = int(prefill_chunk) if prefill_chunk \
+                else min(self.buckets[-1], max_len - 1)
+            if not 1 <= self._chunk < max_len:
+                raise ValueError(f"prefill_chunk must be in [1, "
+                                 f"max_len), got {prefill_chunk}")
+            self._interleave_decode = False
+            self._blocks_used_peak = 0
         self._pos = np.zeros((slots,), np.int32)       # next write row
         self._active: List[Optional[_Request]] = [None] * slots
         self._budget = np.zeros((slots,), np.int32)    # tokens remaining
@@ -265,6 +405,8 @@ class ContinuousBatchingEngine:
         # occupancy gauges are pull-style (read at scrape, zero cost in
         # the serving loop)
         self._metrics = _serving_metrics()
+        if self.paged:
+            self._metrics.update(_paged_metrics())
         from paddle_tpu.observability import default_registry, \
             flight_recorder
         from paddle_tpu.observability.tracing import tracer
@@ -279,6 +421,21 @@ class ContinuousBatchingEngine:
             lambda a=self._active: sum(r is not None for r in a))
         reg.gauge("paddle_tpu_serving_slots",
                   "slot pool size").set(slots)
+        if self.paged:
+            # read through the engine, not a bound allocator: _recover
+            # rebuilds the allocator/prefix objects on error containment
+            reg.gauge("paddle_tpu_serving_kv_blocks_free",
+                      "paged KV blocks on the free list").set_function(
+                lambda e=self: e._allocator.free_blocks)
+            reg.gauge("paddle_tpu_serving_kv_blocks_used",
+                      "paged KV blocks held by sequences or the prefix "
+                      "cache").set_function(
+                lambda e=self: e._allocator.used_blocks)
+            reg.gauge("paddle_tpu_serving_prefix_cache_blocks",
+                      "blocks registered in the prefix trie"
+                      ).set_function(
+                lambda e=self: len(e._prefix)
+                if e._prefix is not None else 0)
 
         # serving traces must see eval-mode (dropout off); remembered so
         # close() / context exit can hand the model back for training
@@ -303,56 +460,130 @@ class ContinuousBatchingEngine:
 
         from paddle_tpu.generation import _sample
         gen_cfg = self._gen_cfg
-
-        @_ft.partial(jax.jit, donate_argnums=(3,))
-        def prefill(keep, quant, ids, caches1, true_len, key):
-            ps = _dequant(keep, quant, dtype)
-            logits, new_caches = fwd(ps, ids, caches1, 0)
-            first = _sample(logits[0, true_len - 1][None], gen_cfg,
-                            key)[0]
-            return first.astype(jnp.int32), new_caches
-
-        @_ft.partial(jax.jit, donate_argnums=(0, 1))
-        def insert(cachesB, caches1, slot):
-            out = []
-            for (kb, vb), (k1, v1) in zip(cachesB, caches1):
-                kb = jax.lax.dynamic_update_slice(
-                    kb, k1.astype(kb.dtype), (slot, 0, 0, 0))
-                vb = jax.lax.dynamic_update_slice(
-                    vb, v1.astype(vb.dtype), (slot, 0, 0, 0))
-                out.append((kb, vb))
-            return out
-
         K = self.steps_per_sync
 
-        def decode(keep, quant, caches, toks, pos, active, key):
-            ps = _dequant(keep, quant, dtype)
+        if not self.paged:
+            @_ft.partial(jax.jit, donate_argnums=(3,))
+            def prefill(keep, quant, ids, caches1, true_len, key):
+                ps = _dequant(keep, quant, dtype)
+                logits, new_caches = fwd(ps, ids, caches1, 0)
+                first = _sample(logits[0, true_len - 1][None], gen_cfg,
+                                key)[0]
+                return first.astype(jnp.int32), new_caches
 
-            def one(carry, _):
-                caches, toks, pos, key = carry
-                logits, caches = fwd(ps, toks[:, None], caches, pos)
-                key, sub = jax.random.split(key)
-                nxt = _sample(logits[:, -1], gen_cfg,
-                              sub).astype(jnp.int32)
-                # inactive slots run with pos pinned to the scratch row
-                # max_len-1 (set by the host) and a frozen token; their
-                # pos must NOT advance inside the chunk
-                nxt = jnp.where(active, nxt, toks)
-                pos = jnp.where(active, pos + 1, pos)
-                return (caches, nxt, pos, key), nxt
+            @_ft.partial(jax.jit, donate_argnums=(0, 1))
+            def insert(cachesB, caches1, slot):
+                out = []
+                for (kb, vb), (k1, v1) in zip(cachesB, caches1):
+                    kb = jax.lax.dynamic_update_slice(
+                        kb, k1.astype(kb.dtype), (slot, 0, 0, 0))
+                    vb = jax.lax.dynamic_update_slice(
+                        vb, v1.astype(vb.dtype), (slot, 0, 0, 0))
+                    out.append((kb, vb))
+                return out
 
-            (caches, _, _, _), seq = jax.lax.scan(
-                one, (caches, toks, pos, key), None, length=K)
-            return jnp.swapaxes(seq, 0, 1), caches   # [B, K]
+            def decode(keep, quant, caches, toks, pos, active, key):
+                ps = _dequant(keep, quant, dtype)
 
-        self._prefill, self._insert = prefill, insert
-        # raw (unjitted) decode kept for program analysis — the engine
-        # build step can lint the exact fn it is about to compile
-        self._decode_raw = decode
-        self._decode = jax.jit(decode, donate_argnums=(2,))
-        self._fwd = fwd
-        # AOT executables from aot_warmup(): decode + one prefill per
-        # bucket; dispatch prefers them (no first-request compile spike)
+                def one(carry, _):
+                    caches, toks, pos, key = carry
+                    logits, caches = fwd(ps, toks[:, None], caches, pos)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample(logits[:, -1], gen_cfg,
+                                  sub).astype(jnp.int32)
+                    # inactive slots run with pos pinned to the scratch
+                    # row max_len-1 (set by the host) and a frozen token;
+                    # their pos must NOT advance inside the chunk
+                    nxt = jnp.where(active, nxt, toks)
+                    pos = jnp.where(active, pos + 1, pos)
+                    return (caches, nxt, pos, key), nxt
+
+                (caches, _, _, _), seq = jax.lax.scan(
+                    one, (caches, toks, pos, key), None, length=K)
+                return jnp.swapaxes(seq, 0, 1), caches   # [B, K]
+
+            self._prefill, self._insert = prefill, insert
+            # raw (unjitted) decode kept for program analysis — the
+            # engine build step can lint the exact fn it will compile
+            self._decode_raw = decode
+            self._decode = jax.jit(decode, donate_argnums=(2,))
+            self._fwd = fwd
+        else:
+            from paddle_tpu.inference.kv_cache import PagedCache
+
+            def fwd_paged(ps, ids, kpools, vpools, bt, pos):
+                cc = [PagedCache(kk, vv, bt)
+                      for kk, vv in zip(kpools, vpools)]
+                logits, new_caches = functional_call(model, ps, ids,
+                                                     None, cc, pos)
+                raw = unwrap(logits).astype(jnp.float32)
+                return raw, ([unwrap(c.k) for c in new_caches],
+                             [unwrap(c.v) for c in new_caches])
+
+            # chunked prefill: ONE executable serves every chunk of
+            # every prompt (B=1, fixed width C, per-row [1] position
+            # vector so padded tails clamp safely in the RoPE gather).
+            # Non-final chunks ignore the sampled token; the final
+            # chunk's sample at the true last prompt position is the
+            # request's first generated token.
+            @_ft.partial(jax.jit, donate_argnums=(3, 4))
+            def prefill_chunk(keep, quant, ids, kpools, vpools, bt_row,
+                              start, last_idx, key):
+                ps = _dequant(keep, quant, dtype)
+                logits, pools = fwd_paged(ps, ids, kpools, vpools,
+                                          bt_row, start)
+                first = _sample(logits[0, last_idx][None], gen_cfg,
+                                key)[0]
+                return first.astype(jnp.int32), pools
+
+            def decode_paged(keep, quant, kpools, vpools, bt, toks, pos,
+                             active, key):
+                ps = _dequant(keep, quant, dtype)
+
+                def one(carry, _):
+                    kpools, vpools, toks, pos, key = carry
+                    logits, (kpools, vpools) = fwd_paged(
+                        ps, toks[:, None], kpools, vpools, bt, pos)
+                    key, sub = jax.random.split(key)
+                    nxt = _sample(logits[:, -1], gen_cfg,
+                                  sub).astype(jnp.int32)
+                    # inactive rows: host pins pos=0 and zeroes their
+                    # block-table row, so the write lands in the
+                    # reserved scratch block
+                    nxt = jnp.where(active, nxt, toks)
+                    pos = jnp.where(active, pos + 1, pos)
+                    return (kpools, vpools, nxt, pos, key), nxt
+
+                (kpools, vpools, _, _, _), seq = jax.lax.scan(
+                    one, (kpools, vpools, toks, pos, key), None,
+                    length=K)
+                return jnp.swapaxes(seq, 0, 1), kpools, vpools
+
+            # speculative verify: ONE batched forward over
+            # [last_token, draft_1..draft_k] per row; argmax at every
+            # position is exactly what step-by-step greedy would emit,
+            # so the host can accept the longest matching draft prefix
+            # plus one bonus token with zero output drift
+            def spec_verify(keep, quant, kpools, vpools, bt, toks, pos,
+                            active):
+                ps = _dequant(keep, quant, dtype)
+                logits, (kpools, vpools) = fwd_paged(
+                    ps, toks, kpools, vpools, bt, pos)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        kpools, vpools)
+
+            self._prefill_chunk_fn = prefill_chunk
+            # raw (unjitted) decode kept for program analysis
+            self._decode_paged_raw = decode_paged
+            self._decode_paged = jax.jit(decode_paged,
+                                         donate_argnums=(2, 3))
+            self._spec_verify = jax.jit(spec_verify,
+                                        donate_argnums=(2, 3))
+            self._prefill_chunk_compiled = None
+            self._spec_verify_compiled = None
+        # AOT executables from aot_warmup(): decode + prefill
+        # executables; dispatch prefers them (no first-request compile
+        # spike)
         self._decode_compiled = None
         self._prefill_compiled: Dict[int, object] = {}
 
@@ -380,6 +611,8 @@ class ContinuousBatchingEngine:
         toks = jnp.zeros((self.slots,), jnp.int32)
         pos = jnp.zeros((self.slots,), jnp.int32)
         active = jnp.ones((self.slots,), jnp.bool_)
+        if self.paged:
+            return self._aot_warmup_paged(aot_compile, toks, pos, active)
         compiled, info = aot_compile(
             self._decode, self._keep, self._quant, self._caches, toks,
             pos, active, self._key, target="serving.decode")
@@ -400,6 +633,42 @@ class ContinuousBatchingEngine:
             stats[target] = info.stats
         return stats
 
+    def _paged_dummies(self):
+        """Zero-filled pool/table/state avals for AOT compile + lint."""
+        kpools = [jnp.zeros_like(p) for p in self._pool.kpools]
+        vpools = [jnp.zeros_like(p) for p in self._pool.vpools]
+        bt = jnp.zeros((self.slots, self._max_blocks), jnp.int32)
+        return kpools, vpools, bt
+
+    def _aot_warmup_paged(self, aot_compile, toks, pos, active):
+        stats = {}
+        kpools, vpools, bt = self._paged_dummies()
+        compiled, info = aot_compile(
+            self._decode_paged, self._keep, self._quant, kpools, vpools,
+            bt, toks, pos, active, self._key, target="serving.decode")
+        self._decode_compiled = compiled
+        stats["serving.decode"] = info.stats
+        kpools, vpools, bt = self._paged_dummies()
+        ids = jnp.zeros((1, self._chunk), jnp.int32)
+        target = f"serving.prefill_chunk[{self._chunk}]"
+        compiled, info = aot_compile(
+            self._prefill_chunk_fn, self._keep, self._quant, ids,
+            kpools, vpools, bt[:1], jnp.zeros((1,), jnp.int32),
+            jnp.asarray(0, jnp.int32), self._key, target=target)
+        self._prefill_chunk_compiled = compiled
+        stats[target] = info.stats
+        if self.spec_tokens:
+            kpools, vpools, bt = self._paged_dummies()
+            toksS = jnp.zeros((self.slots, self.spec_tokens + 1),
+                              jnp.int32)
+            compiled, info = aot_compile(
+                self._spec_verify, self._keep, self._quant, kpools,
+                vpools, bt, toksS, pos, active,
+                target="serving.spec_verify")
+            self._spec_verify_compiled = compiled
+            stats["serving.spec_verify"] = info.stats
+        return stats
+
     def analyze(self, strict: bool = False, passes=None, options=None):
         """Lint the compiled decode step (the hot serving path) with the
         ``paddle_tpu.analysis`` pipeline.  Abstract — nothing executes;
@@ -409,6 +678,12 @@ class ContinuousBatchingEngine:
         toks = jnp.zeros((self.slots,), jnp.int32)
         pos = jnp.zeros((self.slots,), jnp.int32)
         active = jnp.ones((self.slots,), jnp.bool_)
+        if self.paged:
+            kpools, vpools, bt = self._paged_dummies()
+            return _analysis.check(
+                self._decode_paged_raw, self._keep, self._quant, kpools,
+                vpools, bt, toks, pos, active, self._key, strict=strict,
+                passes=passes, options=options)
         report = _analysis.check(
             self._decode_raw, self._keep, self._quant, self._caches,
             toks, pos, active, self._key, strict=strict, passes=passes,
@@ -449,15 +724,43 @@ class ContinuousBatchingEngine:
         # must stay unreachable; chunked decode over-writes up to the next
         # steps_per_sync boundary, so budget in whole chunks
         K = self.steps_per_sync
-        chunks = -(-max_new_tokens // K) * K
-        if len(p) + chunks > self.max_len - 1:
-            raise ValueError(
-                f"prompt {len(p)} + max_new {max_new_tokens} (rounded to "
-                f"{chunks} by steps_per_sync={K}) exceeds max_len-1 = "
-                f"{self.max_len - 1} (last row is reserved)")
-        if len(p) > self.buckets[-1]:
+        if self.paged and self.spec_tokens:
+            # spec verify writes up to spec_tokens draft rows past the
+            # accepted position; budget that headroom up front
+            span = max_new_tokens + self.spec_tokens
+            if len(p) + span > self.max_len - 1:
+                raise ValueError(
+                    f"prompt {len(p)} + max_new {max_new_tokens} + "
+                    f"spec_decode={self.spec_tokens} draft headroom "
+                    f"exceeds max_len-1 = {self.max_len - 1}")
+        else:
+            chunks = -(-max_new_tokens // K) * K
+            if len(p) + chunks > self.max_len - 1:
+                raise ValueError(
+                    f"prompt {len(p)} + max_new {max_new_tokens} "
+                    f"(rounded to {chunks} by steps_per_sync={K}) "
+                    f"exceeds max_len-1 = {self.max_len - 1} (last row "
+                    "is reserved)")
+        if not self.paged and len(p) > self.buckets[-1]:
+            # paged mode has no bucket bound: chunked prefill walks any
+            # prompt that fits the block budget above
             raise ValueError(f"prompt {len(p)} exceeds largest prefill "
                              f"bucket {self.buckets[-1]}")
+        if self.paged:
+            # a request the EMPTY pool couldn't hold would starve in the
+            # queue forever — reject at submission, like the bucket and
+            # max_len bounds (transient exhaustion, by contrast, defers
+            # admission and resolves as running slots retire)
+            if self.spec_tokens:
+                span = max_new_tokens + self.spec_tokens
+            else:
+                span = -(-max_new_tokens // K) * K
+            worst = -(-(len(p) + span) // self._block_size)
+            if worst > self._num_blocks - 1:
+                raise ValueError(
+                    f"prompt {len(p)} + generation span {span} needs "
+                    f"{worst} KV blocks but the pool holds "
+                    f"{self._num_blocks - 1}; raise num_kv_blocks")
         rid = self._next_rid
         self._next_rid += 1
         timeout = timeout_s if timeout_s is not None \
@@ -544,9 +847,307 @@ class ContinuousBatchingEngine:
                 or self._budget[slot] <= 0:
             self._retire(slot)
 
+    # -- paged-KV scheduling (PADDLE_TPU_PAGED_KV=1) --------------------------
+    def _admit_paged(self, slot: int, req: _Request) -> bool:
+        """Reserve blocks for `slot` (prefix-cache hits arrive as shared
+        refs — those tokens never re-prefill) and mark it prefilling.
+        Returns False on allocator exhaustion: the request stays queued
+        and admission pressure backs up into the bounded queue, where
+        add_request already sheds load (QueueFullError)."""
+        from paddle_tpu.inference.kv_cache import SequenceBlocks
+        from paddle_tpu.robustness import fault_fires
+        bs = self._block_size
+        Lp = len(req.prompt)
+        if self.spec_tokens:
+            gen_span = req.max_new_tokens + self.spec_tokens
+        else:
+            K = self.steps_per_sync
+            gen_span = -(-req.max_new_tokens // K) * K
+        total = Lp + gen_span        # every position this slot may write
+        reuse_bids: List[int] = []
+        m = self._metrics
+        if self._prefix is not None:
+            matched = self._prefix.match(req.prompt)
+            # only FULL blocks strictly before the last prompt token are
+            # adopted: the final token always re-forwards (its logits
+            # seed generation) and must land in a private block — shared
+            # blocks are never written, so COW stays off the hot path
+            reuse_bids = matched[:(Lp - 1) // bs]
+            m["prefix_lookups"].labels(
+                result="hit" if reuse_bids else "miss").inc()
+        need = -(-total // bs) - len(reuse_bids)
+        exhausted = fault_fires("serving.kv_alloc", slot=slot,
+                                rid=req.rid, need=need)
+        if not exhausted and self._allocator.free_blocks < need and \
+                self._prefix is not None:
+            m["evictions"].inc(
+                self._prefix.evict(need - self._allocator.free_blocks))
+        if exhausted or self._allocator.free_blocks < need:
+            m["alloc_failures"].inc()
+            self._recorder.record(
+                "serving.kv_alloc_exhausted", rid=req.rid, need=need,
+                free=self._allocator.free_blocks,
+                injected=bool(exhausted))
+            return False
+        seq = SequenceBlocks(self._allocator, bs)
+        seq.adopt_shared(reuse_bids)
+        seq.ensure_capacity(total)   # free count checked above
+        self._seq[slot] = seq
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(seq.bids)] = seq.bids
+        reused = len(reuse_bids) * bs
+        req.prefix_reused = reused
+        req.admitted_at = time.perf_counter()
+        if reused:
+            m["prefix_tokens"].inc(reused)
+        m["admissions"].inc()
+        self._active[slot] = req
+        self._prefilling[slot] = reused   # next prompt pos to prefill
+        self._blocks_used_peak = max(self._blocks_used_peak,
+                                     self._allocator.used_blocks)
+        self._recorder.record("serving.admit", rid=req.rid, slot=slot,
+                              prompt_len=Lp, prefix_reused=reused,
+                              blocks=len(seq.bids))
+        return True
+
+    def _prefill_chunk_step(self, slot: int):
+        """Advance `slot`'s prefill by one fixed-width chunk.  The final
+        chunk samples the request's first token at the true last prompt
+        position and registers the prompt's full blocks in the prefix
+        trie (so the NEXT request with this prompt prefix skips them)."""
+        req = self._active[slot]
+        start = self._prefilling[slot]
+        Lp = len(req.prompt)
+        C = self._chunk
+        n = min(C, Lp - start)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = req.prompt[start:start + n]
+        final = (start + n) == Lp
+        last_idx = (Lp - 1 - start) if final else 0
+        sub = self._next_key()
+        prefill = self._prefill_chunk_compiled or self._prefill_chunk_fn
+        m = self._metrics
+        with self._tracer.span("serving.prefill", parent=req.span,
+                               rid=req.rid, chunk_start=start, tokens=n):
+            first, (self._pool.kpools, self._pool.vpools) = prefill(
+                self._keep, self._quant, jnp.asarray(ids),
+                self._pool.kpools, self._pool.vpools,
+                jnp.asarray(self._bt[slot:slot + 1]),
+                jnp.asarray([start], jnp.int32),
+                jnp.asarray(last_idx, jnp.int32), sub)
+            if final:
+                first = int(first)
+        self._prefilling[slot] = start + n
+        m["chunks"].inc()
+        if C > n:
+            m["pad_tokens"].inc(C - n)
+        if not final:
+            return
+        del self._prefilling[slot]
+        if self._prefix is not None:
+            # generated tokens are per-request noise — register only the
+            # prompt's full blocks (the trie takes its own ref on each)
+            self._prefix.register(req.prompt, self._seq[slot].bids,
+                                  limit_tokens=Lp)
+        req.first_token_at = time.perf_counter()
+        req.out.append(first)
+        m["tokens"].inc()
+        if req.enqueued_at:
+            m["ttft"].observe(time.perf_counter() - req.enqueued_at)
+        self._pos[slot] = Lp
+        self._budget[slot] = req.max_new_tokens - 1
+        self._last_tok[slot] = first
+        if (self.eos is not None and first == self.eos) \
+                or self._budget[slot] <= 0:
+            self._retire(slot)
+
+    def _ensure_writable_span(self, slots_: List[int], span: int):
+        """COW guard before a dispatch that writes `span` positions from
+        each slot's write head: any still-shared block in the span is
+        copied to a private one (device block copy) and the block table
+        is repointed.  Steady state is a no-op — the engine allocates
+        private decode blocks at admission."""
+        bs = self._block_size
+        for i in slots_:
+            seq = self._seq[i]
+            first = int(self._pos[i]) // bs
+            last = min((int(self._pos[i]) + span - 1) // bs,
+                       len(seq.bids) - 1)
+            for idx in range(first, last + 1):
+                if seq.ensure_writable(idx,
+                                       self._pool.copy_block) is not None:
+                    self._metrics["cow"].inc()
+                    self._bt[i, idx] = seq.bids[idx]
+
+    def _decode_step_paged(self, decoding: List[int]):
+        """One fused K-step decode over every decoding slot (the paged
+        analog of the tail of _step_inner)."""
+        active = np.zeros((self.slots,), bool)
+        active[decoding] = True
+        self._ensure_writable_span(decoding, self.steps_per_sync)
+        pos = np.where(active, self._pos, 0).astype(np.int32)
+        # non-decoding rows (free OR mid-prefill) get a zeroed block-
+        # table row: their masked write lands in the scratch block, not
+        # in a real sequence's (possibly shared) block 0
+        bt = np.where(active[:, None], self._bt, 0)
+        chunk_reqs = [self._active[i] for i in decoding]
+        sub = self._next_key()
+        t0 = time.perf_counter()
+        decode = self._decode_compiled or self._decode_paged
+        with self._recorder.instrumented("serving.decode"):
+            toks, self._pool.kpools, self._pool.vpools = decode(
+                self._keep, self._quant, self._pool.kpools,
+                self._pool.vpools, jnp.asarray(bt),
+                jnp.asarray(self._last_tok), jnp.asarray(pos),
+                jnp.asarray(active), sub)
+            toks = np.asarray(toks)                     # [B, K]
+        chunk_dt = time.perf_counter() - t0
+        K = toks.shape[1]
+        for r in chunk_reqs:
+            self._tracer.add_span("serving.decode_step", t0,
+                                  t0 + chunk_dt, parent=r.span,
+                                  rid=r.rid, tokens=K)
+        emitted = 0
+        for i in decoding:
+            req = self._active[i]
+            for j in range(K):
+                t = int(toks[i, j])
+                req.out.append(t)
+                emitted += 1
+                self._pos[i] += 1
+                self._budget[i] -= 1
+                self._last_tok[i] = t
+                if (self.eos is not None and t == self.eos) \
+                        or self._budget[i] <= 0:
+                    self._retire(i)
+                    break
+        m = self._metrics
+        m["steps"].inc()
+        if emitted:
+            m["tokens"].inc(emitted)
+            m["decode"].observe(chunk_dt / K)
+
+    def _spec_decode_step(self, decoding: List[int]):
+        """n-gram speculative decode: draft from each request's own
+        history, verify every row's [last, d1..dk] in ONE batched
+        forward, accept the longest draft prefix matching the argmax
+        chain plus one bonus token.  Greedy-equivalent by construction:
+        position j's argmax is conditioned only on tokens the chain has
+        already validated."""
+        k = self.spec_tokens
+        S = k + 1
+        active = np.zeros((self.slots,), bool)
+        active[decoding] = True
+        toks = np.zeros((self.slots, S), np.int32)
+        proposed = np.zeros((self.slots,), np.int64)
+        for i in decoding:
+            req = self._active[i]
+            toks[i, 0] = self._last_tok[i]
+            hist = np.concatenate([req.prompt,
+                                   np.asarray(req.out, np.int32)])
+            draft = _ngram_propose(hist, k, self._spec_ngram)
+            if draft is not None:
+                n = len(draft)
+                toks[i, 1:1 + n] = draft
+                toks[i, 1 + n:] = draft[-1]   # static-shape pad; unused
+                proposed[i] = n
+        self._ensure_writable_span(decoding, S)
+        pos = np.where(active, self._pos, 0).astype(np.int32)
+        bt = np.where(active[:, None], self._bt, 0)
+        t0 = time.perf_counter()
+        verify = self._spec_verify_compiled or self._spec_verify
+        with self._recorder.instrumented("serving.decode"):
+            greedy, self._pool.kpools, self._pool.vpools = verify(
+                self._keep, self._quant, self._pool.kpools,
+                self._pool.vpools, jnp.asarray(bt), jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(active))
+            greedy = np.asarray(greedy)                 # [B, S]
+        chunk_dt = time.perf_counter() - t0
+        m = self._metrics
+        emitted_total = 0
+        for i in decoding:
+            req = self._active[i]
+            n = int(proposed[i])
+            a = 0
+            while a < n and greedy[i, a] == toks[i, a + 1]:
+                a += 1
+            # a accepted drafts + the bonus token the verify computed at
+            # the last validated position (rejected rows' KV is stale
+            # but masked — the write head rolls back over it)
+            emitted = [int(t) for t in toks[i, 1:1 + a]] + \
+                [int(greedy[i, a])]
+            req.spec_proposed += n
+            req.spec_accepted += a
+            if n:
+                m["spec"].labels(kind="proposed").inc(n)
+                if a:
+                    m["spec"].labels(kind="accepted").inc(a)
+            self._tracer.add_span("serving.decode_step", t0,
+                                  t0 + chunk_dt, parent=req.span,
+                                  rid=req.rid, tokens=len(emitted),
+                                  drafts=n, accepted=a)
+            for t in emitted:
+                req.out.append(t)
+                emitted_total += 1
+                self._pos[i] += 1
+                self._budget[i] -= 1
+                self._last_tok[i] = t
+                if (self.eos is not None and t == self.eos) \
+                        or self._budget[i] <= 0:
+                    self._retire(i)
+                    break
+        m["steps"].inc()
+        if emitted_total:
+            m["tokens"].inc(emitted_total)
+            # wall time per token, averaged over the per-slot haul
+            m["decode"].observe(
+                chunk_dt * len(decoding) / emitted_total)
+
+    def _step_inner_paged(self) -> bool:
+        from paddle_tpu.robustness import fault_point
+        fault_point("serving.engine_step",
+                    active=sum(r is not None for r in self._active),
+                    queued=len(self._queue))
+        free = [i for i, r in enumerate(self._active) if r is None]
+        if free and self._queue:
+            if self._admit_paged(free[0], self._queue[0]):
+                self._queue.popleft()
+                return True
+            # allocator dry: the request stays queued (add_request
+            # already rejected anything the empty pool couldn't hold, so
+            # retiring slots / evicting cached prefixes will free enough
+            # blocks eventually; deadlines still bound the wait)
+        if all(r is None for r in self._active):
+            return bool(self._queue)
+        decoding = [i for i, r in enumerate(self._active)
+                    if r is not None and i not in self._prefilling]
+        # chunked prefill interleaves with decode: alternate dispatches
+        # so a kilotoken prompt can't stall in-flight requests' TPOT,
+        # and an idle decode pool can't starve TTFT
+        do_chunk = bool(self._prefilling) and (
+            not decoding or self._interleave_decode)
+        self._interleave_decode = not self._interleave_decode
+        if do_chunk:
+            self._prefill_chunk_step(min(self._prefilling))
+            return True
+        if not decoding:
+            return True
+        if self.spec_tokens:
+            self._spec_decode_step(decoding)
+        else:
+            self._decode_step_paged(decoding)
+        return True
+
     def _retire(self, slot: int, status: str = "ok"):
         req = self._active[slot]
         self._active[slot] = None
+        if self.paged:
+            self._prefilling.pop(slot, None)
+            seq = self._seq[slot]
+            if seq is not None:
+                seq.release()   # shared prefix blocks stay in the trie
+            self._seq[slot] = None
+            self._bt[slot, :] = 0
         self._finish(req, slot=slot, status=status)
 
     def _finish(self, req: _Request, slot: Optional[int] = None,
@@ -623,13 +1224,28 @@ class ContinuousBatchingEngine:
         for slot, req in enumerate(self._active):
             if req is not None:
                 self._retire(slot, status="error")
-        cfgm = self.model.config
-        kv_shape = (self.slots, self.max_len, cfgm.num_key_value_heads,
-                    cfgm.head_dim)
-        self._caches = [
-            (jnp.zeros(kv_shape, self._dtype),
-             jnp.zeros(kv_shape, self._dtype))
-            for _ in range(cfgm.num_hidden_layers)]
+        if self.paged:
+            # the failed donated call may have consumed the pools; the
+            # host bookkeeping may be mid-flight — rebuild both from
+            # scratch (the prefix cache is warm state, safe to drop)
+            from paddle_tpu.inference.kv_cache import (BlockAllocator,
+                                                       PrefixCache)
+            self._allocator = BlockAllocator(self._num_blocks)
+            if self._prefix is not None:
+                self._prefix = PrefixCache(self._block_size,
+                                           self._allocator)
+            self._pool.reset()
+            self._bt[:] = 0
+            self._seq = [None] * self.slots
+            self._prefilling.clear()
+        else:
+            cfgm = self.model.config
+            kv_shape = (self.slots, self.max_len,
+                        cfgm.num_key_value_heads, cfgm.head_dim)
+            self._caches = [
+                (jnp.zeros(kv_shape, self._dtype),
+                 jnp.zeros(kv_shape, self._dtype))
+                for _ in range(cfgm.num_hidden_layers)]
         self._pos[:] = 0
         self._budget[:] = 0
         self._last_tok[:] = 0
@@ -642,7 +1258,8 @@ class ContinuousBatchingEngine:
         the engine (see :meth:`_recover`)."""
         self._expire()
         try:
-            out = self._step_inner()
+            out = self._step_inner_paged() if self.paged \
+                else self._step_inner()
         except Exception as e:  # KeyboardInterrupt etc. still propagate
             self._recover(e)
             return bool(self._queue) or \

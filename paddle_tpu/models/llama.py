@@ -122,6 +122,18 @@ class LlamaAttention(Layer):
                 out = M.reshape(out,
                                 [b, s, self.num_heads * self.head_dim])
                 return self.o_proj(out), new_cache
+            from paddle_tpu.inference.kv_cache import (PagedCache,
+                                                       paged_cache_attention)
+            if isinstance(cache, PagedCache):
+                # paged serving path: KV lives in block pools addressed by
+                # a per-row block table (prefix blocks shared COW across
+                # requests); supports per-row offsets at s > 1, which is
+                # what chunked prefill and batched speculative verify need
+                out, new_cache = paged_cache_attention(
+                    q, k, v, cache, position_offset, attn_mask)
+                out = M.reshape(out,
+                                [b, s, self.num_heads * self.head_dim])
+                return self.o_proj(out), new_cache
             pk, pv = cache
             k = M.concat([pk, k], axis=1)
             v = M.concat([pv, v], axis=1)

@@ -32,6 +32,11 @@ point                                 site
 ``serving.engine_step``               raises inside the serving engine's
                                       scheduling step (device fault /
                                       bad batch)
+``serving.kv_alloc``                  simulates paged-KV block-pool
+                                      exhaustion at admission (bool-style:
+                                      the engine must shed load through
+                                      the bounded-admission path — defer,
+                                      never crash)
 ====================================  =====================================
 
 Env syntax (comma-separated specs, colon-separated options)::

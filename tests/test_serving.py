@@ -168,6 +168,33 @@ class TestChunkedDecode:
             tiny_model.eval()
 
 
+class TestPagedKnobRegression:
+    """PADDLE_TPU_PAGED_KV=0 (or unset) must reproduce the exact
+    previous engine; =1 must be token-for-token greedy-identical.
+    (The paged engine's own suite lives in tests/test_kv_cache.py.)"""
+
+    def test_default_is_unpaged(self, tiny_model, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_PAGED_KV", raising=False)
+        eng = ContinuousBatchingEngine(tiny_model, slots=1, max_len=64,
+                                       prefill_buckets=(16,))
+        assert not eng.paged
+        assert hasattr(eng, "_caches")       # slot-contiguous buffers
+
+    def test_knob_zero_matches_knob_one(self, tiny_model, monkeypatch):
+        rng = np.random.default_rng(40)
+        prompt = rng.integers(0, 256, (12,))
+        outs = {}
+        for knob in ("0", "1"):
+            monkeypatch.setenv("PADDLE_TPU_PAGED_KV", knob)
+            eng = ContinuousBatchingEngine(
+                tiny_model, slots=2, max_len=64, prefill_buckets=(16,))
+            assert eng.paged == (knob == "1")
+            rid = eng.add_request(prompt, max_new_tokens=8)
+            outs[knob] = eng.run()[rid][1]
+        assert outs["0"] == outs["1"]
+        assert outs["0"] == _reference(tiny_model, prompt, 8)
+
+
 class TestSampling:
     def test_near_zero_temperature_matches_greedy(self, tiny_model):
         """do_sample with temperature -> 0 degenerates to argmax: exact
