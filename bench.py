@@ -110,6 +110,13 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
             regressions.append(
                 f"{key} {float(cl):.4f} > prev {float(pl):.4f} + "
                 f"{tolerance:.0%} tolerance")
+    # replica cold-start (both artifacts must carry the section)
+    pw = (pd.get("cold_start") or {}).get("warmup_wall_s")
+    cw = (cd.get("cold_start") or {}).get("warmup_wall_s")
+    if pw and cw and float(cw) > float(pw) * (2.0 + tolerance):
+        regressions.append(
+            f"cold_start.warmup_wall_s {float(cw):.4f} > prev "
+            f"{float(pw):.4f} x (2 + {tolerance:.0%})")
     return regressions
 
 
@@ -131,6 +138,16 @@ def compare_records(cur: dict, prev: dict, tolerance: float = 0.05):
         regressions.append(
             f"step_time_s {float(ct):.4f} > prev {float(pt):.4f} + "
             f"{tolerance:.0%} tolerance")
+    # cold-start trajectory (only once both artifacts carry the section;
+    # compile wall time on a shared host is noisy, so the bar is a 2x+
+    # blowup past tolerance rather than drift)
+    pc = (prev.get("detail") or {}).get("cold_start") or {}
+    cc = (cur.get("detail") or {}).get("cold_start") or {}
+    pt, ct = pc.get("total_s"), cc.get("total_s")
+    if pt and ct and float(ct) > float(pt) * (2.0 + tolerance):
+        regressions.append(
+            f"cold_start.total_s {float(ct):.4f} > prev {float(pt):.4f} "
+            f"x (2 + {tolerance:.0%})")
     return regressions
 
 
@@ -222,7 +239,10 @@ def main(argv=None):
     # explicit AOT compile first: the measured run dispatches through the
     # compiled executable (no first-step compile spike inside timing) and
     # lower/compile wall time + XLA's flops/bytes/peak-memory become part
-    # of the artifact
+    # of the artifact.  With PADDLE_TPU_COMPILE_CACHE=1 this consults the
+    # persistent executable cache — a warm cache turns trace+compile into
+    # a deserialize-and-load, which is the cold-start story the
+    # `cold_start` detail section below records.
     compile_info = step.compile(batch_dict)
 
     # device prefetch: H2D for batch N+1 rides behind step N instead of
@@ -233,8 +253,14 @@ def main(argv=None):
         for _ in range(n):
             yield batch_dict
 
+    first_step_s = None
     for b in device_prefetch(batches(warmup), depth=2):
+        t0 = time.perf_counter()
         step(b)
+        if first_step_s is None:
+            import jax as _jax
+            _jax.block_until_ready(step.params)
+            first_step_s = time.perf_counter() - t0
     jax.block_until_ready(step.params)
     # min-of-windows timing: the tunneled chip shows run-to-run noise
     # (observed 0.50-0.514 MFU for the identical executable); the fastest
@@ -310,6 +336,32 @@ def main(argv=None):
             device_profile = {"error": f"{type(e).__name__}: {e}"}
     live_watermark = device_memory_monitor().watermark
 
+    # cold-start ledger (ROADMAP 5): how long from process start to a
+    # runnable step — trace, compile-or-load (cache hit → deserialize
+    # time), first real step — plus the compile-cache counters that say
+    # WHICH path this run took.  --compare guards it once two artifacts
+    # carry the section.
+    from paddle_tpu import compile_cache
+    cache_series = _series("paddle_tpu_compile_cache_total")
+    cold_start = {
+        "trace_s": round(compile_info.lower_s, 4),
+        "compile_or_load_s": round(compile_info.compile_s, 4),
+        "first_step_s": round(first_step_s or 0.0, 4),
+        "total_s": round(compile_info.lower_s + compile_info.compile_s
+                         + (first_step_s or 0.0), 4),
+        "cache_hit": bool(compile_info.cached),
+        "cache_enabled": compile_cache.enabled(),
+        "cache": {
+            "hit": sum(v for k, v in cache_series.items()
+                       if k.endswith("/hit")),
+            "miss": sum(v for k, v in cache_series.items()
+                        if k.endswith("/miss")),
+            "deserialize_error": sum(
+                v for k, v in cache_series.items()
+                if k.endswith("/deserialize_error")),
+        },
+    }
+
     prev = _prev_value()
     result = {
         "metric": "llama_pretrain_mfu",
@@ -336,6 +388,7 @@ def main(argv=None):
             "peak_hbm_bytes": compile_info.stats.peak_bytes,
             "device_live_bytes_watermark": live_watermark,
             "device_profile": device_profile,
+            "cold_start": cold_start,
         },
     }
     print(json.dumps(result))

@@ -167,12 +167,23 @@ def main(argv=None):
 
     prompts, arrivals = _workload(args, cfg.vocab_size)
     eng = _build_engine(model, args, paged)
-    # warmup outside the timed window: compile prefill/decode (and let
-    # the paged engine's first request pay the trace) on a throwaway
+    # explicit AOT warmup outside the timed window: compiles (or, with
+    # PADDLE_TPU_COMPILE_CACHE=1, deserialize-and-loads) every serving
+    # executable up front — the replica cold-start cost is a measured
+    # number, not a first-request latency spike
+    t_warm0 = time.perf_counter()
+    warm_stats = eng.aot_warmup()
+    warmup_s = time.perf_counter() - t_warm0
+    from paddle_tpu.observability.device_profiler import compile_records
+    warm_recs = [r for r in compile_records()
+                 if r.target in warm_stats]
+    # one throwaway request flushes any remaining lazy init
     w = eng.add_request(prompts[0][: max(2, len(prompts[0]) // 2)],
                         max_new_tokens=2)
     eng.run()
-    eng.request_status(w)
+    st_warm = eng.request_status(w)
+    first_token_s = (st_warm.timings.get("ttft_s")
+                     if st_warm is not None else None)
 
     results, rids, t0, t1 = _run_workload(eng, prompts, arrivals,
                                           args.max_new)
@@ -215,6 +226,32 @@ def main(argv=None):
         "spec_tokens": _series("paddle_tpu_serving_spec_tokens_total"),
         "spec_accept_rate_mean": (float(np.mean(accept_rates))
                                   if accept_rates else None),
+    }
+    # replica cold-start ledger (ROADMAP 5): wall time to acquire every
+    # serving executable (trace+compile live, or deserialize on a
+    # compile-cache hit), TTFT of the first request after warmup, and
+    # the cache counters that say which path this boot took
+    from paddle_tpu import compile_cache
+    cache_series = _series("paddle_tpu_compile_cache_total")
+    detail["cold_start"] = {
+        "trace_s": round(sum(r.lower_s for r in warm_recs), 4),
+        "compile_or_load_s": round(
+            sum(r.compile_s for r in warm_recs), 4),
+        "warmup_wall_s": round(warmup_s, 4),
+        "first_token_s": (round(first_token_s, 4)
+                          if first_token_s else None),
+        "executables": len(warm_stats),
+        "cache_hits": sum(1 for r in warm_recs if r.cached),
+        "cache_enabled": compile_cache.enabled(),
+        "cache": {
+            "hit": sum(v for k, v in cache_series.items()
+                       if k.endswith("/hit")),
+            "miss": sum(v for k, v in cache_series.items()
+                        if k.endswith("/miss")),
+            "deserialize_error": sum(
+                v for k, v in cache_series.items()
+                if k.endswith("/deserialize_error")),
+        },
     }
     if paged:
         detail["kv_blocks_total"] = eng._num_blocks - 1

@@ -13,7 +13,7 @@ from paddle_tpu.distributed.env import (  # noqa: F401
 from paddle_tpu.distributed.communication import (  # noqa: F401
     Group, ReduceOp, all_gather, all_reduce, all_to_all, barrier, broadcast,
     get_group, new_group, ppermute, recv, reduce, reduce_scatter, scatter,
-    send, shift)
+    send, shard_map, shift)
 from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
     Partial, ProcessMesh, Replicate, Shard, dtensor_from_fn, get_mesh,
     reshard, set_mesh, shard_layer, shard_op, shard_tensor)

@@ -34,14 +34,64 @@ from jax import lax
 __all__ = [
     "ReduceOp", "all_reduce", "all_gather", "all_to_all", "reduce_scatter",
     "broadcast", "reduce", "scatter", "send", "recv", "barrier", "ppermute",
-    "new_group", "get_group", "Group", "shift",
+    "new_group", "get_group", "Group", "shift", "shard_map",
+    "axis_size", "vma_of",
 ]
+
+_SHARD_MAP = None
+
+
+def _resolve_shard_map():
+    """The jax shard_map entry point, wherever this jax version keeps
+    it: ``jax.shard_map`` (0.6+) first, then the long-lived
+    ``jax.experimental.shard_map.shard_map`` (0.4.x) — on 0.4.37
+    ``from jax import shard_map`` raises ImportError, which used to
+    take the whole sequence-parallel/MoE/pipeline family down with
+    it."""
+    global _SHARD_MAP
+    if _SHARD_MAP is None:
+        try:
+            from jax import shard_map as sm          # jax >= 0.6
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+        _SHARD_MAP = sm
+    return _SHARD_MAP
+
+
+def shard_map(fn, mesh=None, in_specs=None, out_specs=None, **kwargs):
+    """Version-compat ``shard_map``: identical signature to jax's, so
+    every SPMD call site in this package (and user code) routes through
+    one resolver instead of guessing the import path per jax release.
+
+    ``legacy_check_rep=False`` relaxes the 0.4.x replication checker
+    ONLY (newer jax tracks varying-manual-axes precisely via pvary, so
+    its check stays on): the old static inference cannot see through a
+    pipelined-backward psum, and rejects out_specs whose values are
+    replicated by construction."""
+    impl = _resolve_shard_map()
+    legacy = kwargs.pop("legacy_check_rep", None)
+    if legacy is not None and "experimental" in getattr(impl,
+                                                        "__module__", ""):
+        kwargs.setdefault("check_rep", legacy)
+    return impl(fn, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, **kwargs)
+
+
+def vma_of(x):
+    """The varying-manual-axes set of ``x`` under shard_map tracing, or
+    None on jax builds without ``jax.typeof`` (0.4.x has no vma
+    tracking; on newer jax the bare attribute access RAISES through the
+    deprecation machinery, so every caller must come through here)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    return getattr(typeof(x), "vma", None)
 
 
 def pvary(x, axis_name: str):
     """Mark `x` as device-varying over `axis_name` — needed for scan carries
     inside shard_map whose value becomes varying (e.g. after a ppermute)."""
-    vma = getattr(jax.typeof(x), "vma", None) if hasattr(jax, "typeof") else None
+    vma = vma_of(x)
     if vma is not None and axis_name in vma:
         return x  # already varying over this axis
     if hasattr(lax, "pcast"):
@@ -60,11 +110,11 @@ def pvary_like(x, ref, fallback_axes=()):
     without ``jax.typeof`` the ref's axes can't be inspected —
     ``fallback_axes`` (the axes the caller KNOWS are in play) keep the
     old pvary behavior there."""
-    if not hasattr(jax, "typeof"):
+    if getattr(jax, "typeof", None) is None:
         missing = tuple(fallback_axes)
     else:
-        want = getattr(jax.typeof(ref), "vma", None)
-        have = getattr(jax.typeof(x), "vma", None)
+        want = vma_of(ref)
+        have = vma_of(x)
         if not want:
             return x
         missing = tuple(a for a in want if have is None or a not in have)
@@ -96,12 +146,22 @@ def _wrap_like(x, ref):
     return x
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis.  ``lax.axis_size`` where this
+    jax has it (0.6+); on 0.4.x a ``psum`` of a python scalar constant-
+    folds to the axis size (and raises NameError when the axis is
+    unbound — same contract)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _in_trace(axis_name) -> bool:
     """True when `axis_name` is bound by an enclosing shard_map/pmap."""
     if axis_name is None:
         return False
     try:
-        lax.axis_size(axis_name)
+        axis_size(axis_name)
         return True
     except (NameError, KeyError, ValueError):
         return False
@@ -144,7 +204,7 @@ def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True,
     else:
         out = x
     if out_list is not None:
-        n = lax.axis_size(axis_name) if _in_trace(axis_name) else 1
+        n = axis_size(axis_name) if _in_trace(axis_name) else 1
         for piece in jnp.split(out, n, axis=axis):
             out_list.append(_wrap_like(piece, tensor))
         return None
@@ -218,7 +278,7 @@ def scatter(tensor, tensor_list=None, src: int = 0, group=None, sync_op=True,
     axis_name = axis_name or (group.axis_name if group else None)
     x = _unwrap(tensor)
     if _in_trace(axis_name):
-        n = lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         idx = lax.axis_index(axis_name)
         full = lax.psum(jnp.where(idx == src, x, jnp.zeros_like(x)),
                         axis_name)
@@ -244,7 +304,7 @@ def shift(tensor, offset: int = 1, axis_name: Optional[str] = None,
     """Rotate values around the axis ring by `offset` (ring-attention /
     pipeline microbatch rotation primitive)."""
     axis_name = axis_name or (group.axis_name if group else None)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     perm = [(i, (i + offset) % n) for i in range(n)]
     return ppermute(tensor, perm, axis_name=axis_name)
 

@@ -58,6 +58,16 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
         process_id = int(r) if r else None
 
     if coordinator_address and (num_processes or 0) > 1:
+        # multi-process on the CPU backend (launch tests, local sims)
+        # needs an explicit cross-process collectives implementation —
+        # the default 'none' raises "Multiprocess computations aren't
+        # implemented on the CPU backend" at the first collective.  Must
+        # be set BEFORE the backend initializes; harmless elsewhere.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)

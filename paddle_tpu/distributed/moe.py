@@ -356,8 +356,9 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
     silent.  ``dispatch`` picks the shard body: "einsum" (one-hot
     contraction, :func:`moe_shard_a2a`) or "index" (O(T·k·d)
     scatter/gather build, :func:`moe_shard_index_a2a`)."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.communication import shard_map
 
     if dispatch not in ("einsum", "index"):
         raise ValueError(f"unknown a2a dispatch {dispatch!r}")
@@ -390,6 +391,11 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
                   P(ep_axis)),
         out_specs=(P(ep_axis), P(), P()))
     out, aux, dropped = mapped(x2d, gate_w, w1, b1, w2, b2)
+    # couple the scalar outputs into `out`'s dataflow with a zero-weight
+    # term: a caller differentiating only `out` then sends DENSE zero
+    # cotangents into aux/dropped instead of symbolic Zeros, which jax
+    # 0.4.x's shard_map transpose mishandles ('Zero' has no .reshape)
+    out = out + (0.0 * (aux + dropped)).astype(out.dtype)
     if with_stats:
         return out.reshape(shape), aux, dropped
     return out.reshape(shape), aux

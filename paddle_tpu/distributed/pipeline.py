@@ -36,6 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from paddle_tpu.distributed.communication import axis_size as _axis_size, \
+    vma_of as _vma_of
 from paddle_tpu.jit.train_step import CompiledStepBase as _TrainStepBase
 from paddle_tpu.nn.layer import Layer
 
@@ -229,7 +231,7 @@ def spmd_pipeline(stage_fn: Callable, stage_params: Any, microbatches,
     ``t - s`` (when in range) — the classic GPipe wavefront; ppermute
     rotates boundary activations one hop per tick over ICI.
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = num_microbatches
     mb_shape = microbatches.shape[1:]
@@ -352,7 +354,7 @@ def _varying_axes(axis_name, *trees):
     branch output / scan carry is marked varying over the full set."""
     axes = {axis_name}
     for v in jax.tree.leaves(trees):
-        vma = getattr(jax.typeof(v), "vma", None)
+        vma = _vma_of(v)
         if vma:
             axes |= set(vma)
     return tuple(sorted(axes))
@@ -411,7 +413,7 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
     loss is valid on the last stage (psum'd over pp so every stage sees
     it), stage grads are per-stage.
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = num_microbatches
     from paddle_tpu.distributed.communication import pvary
@@ -546,7 +548,7 @@ def pipeline_1f1b(stage_fn: Callable, first_fn: Callable, last_fn: Callable,
                                     lparams, x_saved)
                 # the seed's varying-axes set must match val's (under a
                 # multi-axis mesh the loss also varies over dp/tp axes)
-                vma = getattr(jax.typeof(val), "vma", None)
+                vma = _vma_of(val)
                 seed = _pvary_axes(jnp.ones((), val.dtype),
                                    vma or (axis_name,))
                 dp, dfp, dlp, dx = pull(seed)
@@ -741,7 +743,7 @@ def pipeline_interleaved(stage_fn: Callable, first_fn: Callable,
     banking tables below encode exactly which (chunk, mb) each tick's
     incoming payload belongs to.
     """
-    S = lax.axis_size(axis_name)
+    S = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     M = num_microbatches
     V = num_chunks
@@ -879,7 +881,7 @@ def pipeline_interleaved(stage_fn: Callable, first_fn: Callable,
         def do_bwd(_):
             def run(loss_like):
                 val, pull = jax.vjp(loss_like, params_c, x_saved)
-                vma = getattr(jax.typeof(val), "vma", None)
+                vma = _vma_of(val)
                 seed = _pvary_axes(jnp.ones((), val.dtype),
                                    vma or (axis_name,))
                 dp, dx = pull(seed)
@@ -1127,7 +1129,15 @@ def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
         # vma cleanup: pmean over any axis the grad still varies on
         # but its out_spec omits (values already equal across them)
         present = _spec_axes(spec)
-        vma = getattr(jax.typeof(g), "vma", None) or ()
+        vma = _vma_of(g)
+        if vma is None:
+            # jax 0.4.x: no vma tracking means no auto-inserted psum in
+            # the vjp — grads of params invariant on an axis come back
+            # as RAW per-device partial sums; reduce them explicitly
+            # (the uniform 1/D scale above turns sums into means)
+            for ax in manual - present - set(exclude):
+                g = lax.psum(g, ax)
+            return g
         for ax in manual - present - set(exclude):
             if ax in vma:
                 g = lax.pmean(g, ax)
@@ -1164,7 +1174,7 @@ def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
         # uniform 1/D turns every leaf into the global-batch mean.
         d_total = 1
         for ax in data_axes:
-            d_total *= lax.axis_size(ax)
+            d_total *= _axis_size(ax)
         scale = 1.0 / d_total
         norm = lambda tr: None if tr is None else jax.tree.map(
             lambda g: g * scale, tr)
@@ -1172,7 +1182,7 @@ def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
             norm(g_last)
         for ax in data_axes:
             loss = lax.pmean(loss, ax)
-        vma_l = getattr(jax.typeof(loss), "vma", None) or ()
+        vma_l = _vma_of(loss) or ()
         for ax in manual - set(data_axes):
             if ax in vma_l:  # e.g. tp: equal across shards, clean vma
                 loss = lax.pmean(loss, ax)
@@ -1191,9 +1201,10 @@ def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
                 return None
             out = {}
             for n, g in tr.items():
+                vma = _vma_of(g)
                 for ax in data_axes:
-                    if ax != fsdp and ax in (
-                            getattr(jax.typeof(g), "vma", None) or ()):
+                    # no vma tracking (0.4.x) → partials, always reduce
+                    if ax != fsdp and (vma is None or ax in vma):
                         g = lax.psum(g, ax)
                 if fsdp:
                     pos = _spec_axis_pos(specs[prefix + n], fsdp)
@@ -1212,15 +1223,31 @@ def build_pipeline_step_fn(stage_fn, first_fn, last_fn, optimizer, mesh,
         for prefix, tr in (("first/", g_first), ("last/", g_last)):
             if tr is not None:
                 for n, g in tr.items():
-                    merged[prefix + n] = reduce_leaf(
-                        g, specs[prefix + n])
+                    if _vma_of(g) is None:
+                        # 0.4.x (no vma tracking): pp (psum_tree inside
+                        # pipeline_1f1b) and the data axes (group_reduce
+                        # above) are ALREADY summed — a pessimistic psum
+                        # there would double-count; what remains (e.g.
+                        # tp) is still raw per-device vjp partials, and
+                        # reduce_leaf's unconditional psum closes them
+                        merged[prefix + n] = reduce_leaf(
+                            g, specs[prefix + n],
+                            exclude=(pp_axis,) + tuple(data_axes))
+                    else:
+                        merged[prefix + n] = reduce_leaf(
+                            g, specs[prefix + n])
         return loss, merged
 
+    from paddle_tpu.distributed.communication import shard_map
+
     batch_spec = P(None, data_axes) if data_axes else P()
-    shmap = jax.shard_map(
+    # grads ARE replicated over the data axes (group_reduce psums them)
+    # but jax 0.4.x's static rep inference can't see through the
+    # pipelined backward — legacy_check_rep only relaxes the old checker
+    shmap = shard_map(
         body, mesh=mesh,
         in_specs=(dict(specs), batch_spec, batch_spec),
-        out_specs=(P(), dict(specs)))
+        out_specs=(P(), dict(specs)), legacy_check_rep=False)
 
     def step_impl(params, opt_state, step_count, mb_inputs, mb_labels,
                   lr):

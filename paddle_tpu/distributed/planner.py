@@ -295,6 +295,7 @@ def _llama_stage_fn(cfg: DenseConfig):
         import jax
         from jax import lax
 
+        from paddle_tpu.distributed.communication import vma_of as _vma_of
         from paddle_tpu.distributed.pipeline import _pvary_axes
 
         layers = jax.tree.map(lambda a: a[0], p)   # drop pp remnant axis
@@ -304,10 +305,9 @@ def _llama_stage_fn(cfg: DenseConfig):
         # tp-varying product is closed by an explicit psum in the block)
         axes = set()
         for v in jax.tree.leaves(layers):
-            axes |= set(getattr(jax.typeof(v), "vma", None) or ())
+            axes |= set(_vma_of(v) or ())
         axes -= {"tp"}
-        x = _pvary_axes(x, axes - set(getattr(jax.typeof(x), "vma",
-                                              None) or ()))
+        x = _pvary_axes(x, axes - set(_vma_of(x) or ()))
 
         def blk(xc, lp):
             return _llama_block(cfg, xc, lp), None

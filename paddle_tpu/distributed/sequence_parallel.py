@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from paddle_tpu.distributed.communication import axis_size as _axis_size
+
 __all__ = ["ring_attention", "ulysses_attention", "make_ring_attention",
            "make_ulysses_attention"]
 
@@ -42,7 +44,7 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     sequence is the concatenation over the sp axis in rank order.
     Returns the local output shard [batch, seq_local, heads, head_dim].
     """
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, s, h, d = q.shape
     if scale is None:
@@ -108,7 +110,7 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
     `attn_fn(q, k, v, causal, scale)` defaults to the XLA sdpa; pass the
     flash kernel for long sequences.
     """
-    sp = lax.axis_size(axis_name)
+    sp = _axis_size(axis_name)
     b, s, h, d = q.shape
     if h % sp:
         raise ValueError(f"heads {h} not divisible by sp={sp}")
@@ -132,12 +134,16 @@ def ulysses_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
 def _wrap_shard_map(fn, mesh, axis_name, seq_axis=1):
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from paddle_tpu.distributed.communication import shard_map
     spec = [None, None, None, None]
     spec[seq_axis] = axis_name
     spec = P(*spec)
+    # every operand is sp-sharded (nothing replicated → no auto-psum to
+    # lose); 0.4.x's rep checker trips on the pvary-less scan carry, so
+    # relax it there only
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)
+                     out_specs=spec, legacy_check_rep=False)
 
 
 def make_ring_attention(mesh, axis_name: str = "sp", causal: bool = False,

@@ -585,6 +585,7 @@ class ContinuousBatchingEngine:
         # executables; dispatch prefers them (no first-request compile
         # spike)
         self._decode_compiled = None
+        self._insert_compiled = None
         self._prefill_compiled: Dict[int, object] = {}
 
         from paddle_tpu.analysis import analysis_mode
@@ -595,42 +596,81 @@ class ContinuousBatchingEngine:
             if len(report):
                 print(report.format(), file=sys.stderr)
 
-    def aot_warmup(self, buckets: Optional[Sequence[int]] = None):
+    def _cache_extra(self) -> str:
+        """Compile-cache key discriminators invisible to call-argument
+        avals: closed-over sampling config, chunking, and the model
+        config whose constants (rope tables, eps) are baked into the
+        traced programs."""
+        from paddle_tpu import compile_cache
+        gc = self._gen_cfg
+        return (f"model={compile_cache.model_config_tag(self.model)}"
+                f"|gc={gc.do_sample}:{gc.temperature}:{gc.top_k}"
+                f":{gc.top_p}|K={self.steps_per_sync}"
+                f"|int8={int(self.int8)}|paged={int(self.paged)}"
+                f"|spec={self.spec_tokens}")
+
+    def aot_warmup(self, buckets: Optional[Sequence[int]] = None,
+                   cache_only: bool = False):
         """Explicitly compile the serving executables up front — the
-        decode step and one prefill per prompt bucket — with full
-        compile observability (``compile.lower``/``compile.xla`` spans,
-        ``paddle_tpu_compile_total{target}`` counters, per-executable
-        FLOPs / HBM bytes / peak-memory gauges).  The engine then
+        decode step, one prefill per prompt bucket (plus the admission
+        insert) or the chunked-prefill / spec-verify programs in paged
+        mode — with full compile observability (``compile.lower``/
+        ``compile.xla`` spans, ``paddle_tpu_compile_total{target}``
+        counters, per-executable FLOPs / HBM bytes / peak-memory
+        gauges).  With ``PADDLE_TPU_COMPILE_CACHE=1`` every executable
+        is served from (or stored into) the persistent compile cache:
+        a warm replica boots to first token with ZERO XLA compiles.
+        ``cache_only=True`` adopts cached executables but never pays a
+        live compile — the ``_recover`` re-warm path.  The engine then
         dispatches through the compiled objects: no first-request
         compile spike, a shape drift raises instead of silently
         recompiling, and a restarting replica's warmup cost is a
         measured number (ROADMAP item 5's cold-start budget).  Returns
-        ``{target: ExecutableStats}``."""
-        from paddle_tpu.observability.device_profiler import aot_compile
+        ``{target: ExecutableStats}`` of every executable acquired."""
+        from paddle_tpu import compile_cache
         stats = {}
+        extra = self._cache_extra()
+
+        def warm(fn, *args, target):
+            compiled, info, _hit = compile_cache.aot_compile_cached(
+                fn, *args, target=target, extra=extra,
+                cache_only=cache_only)
+            if compiled is not None:
+                stats[target] = info.stats
+            return compiled
+
         toks = jnp.zeros((self.slots,), jnp.int32)
         pos = jnp.zeros((self.slots,), jnp.int32)
         active = jnp.ones((self.slots,), jnp.bool_)
         if self.paged:
-            return self._aot_warmup_paged(aot_compile, toks, pos, active)
-        compiled, info = aot_compile(
-            self._decode, self._keep, self._quant, self._caches, toks,
-            pos, active, self._key, target="serving.decode")
-        self._decode_compiled = compiled
-        stats["serving.decode"] = info.stats
+            self._aot_warmup_paged(warm, toks, pos, active)
+            return stats
+        c = warm(self._decode, self._keep, self._quant, self._caches,
+                 toks, pos, active, self._key, target="serving.decode")
+        if c is not None:
+            self._decode_compiled = c
         cfgm = self.model.config
         shape1 = (1, self.max_len, cfgm.num_key_value_heads, cfgm.head_dim)
+
+        def kv1():
+            return [(jnp.zeros(shape1, self._dtype),
+                     jnp.zeros(shape1, self._dtype))
+                    for _ in range(cfgm.num_hidden_layers)]
+
+        # the slot insert is bookkeeping-sized but still an XLA compile
+        # on the first admission — warm it too, so a warm-cache fresh
+        # process admits its first request without any compile
+        c = warm(self._insert, self._caches, kv1(),
+                 jnp.asarray(0, jnp.int32), target="serving.insert")
+        if c is not None:
+            self._insert_compiled = c
         for b in (buckets or self.buckets):
             ids = jnp.zeros((1, b), jnp.int32)
-            kv1 = [(jnp.zeros(shape1, self._dtype),
-                    jnp.zeros(shape1, self._dtype))
-                   for _ in range(cfgm.num_hidden_layers)]
             target = f"serving.prefill[{b}]"
-            compiled, info = aot_compile(
-                self._prefill, self._keep, self._quant, ids, kv1,
-                jnp.asarray(b, jnp.int32), self._key, target=target)
-            self._prefill_compiled[b] = compiled
-            stats[target] = info.stats
+            c = warm(self._prefill, self._keep, self._quant, ids, kv1(),
+                     jnp.asarray(b, jnp.int32), self._key, target=target)
+            if c is not None:
+                self._prefill_compiled[b] = c
         return stats
 
     def _paged_dummies(self):
@@ -640,34 +680,30 @@ class ContinuousBatchingEngine:
         bt = jnp.zeros((self.slots, self._max_blocks), jnp.int32)
         return kpools, vpools, bt
 
-    def _aot_warmup_paged(self, aot_compile, toks, pos, active):
-        stats = {}
+    def _aot_warmup_paged(self, warm, toks, pos, active):
         kpools, vpools, bt = self._paged_dummies()
-        compiled, info = aot_compile(
-            self._decode_paged, self._keep, self._quant, kpools, vpools,
-            bt, toks, pos, active, self._key, target="serving.decode")
-        self._decode_compiled = compiled
-        stats["serving.decode"] = info.stats
+        c = warm(self._decode_paged, self._keep, self._quant, kpools,
+                 vpools, bt, toks, pos, active, self._key,
+                 target="serving.decode")
+        if c is not None:
+            self._decode_compiled = c
         kpools, vpools, bt = self._paged_dummies()
         ids = jnp.zeros((1, self._chunk), jnp.int32)
         target = f"serving.prefill_chunk[{self._chunk}]"
-        compiled, info = aot_compile(
-            self._prefill_chunk_fn, self._keep, self._quant, ids,
-            kpools, vpools, bt[:1], jnp.zeros((1,), jnp.int32),
-            jnp.asarray(0, jnp.int32), self._key, target=target)
-        self._prefill_chunk_compiled = compiled
-        stats[target] = info.stats
+        c = warm(self._prefill_chunk_fn, self._keep, self._quant, ids,
+                 kpools, vpools, bt[:1], jnp.zeros((1,), jnp.int32),
+                 jnp.asarray(0, jnp.int32), self._key, target=target)
+        if c is not None:
+            self._prefill_chunk_compiled = c
         if self.spec_tokens:
             kpools, vpools, bt = self._paged_dummies()
             toksS = jnp.zeros((self.slots, self.spec_tokens + 1),
                               jnp.int32)
-            compiled, info = aot_compile(
-                self._spec_verify, self._keep, self._quant, kpools,
-                vpools, bt, toksS, pos, active,
-                target="serving.spec_verify")
-            self._spec_verify_compiled = compiled
-            stats["serving.spec_verify"] = info.stats
-        return stats
+            c = warm(self._spec_verify, self._keep, self._quant, kpools,
+                     vpools, bt, toksS, pos, active,
+                     target="serving.spec_verify")
+            if c is not None:
+                self._spec_verify_compiled = c
 
     def analyze(self, strict: bool = False, passes=None, options=None):
         """Lint the compiled decode step (the hot serving path) with the
@@ -823,8 +859,9 @@ class ContinuousBatchingEngine:
                                      jnp.asarray(ids), kv1,
                                      jnp.asarray(Lp, jnp.int32),
                                      sub)
-            self._caches = self._insert(self._caches, caches1,
-                                        jnp.asarray(slot, jnp.int32))
+            insert = self._insert_compiled or self._insert
+            self._caches = insert(self._caches, caches1,
+                                  jnp.asarray(slot, jnp.int32))
             first = int(first)
         req.first_token_at = time.perf_counter()
         req.out.append(first)
@@ -1249,6 +1286,18 @@ class ContinuousBatchingEngine:
         self._pos[:] = 0
         self._budget[:] = 0
         self._last_tok[:] = 0
+        # restart-after-fault cold start: consult the persistent compile
+        # cache so a recovering engine that never warmed (or a future
+        # where recovery rebuilds executables) gets its programs back
+        # without paying a live compile — cache_only means a cold cache
+        # is a no-op and recovery stays cheap.  Never allowed to fail
+        # the recovery itself.
+        try:
+            from paddle_tpu import compile_cache
+            if compile_cache.enabled():
+                self.aot_warmup(cache_only=True)
+        except Exception:
+            pass
         if self._error_streak >= self._max_consecutive_errors:
             raise exc
 

@@ -291,6 +291,7 @@ class TrainStep(CompiledStepBase):
         # named XLA remat policies (SURVEY hard-part: trade FLOPs for HBM);
         # 'dots' saves matmul outputs and recomputes elementwise — near
         # no-remat throughput at a fraction of the activation memory
+        self._remat_policy_name = remat_policy
         if remat_policy is None:
             self._remat_policy = None
         else:
@@ -342,6 +343,7 @@ class TrainStep(CompiledStepBase):
         self._compiled_sig = None
         self._exe_flops = None
         self._peak_flops = None
+        self._cache_probed = False
         # per-step HBM watermark sampling (leak detection rides on it);
         # PADDLE_TPU_DEVICE_WATERMARK=0 disables, _WATERMARK_INTERVAL
         # thins it (the sweep is O(live arrays))
@@ -457,30 +459,77 @@ class TrainStep(CompiledStepBase):
                 batch)
         return jax.tree.map(jnp.asarray, batch)
 
+    def _cache_extra(self) -> str:
+        """Compile-cache key discriminators the call-argument avals
+        can't see: closed-over step config plus the model config that
+        bakes constants (rope tables, eps) into the trace."""
+        from paddle_tpu import compile_cache
+        lf = getattr(self.loss_fn, "__name__", repr(self.loss_fn)) \
+            if self.loss_fn is not None else ""
+        return (f"model={compile_cache.model_config_tag(self.model)}"
+                f"|opt={type(self.optimizer).__name__}"
+                f"|loss={lf}|accum={self._accum_steps}"
+                f"|remat={int(self._remat)}:{self._remat_policy_name}"
+                f"|guard={int(self._guard_nonfinite)}")
+
     def compile(self, batch):
         """AOT-compile the step for this batch signature with full
         compile observability: ``train.compile`` span (with
         ``compile.lower`` / ``compile.xla`` children), the per-target
         compile counter, and the executable's measured FLOPs / HBM
         bytes / peak memory exposed as ``paddle_tpu_xla_*`` gauges.
+        With ``PADDLE_TPU_COMPILE_CACHE=1`` the persistent executable
+        cache is consulted first: a hit deserialize-and-loads under a
+        ``compile.cache_hit`` span instead of lower→compile, and a
+        live compile's executable is stored for the next boot.
         Subsequent calls whose batch matches dispatch through the
         compiled executable (no retrace), and the step starts setting
         the ``paddle_tpu_train_mfu`` gauge.  Returns the
         :class:`~paddle_tpu.observability.device_profiler.CompileInfo`.
         """
-        from paddle_tpu.observability.device_profiler import (
-            aot_compile, signature_of)
+        from paddle_tpu import compile_cache
+        from paddle_tpu.observability.device_profiler import signature_of
         batch = self._place_batch(batch)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         target = f"TrainStep({type(self.model).__name__})"
         with self._tracer.span("train.compile", target=target):
-            compiled, info = aot_compile(
+            compiled, info, _hit = compile_cache.aot_compile_cached(
                 self._jitted, self.params, self.opt_state,
-                self.step_count, batch, self._key, lr, target=target)
+                self.step_count, batch, self._key, lr, target=target,
+                mesh=self.mesh, shardings=self._param_sh,
+                extra=self._cache_extra())
         self._compiled = compiled
         self._compiled_sig = signature_of(batch)
         self._exe_flops = info.stats.flops or None
         return info
+
+    def _probe_compile_cache(self, batch):
+        """Transparent cold-start adoption: the FIRST plain call checks
+        the persistent cache for this exact step signature — a restarted
+        worker that never calls compile() still boots without an XLA
+        compile when the cache is warm.  Misses leave the jit path
+        untouched; failures never escape (a stale cache must not break
+        a boot)."""
+        self._cache_probed = True
+        try:
+            from paddle_tpu import compile_cache
+            if not compile_cache.enabled():
+                return
+            from paddle_tpu.observability.device_profiler import \
+                signature_of
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+            target = f"TrainStep({type(self.model).__name__})"
+            compiled, info, hit = compile_cache.aot_compile_cached(
+                self._jitted, self.params, self.opt_state,
+                self.step_count, batch, self._key, lr, target=target,
+                mesh=self.mesh, shardings=self._param_sh,
+                extra=self._cache_extra(), cache_only=True)
+            if hit:
+                self._compiled = compiled
+                self._compiled_sig = signature_of(batch)
+                self._exe_flops = info.stats.flops or None
+        except Exception:
+            pass
 
     def _dispatch_fn(self, *step_args):
         if self._compiled is not None:
@@ -511,6 +560,8 @@ class TrainStep(CompiledStepBase):
                 else a, batch)
         with self._tracer.span("train.h2d"):
             batch = self._place_batch(batch)
+        if self._compiled is None and not self._cache_probed:
+            self._probe_compile_cache(batch)
         if self._accum_steps > 1:
             for leaf in jax.tree.leaves(batch):
                 if getattr(leaf, "ndim", 0) and \

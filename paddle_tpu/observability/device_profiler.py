@@ -60,7 +60,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["ExecutableStats", "CompileInfo", "aot_compile", "compiled_stats",
-           "compile_records", "signature_of", "detect_roofline",
+           "compile_records", "record_compile_info", "signature_of",
+           "detect_roofline",
            "Segment", "SegmentReport", "AttributionResult", "DeviceProfiler",
            "DeviceMemoryMonitor", "device_memory_monitor",
            "llama_step_segments", "capture_xla_trace"]
@@ -176,13 +177,16 @@ def signature_of(tree) -> str:
 @dataclasses.dataclass
 class CompileInfo:
     """One explicit compile: target name, argument signature, phase wall
-    times, and what XLA measured about the result."""
+    times, and what XLA measured about the result.  ``cached=True``
+    marks a persistent-cache hit (``compile_cache``): no trace or XLA
+    compile happened — ``compile_s`` is the deserialize-and-load time."""
 
     target: str
     signature: str
     lower_s: float
     compile_s: float
     stats: ExecutableStats
+    cached: bool = False
 
     @property
     def total_s(self) -> float:
@@ -191,6 +195,16 @@ class CompileInfo:
 
 _COMPILE_LOG: deque = deque(maxlen=512)
 _COMPILE_LOCK = threading.Lock()
+
+
+def record_compile_info(info: CompileInfo):
+    """Append an externally-produced record to the compile log — the
+    compile-cache hit path uses this so ``compile_records()`` still
+    lists every executable a boot acquired, while
+    ``paddle_tpu_compile_total`` keeps meaning 'explicit XLA
+    compiles'."""
+    with _COMPILE_LOCK:
+        _COMPILE_LOG.append(info)
 
 
 def compile_records(target: Optional[str] = None) -> List[CompileInfo]:

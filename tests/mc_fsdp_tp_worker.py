@@ -66,13 +66,18 @@ rs = np.random.RandomState(0)
 ids = rs.randint(0, cfg.vocab_size, size=(4, 17))
 loss = step({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
 
-# representative updated params, fully gathered for the parity check
+# representative updated params, fully gathered for the parity check.
+# NB: cross-process resharding must go through a compiled program —
+# eager device_put of a non-addressable global array is rejected on
+# jax 0.4.x (multihost assert_equal path); jit + out_shardings is the
+# portable spelling on every version.
 emb_name = next(n for n in step.params if "embed" in n)
 proj_name = next(n for n in step.params if n.endswith("q_proj.weight"))
 repl = NamedSharding(mesh, P())
+gather_fn = jax.jit(lambda a: a, out_shardings=repl)
 gathered = {
-    "emb": np.asarray(jax.device_put(step.params[emb_name], repl)),
-    "proj": np.asarray(jax.device_put(step.params[proj_name], repl)),
+    "emb": np.asarray(gather_fn(step.params[emb_name])),
+    "proj": np.asarray(gather_fn(step.params[proj_name])),
 }
 
 # per-shard save of the fsdp+tp-sharded state (each process writes only

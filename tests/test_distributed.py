@@ -63,7 +63,7 @@ class TestTopology:
 
 class TestCollectives:
     def test_all_reduce_and_gather(self):
-        from jax import shard_map
+        from paddle_tpu.distributed.communication import shard_map
         mesh = mesh1d()
 
         def body(x):
@@ -80,7 +80,7 @@ class TestCollectives:
         assert g.shape == (64, 1)
 
     def test_reduce_scatter_matches_manual(self):
-        from jax import shard_map
+        from paddle_tpu.distributed.communication import shard_map
         mesh = mesh1d()
         x = jnp.arange(64.0).reshape(8, 8)
 
@@ -95,7 +95,7 @@ class TestCollectives:
         np.testing.assert_allclose(np.asarray(out), np.full((8, 8), 8.0))
 
     def test_broadcast_and_shift(self):
-        from jax import shard_map
+        from paddle_tpu.distributed.communication import shard_map
         mesh = mesh1d()
         x = jnp.arange(8.0).reshape(8, 1)
         f = shard_map(lambda v: dist.broadcast(v, src=3, axis_name="x"),
@@ -107,7 +107,7 @@ class TestCollectives:
         np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
 
     def test_all_to_all(self):
-        from jax import shard_map
+        from paddle_tpu.distributed.communication import shard_map
         mesh = mesh1d()
         # rank r holds row r of an 8x8; all_to_all transposes ownership
         x = jnp.arange(64.0).reshape(8, 8)

@@ -7,8 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.communication import shard_map
 
 import paddle_tpu as pp
 import paddle_tpu.distributed as dist
