@@ -117,6 +117,18 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
         regressions.append(
             f"cold_start.warmup_wall_s {float(cw):.4f} > prev "
             f"{float(pw):.4f} x (2 + {tolerance:.0%})")
+    # SLO attainment (better-higher fractions; guarded once both
+    # artifacts carry the section AND judged against the same target)
+    ps, cs = pd.get("slo_attainment") or {}, cd.get("slo_attainment") or {}
+    for kind in ("ttft", "tpot"):
+        pa, ca = ps.get(kind), cs.get(kind)
+        same_target = ps.get(f"{kind}_target_s") == cs.get(
+            f"{kind}_target_s")
+        if pa and ca is not None and same_target and \
+                float(ca) < float(pa) * (1.0 - tolerance):
+            regressions.append(
+                f"slo_attainment.{kind} {float(ca):.3f} < prev "
+                f"{float(pa):.3f} - {tolerance:.0%} tolerance")
     return regressions
 
 
@@ -137,6 +149,13 @@ def compare_records(cur: dict, prev: dict, tolerance: float = 0.05):
     if pt and ct and float(ct) > float(pt) * (1.0 + tolerance):
         regressions.append(
             f"step_time_s {float(ct):.4f} > prev {float(pt):.4f} + "
+            f"{tolerance:.0%} tolerance")
+    # training goodput (better-higher; only once both artifacts carry it)
+    pg = ((prev.get("detail") or {}).get("goodput") or {}).get("value")
+    cg = ((cur.get("detail") or {}).get("goodput") or {}).get("value")
+    if pg and cg is not None and float(cg) < float(pg) * (1.0 - tolerance):
+        regressions.append(
+            f"goodput {float(cg):.4f} < prev {float(pg):.4f} - "
             f"{tolerance:.0%} tolerance")
     # cold-start trajectory (only once both artifacts carry the section;
     # compile wall time on a shared host is noisy, so the bar is a 2x+
@@ -193,6 +212,7 @@ def main(argv=None):
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+    bench_t0 = time.perf_counter()
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
 
@@ -362,6 +382,19 @@ def main(argv=None):
         },
     }
 
+    # goodput ledger (fleet observability): productive step seconds over
+    # the bench's own wall clock, with the lost-time attribution — the
+    # field --compare guards alongside MFU once two artifacts carry it
+    from paddle_tpu.observability import goodput as _goodput
+    ledger = _goodput.compute_goodput(
+        wall_s=time.perf_counter() - bench_t0)
+    goodput_detail = {
+        "value": round(ledger["goodput"], 4),
+        "productive_s": round(ledger["productive_s"], 4),
+        "wall_s": round(ledger["wall_s"], 4),
+        "lost": {k: round(v, 4) for k, v in ledger["lost"].items()},
+    }
+
     prev = _prev_value()
     result = {
         "metric": "llama_pretrain_mfu",
@@ -389,6 +422,7 @@ def main(argv=None):
             "device_live_bytes_watermark": live_watermark,
             "device_profile": device_profile,
             "cold_start": cold_start,
+            "goodput": goodput_detail,
         },
     }
     print(json.dumps(result))

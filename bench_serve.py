@@ -208,6 +208,20 @@ def main(argv=None):
     ttft = _percentiles(ttfts)
     tpot = _percentiles(tpots)
 
+    # SLO attainment from the per-request timings (same targets the
+    # engine's paddle_tpu_serving_slo_total counters judge against) —
+    # the serving twin of training's goodput, guarded by --compare
+    from paddle_tpu.observability.goodput import slo_targets
+    targets = slo_targets()
+    slo = {"ttft_target_s": targets["ttft"],
+           "tpot_target_s": targets["tpot"],
+           "ttft": (sum(1 for v in ttfts if v <= targets["ttft"])
+                    / len(ttfts) if ttfts and targets["ttft"] > 0
+                    else None),
+           "tpot": (sum(1 for v in tpots if v <= targets["tpot"])
+                    / len(tpots) if tpots and targets["tpot"] > 0
+                    else None)}
+
     detail = {
         "requests": args.requests,
         "completed": len(results),
@@ -222,6 +236,7 @@ def main(argv=None):
         "shared_prefix": args.shared_prefix,
         "device": getattr(dev, "device_kind", dev.platform),
         "prefix_hit_tokens": reused_tokens,
+        "slo_attainment": slo,
         "prefix_cache": _series("paddle_tpu_serving_prefix_cache_total"),
         "spec_tokens": _series("paddle_tpu_serving_spec_tokens_total"),
         "spec_accept_rate_mean": (float(np.mean(accept_rates))

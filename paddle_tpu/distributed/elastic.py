@@ -88,6 +88,11 @@ def _elastic_metrics():
             "paddle_tpu_elastic_generation_seconds",
             "lifetime of each finished generation",
             buckets=(1, 5, 15, 60, 300, 900, 3600, 14400, 86400)),
+        "downtime": reg.counter(
+            "paddle_tpu_elastic_downtime_seconds_total",
+            "wall seconds between a generation ending and the next one "
+            "spawning (backoff + teardown) — the elastic restart gap "
+            "observability.goodput debits from training goodput"),
     }
 
 
@@ -345,11 +350,21 @@ class ElasticManager:
         tr = tracer()
         infra_retries = 0
         fast_fail_streak = 0
+        prev_gen_end: Optional[float] = None
         old_handlers = _install_drain_handlers(self._on_drain_signal)
         try:
             while True:
                 self._gen_hb_seen = False
                 started = time.time()
+                if prev_gen_end is not None:
+                    # restart gap: dead time between generations (kill
+                    # sweep + backoff) is the goodput debit the fleet
+                    # plane surfaces
+                    gap = max(0.0, started - prev_gen_end)
+                    metrics["downtime"].inc(gap)
+                    recorder.record("elastic.restart_gap",
+                                    generation=self.generation,
+                                    gap_s=round(gap, 3))
                 metrics["generation"].set(self.generation)
                 recorder.record("elastic.spawn",
                                 generation=self.generation,
@@ -386,6 +401,7 @@ class ElasticManager:
                         "fail" if ok is False else "error")
                     gen_span.end()
                 metrics["gen_seconds"].observe(time.time() - started)
+                prev_gen_end = time.time()
                 if ok == "drain":
                     return drain_rc
                 if ok:

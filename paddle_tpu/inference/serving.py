@@ -101,6 +101,15 @@ def _serving_metrics():
             "paddle_tpu_serving_engine_errors_total",
             "engine-step exceptions recovered by failing the in-flight "
             "batch (the engine itself survives)"),
+        # SLO-attainment feed (fleet observability tentpole): one
+        # hit/miss verdict per retirement against the TTFT/TPOT targets
+        # (PADDLE_TPU_SLO_TTFT_TARGET / _TPOT_TARGET seconds);
+        # observability.goodput folds these into the
+        # paddle_tpu_slo_attainment{kind} gauge
+        "slo": reg.counter(
+            "paddle_tpu_serving_slo_total",
+            "retired requests judged against the serving latency "
+            "targets", labelnames=("kind", "result")),
     }
 
 
@@ -407,6 +416,10 @@ class ContinuousBatchingEngine:
         self._metrics = _serving_metrics()
         if self.paged:
             self._metrics.update(_paged_metrics())
+        # latency targets snapshotted once per engine (env-tunable); a
+        # target <= 0 disables that kind's hit/miss counting
+        from paddle_tpu.observability.goodput import slo_targets
+        self._slo_targets = slo_targets()
         from paddle_tpu.observability import default_registry, \
             flight_recorder
         from paddle_tpu.observability.tracing import tracer
@@ -1197,6 +1210,7 @@ class ContinuousBatchingEngine:
             self._status.pop(next(iter(self._status)))
         self._done.append((req.rid, req.prompt, list(req.out)))
         self._metrics["retirements"].inc()
+        self._count_slo(req)
         ev = dict(rid=req.rid, slot=slot, generated=len(req.out),
                   status=status)
         if trace_id is not None:
@@ -1206,6 +1220,28 @@ class ContinuousBatchingEngine:
             req.span.set_attribute("status", status)
             req.span.set_attribute("generated", len(req.out))
             req.span.end(end_time=req.retired_at)
+
+    def _count_slo(self, req: _Request):
+        """SLO verdicts from the request's own lifecycle stamps: TTFT is
+        judged for every retirement (a request that never produced a
+        first token — queue timeout, engine error — MISSED by
+        definition); TPOT only once there are >= 2 output tokens to
+        average over."""
+        ttft_target = self._slo_targets.get("ttft", 0.0)
+        if ttft_target > 0:
+            ttft = (req.first_token_at - req.enqueued_at
+                    if req.first_token_at and req.enqueued_at else None)
+            hit = ttft is not None and ttft <= ttft_target
+            self._metrics["slo"].labels(
+                kind="ttft", result="hit" if hit else "miss").inc()
+        tpot_target = self._slo_targets.get("tpot", 0.0)
+        if tpot_target > 0 and len(req.out) > 1 and \
+                req.first_token_at and req.retired_at:
+            tpot = (req.retired_at - req.first_token_at) \
+                / (len(req.out) - 1)
+            self._metrics["slo"].labels(
+                kind="tpot",
+                result="hit" if tpot <= tpot_target else "miss").inc()
 
     def request_status(self, rid: int) -> Optional[str]:
         """Terminal status of a finished request: "ok" (eos/budget),

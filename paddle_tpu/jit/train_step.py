@@ -93,6 +93,22 @@ def _train_metrics():
             "measured model-FLOPs utilisation of the most recent step "
             "(XLA executable FLOPs / step time / device peak; set once "
             "TrainStep.compile() has introspected the executable)"),
+        # goodput accounting (fleet observability tentpole): wall time
+        # of APPLIED updates vs. time burned on guard-discarded ones —
+        # observability.goodput turns these into the goodput gauge
+        "productive": reg.counter(
+            "paddle_tpu_train_productive_seconds_total",
+            "step wall seconds whose optimizer update was applied "
+            "(the goodput numerator)"),
+        "skipped_s": reg.counter(
+            "paddle_tpu_train_skipped_seconds_total",
+            "step wall seconds whose update the non-finite step-guard "
+            "discarded (lost time, debited from goodput)"),
+        "ema": reg.gauge(
+            "paddle_tpu_train_step_ema_seconds",
+            "EMA of step wall time — host-labeled after fleet "
+            "federation, the series the straggler SLO rule compares "
+            "against the fleet median"),
     }
 
 
@@ -368,6 +384,7 @@ class TrainStep(CompiledStepBase):
         self._signature_monitor = SignatureMonitor(
             name=f"TrainStep({type(model).__name__})")
         self._host_steps = 0
+        self._step_ema: Optional[float] = None
 
     def _step_impl(self, params, opt_state, step_count, batch, key, lr):
         model, opt = self.model, self.optimizer
@@ -582,6 +599,14 @@ class TrainStep(CompiledStepBase):
                 distinct_signatures=len(self._signature_monitor.records))
         self._key, sub = jax.random.split(self._key)
         t0 = time.perf_counter()
+        # chaos: per-host step delay INSIDE the timed region — the
+        # injectable straggler whose inflated step EMA the fleet
+        # straggler rule must catch (delay via
+        # PADDLE_TPU_STRAGGLER_DELAY_S, default 50ms)
+        if fault_fires("train.straggler_delay", step=self._host_steps):
+            import os as _os
+            time.sleep(float(_os.environ.get(
+                "PADDLE_TPU_STRAGGLER_DELAY_S", "0.05")))
         with self._recorder.instrumented("train.step",
                                          step=self._host_steps):
             with self._tracer.span("train.dispatch",
@@ -604,11 +629,20 @@ class TrainStep(CompiledStepBase):
         m["accum"].observe(self._accum_steps)
         m["loss"].set(loss)     # device scalar, resolved at scrape
         m["gnorm"].set(gnorm)
+        self._step_ema = dt if self._step_ema is None \
+            else 0.8 * self._step_ema + 0.2 * dt
+        m["ema"].set(self._step_ema)
         if self._guard_nonfinite:
             # the int() sync IS the guard's cost; the span makes it
             # visible instead of smearing into "step overhead"
             with self._tracer.span("train.guard"):
-                self._account_skip(int(skip_code))
+                code = int(skip_code)
+                # goodput split BEFORE _account_skip may raise: a
+                # discarded update is lost time, not productive time
+                m["productive" if code == 0 else "skipped_s"].inc(dt)
+                self._account_skip(code)
+        else:
+            m["productive"].inc(dt)
         tokens = self._batch_tokens(batch)
         if tokens:
             m["tokens"].inc(tokens)

@@ -59,11 +59,22 @@ from paddle_tpu.observability.exposition import (JsonlSink, MetricsServer,
                                                  start_metrics_server)
 from paddle_tpu.observability.tracing import (Span, SpanContext, Tracer,
                                               extract_context,
-                                              inject_context, trace_span,
+                                              extract_spans,
+                                              inject_context,
+                                              inject_spans, trace_span,
                                               tracer)
 from paddle_tpu.observability.watchdog import (Alert, Watchdog,
                                                default_rules,
                                                rules_from_spec)
+from paddle_tpu.observability.fleet import (FleetAggregator, LocalStore,
+                                            MetricsPublisher,
+                                            fleet_host_id,
+                                            merge_snapshots)
+from paddle_tpu.observability.goodput import (GoodputMonitor,
+                                              compute_goodput,
+                                              goodput_monitor,
+                                              slo_attainment,
+                                              slo_targets)
 from paddle_tpu.observability.device_profiler import (
     AttributionResult, CompileInfo, DeviceMemoryMonitor, DeviceProfiler,
     ExecutableStats, Segment, aot_compile, compile_records,
@@ -77,8 +88,13 @@ __all__ = [
     "JsonlSink", "MetricsServer", "render_json", "render_prometheus",
     "start_metrics_server",
     "Span", "SpanContext", "Tracer", "tracer", "trace_span",
-    "inject_context", "extract_context",
+    "inject_context", "extract_context", "inject_spans",
+    "extract_spans",
     "Alert", "Watchdog", "default_rules", "rules_from_spec",
+    "FleetAggregator", "LocalStore", "MetricsPublisher",
+    "fleet_host_id", "merge_snapshots",
+    "GoodputMonitor", "compute_goodput", "goodput_monitor",
+    "slo_attainment", "slo_targets",
     "AttributionResult", "CompileInfo", "DeviceMemoryMonitor",
     "DeviceProfiler", "ExecutableStats", "Segment", "aot_compile",
     "compile_records", "compiled_stats", "detect_roofline",

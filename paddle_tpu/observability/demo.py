@@ -48,6 +48,13 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=6)
     ap.add_argument("--trace-out", default="/tmp/paddle_tpu_trace.json",
                     help="Perfetto/chrome-trace export path")
+    ap.add_argument("--fleet", action="store_true",
+                    help="exercise the fleet federation phase "
+                         "(publish -> aggregate -> render, in-process)")
+    ap.add_argument("--fleet-trace-out",
+                    default="/tmp/paddle_tpu_fleet_trace.json",
+                    help="merged multi-host Perfetto export path "
+                         "(--fleet)")
     args = ap.parse_args(argv)
 
     # head-based sampling must be on before the first instrument builds
@@ -238,7 +245,116 @@ def main(argv=None) -> int:
     if not any(e["kind"] == "crash" for e in recorder.snapshot()):
         print("[demo] FAIL: crash event not recorded", file=sys.stderr)
         return 1
+
+    # -- fleet federation: publish -> aggregate -> render, in-process
+    # (ISSUE 11): this process is host demo0; two synthetic hosts (one a
+    # deliberate straggler) join it through a LocalStore, and the
+    # aggregator must serve summed counters, host-labeled gauges, the
+    # fleet table, a straggler breach, and a merged multi-host trace
+    if args.fleet:
+        rc = _fleet_phase(args)
+        if rc:
+            return rc
+
     print("[demo] OK", file=sys.stderr)
+    return 0
+
+
+def _fleet_phase(args) -> int:
+    import numpy as np
+
+    from paddle_tpu.observability import (Watchdog, default_registry,
+                                          goodput_monitor,
+                                          render_prometheus, tracer)
+    from paddle_tpu.observability.fleet import (FleetAggregator,
+                                                LocalStore,
+                                                MetricsPublisher)
+    from paddle_tpu.observability.metrics import MetricsRegistry
+    from paddle_tpu.observability.tracing import Tracer
+    from paddle_tpu.observability.watchdog import StragglerRule
+
+    store = LocalStore()
+    # host demo0: the REAL registry + tracer this demo already filled
+    goodput_monitor().publish()
+    MetricsPublisher(store, host="demo0", interval=999,
+                     publish_goodput=True).publish_once()
+    my_steps = default_registry().get(
+        "paddle_tpu_train_steps_total").value()
+
+    # hosts demo1/demo2: synthetic replicas running the same program —
+    # same series names, their own values, scaled off THIS process's
+    # real step EMA (a few CPU steps carry the compile spike); demo2 is
+    # the deliberate straggler at 3x while demo0/demo1 sit near the
+    # median
+    my_ema = float(default_registry().get(
+        "paddle_tpu_train_step_ema_seconds").value())
+    rng = np.random.default_rng(0)
+    for host, step_ms in (("demo1", my_ema * 1.05e3),
+                          ("demo2", my_ema * 3e3)):
+        reg = MetricsRegistry()
+        reg.counter("paddle_tpu_train_steps_total",
+                    "train steps executed").inc(my_steps)
+        h = reg.histogram("paddle_tpu_train_step_seconds", "")
+        for _ in range(int(my_steps) or 3):
+            h.observe(step_ms / 1e3 * rng.uniform(0.9, 1.1))
+        reg.gauge("paddle_tpu_train_step_ema_seconds",
+                  "").set(step_ms / 1e3)
+        reg.gauge("paddle_tpu_goodput", "").set(0.9)
+        tr = Tracer(capacity=128, sample=1.0)
+        # join the synthetic host's spans to THIS process's trace ids
+        # (the elastic-generation stitching pattern: remote children
+        # parent under a context extracted from the store)
+        from paddle_tpu.observability.tracing import SpanContext
+        last = tracer().finished_spans(name="train.step", last=1)
+        parent = SpanContext(last[0]["trace_id"], last[0]["span_id"],
+                             True) if last else None
+        with tr.span("train.step", parent=parent, replica=host):
+            pass
+        MetricsPublisher(store, registry=reg, tracer_=tr, host=host,
+                         interval=999,
+                         publish_goodput=False).publish_once()
+
+    agg = FleetAggregator(store=store, stale_after=60.0)
+    text = render_prometheus(agg)
+    steps_m = agg.merged_registry(refresh=False).get(
+        "paddle_tpu_train_steps_total")
+    total_steps = sum(c.value() for _, c in steps_m.series())
+    if total_steps != 3 * my_steps:
+        print(f"[demo] FAIL: fleet steps {total_steps} != 3x "
+              f"{my_steps}", file=sys.stderr)
+        return 1
+    if 'paddle_tpu_train_step_ema_seconds{host="demo2"}' not in text \
+            or 'paddle_tpu_goodput' not in text:
+        print("[demo] FAIL: host-labeled gauges missing from fleet "
+              "exposition", file=sys.stderr)
+        return 1
+    print(f"[demo] fleet /metrics: counters summed across 3 hosts "
+          f"({int(total_steps)} steps), gauges host-labeled",
+          file=sys.stderr)
+    print("[demo] fleet table:\n" + agg.table(), file=sys.stderr)
+
+    # straggler rule against the merged registry: demo2 must breach
+    wd = Watchdog(rules=[StragglerRule(factor=1.75)],
+                  registry=agg.merged_registry(refresh=False),
+                  cooldown=0.0)
+    alerts = wd.evaluate_once()
+    if len(alerts) != 1 or "demo2" not in alerts[0].detail:
+        print(f"[demo] FAIL: straggler rule did not single out demo2: "
+              f"{[a.detail for a in alerts]}", file=sys.stderr)
+        return 1
+    print(f"[demo] straggler breach: {alerts[0].detail}",
+          file=sys.stderr)
+
+    trace = agg.export_chrome(args.fleet_trace_out)
+    tracks = [e for e in trace["traceEvents"]
+              if e.get("name") == "process_name"]
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    if len(tracks) < 3 or not xs:
+        print(f"[demo] FAIL: merged trace has {len(tracks)} host "
+              f"tracks / {len(xs)} spans", file=sys.stderr)
+        return 1
+    print(f"[demo] fleet trace: {len(xs)} spans across {len(tracks)} "
+          f"host tracks -> {args.fleet_trace_out}", file=sys.stderr)
     return 0
 
 

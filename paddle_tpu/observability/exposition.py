@@ -223,9 +223,12 @@ def maybe_start_from_env(registry) -> None:
     """Attach exporters requested by env (called once from
     ``default_registry()``): PADDLE_TPU_METRICS_PORT starts the HTTP
     endpoint, PADDLE_TPU_METRICS_JSONL starts a periodic file sink
-    (interval via PADDLE_TPU_METRICS_JSONL_INTERVAL, default 10s), and
+    (interval via PADDLE_TPU_METRICS_JSONL_INTERVAL, default 10s),
     PADDLE_TPU_SLO_RULES starts the SLO watchdog with the declarative
-    rule spec (interval via PADDLE_TPU_SLO_INTERVAL, default 15s)."""
+    rule spec (interval via PADDLE_TPU_SLO_INTERVAL, default 15s), and
+    PADDLE_TPU_FLEET_METRICS=<host:port> starts the fleet snapshot
+    publisher against that TCPStore (interval via
+    PADDLE_TPU_FLEET_INTERVAL, default 5s)."""
     global _ENV_SERVER, _ENV_SINK, _ENV_WATCHDOG
     port = os.environ.get("PADDLE_TPU_METRICS_PORT")
     if port is not None and _ENV_SERVER is None:
@@ -250,4 +253,12 @@ def maybe_start_from_env(registry) -> None:
         except Exception as e:  # a typo'd rule must not crash the job
             import sys
             print(f"paddle_tpu.observability: SLO watchdog from env "
+                  f"failed: {e}", file=sys.stderr)
+    if os.environ.get("PADDLE_TPU_FLEET_METRICS"):
+        try:
+            from paddle_tpu.observability import fleet
+            fleet.start_publisher_from_env(registry)
+        except Exception as e:  # a down store must not crash the job
+            import sys
+            print(f"paddle_tpu.observability: fleet publisher from env "
                   f"failed: {e}", file=sys.stderr)

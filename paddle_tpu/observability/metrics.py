@@ -326,11 +326,15 @@ class MetricsRegistry:
             for values, child in m.series():
                 labels = dict(zip(m.labelnames, values))
                 if isinstance(child, Histogram):
+                    # min/max ride along so a cross-process merge
+                    # (observability.fleet) can reconstruct a histogram
+                    # whose quantile clamps stay data-bounded
                     series.append({
                         "labels": labels,
                         "buckets": list(zip(child.bounds,
                                             child.cumulative_counts())),
                         "count": child.count(), "sum": child.sum(),
+                        "min": child._min, "max": child._max,
                         "summary": child.summary()})
                 else:
                     series.append({"labels": labels,

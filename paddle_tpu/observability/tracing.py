@@ -46,7 +46,8 @@ from contextlib import contextmanager
 from typing import Any, Dict, List, NamedTuple, Optional
 
 __all__ = ["Span", "SpanContext", "Tracer", "tracer", "trace_span",
-           "inject_context", "extract_context", "on_host_event"]
+           "inject_context", "extract_context", "inject_spans",
+           "extract_spans", "on_host_event"]
 
 # perf_counter → wall-clock offset, fixed once per process: span
 # timestamps are taken with the cheap monotonic clock but exported as
@@ -318,6 +319,20 @@ class Tracer:
                         "spans": members[:max_spans]})
         return out
 
+    def spans_payload(self, last: Optional[int] = None) -> List[dict]:
+        """Finished spans with WALL-CLOCK endpoints (``t0``/``t1`` in
+        epoch seconds) — the shippable form of the ring: another host's
+        aggregator can merge payloads from many processes onto one
+        timeline without knowing each sender's ``perf_counter`` origin
+        (see :func:`inject_spans` / ``observability.fleet``)."""
+        out = []
+        for s in self.finished_spans(last=last):
+            e = dict(s)
+            e["t0"] = s["t0"] + _EPOCH
+            e["t1"] = s["t1"] + _EPOCH
+            out.append(e)
+        return out
+
     def export_chrome(self, path: Optional[str] = None) -> dict:
         """Perfetto/chrome-trace JSON of every retained span.  ``ts`` is
         wall time (see ``_EPOCH``) so per-host exports from one job can
@@ -432,5 +447,45 @@ def extract_context(store, key: str = "trace/ctx"
         if isinstance(raw, bytes):
             raw = raw.decode()
         return SpanContext.from_header(raw)
+    except Exception:
+        return None
+
+
+# -- span-ring shipping (fleet trace stitching) ------------------------------
+def inject_spans(store, key: str, host: Optional[str] = None,
+                 tracer_: Optional[Tracer] = None,
+                 last: Optional[int] = None) -> int:
+    """Publish this process's bounded span ring under ``key`` on a
+    store-like carrier — the sibling of :func:`inject_context` for whole
+    rings instead of one context.  The payload is a versioned JSON blob
+    of wall-clock spans (``spans_payload``), bounded to ``last`` spans
+    (``PADDLE_TPU_FLEET_TRACE_SPANS``, default 1024 — the TCPStore value
+    buffer is 1 MiB).  Returns the number of spans shipped."""
+    t = tracer_ if tracer_ is not None else tracer()
+    if last is None:
+        last = int(os.environ.get("PADDLE_TPU_FLEET_TRACE_SPANS", "1024"))
+    spans = t.spans_payload(last=last)
+    payload = {"schema": 1, "host": host, "pid": os.getpid(),
+               "spans": spans}
+    store.set(key, json.dumps(payload, default=str).encode())
+    return len(spans)
+
+
+def extract_spans(store, key: str) -> Optional[dict]:
+    """Read a span-ring payload published by :func:`inject_spans`; None
+    when the key is absent or unparseable (a partially-written or
+    old-schema blob must degrade to 'no trace from that host', never
+    crash the aggregator)."""
+    try:
+        if hasattr(store, "check") and not store.check(key):
+            return None
+        raw = store.get(key, wait=False)
+        if isinstance(raw, bytes):
+            raw = raw.decode()
+        payload = json.loads(raw)
+        if payload.get("schema") != 1 or \
+                not isinstance(payload.get("spans"), list):
+            return None
+        return payload
     except Exception:
         return None

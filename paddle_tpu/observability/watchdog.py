@@ -33,7 +33,18 @@ Built-in rule types (see ``default_rules()``):
                       dropping below ``factor``× its EMA baseline
 ``compile_storm``     fresh XLA compiles (``paddle_tpu_compile_total``)
                       rising faster than ``max_delta`` per interval
+``straggler``         one host's step-time EMA gauge drifting above
+                      ``factor``× the fleet median (needs the
+                      host-labeled series a fleet aggregator's merged
+                      registry carries; silent under ``min_hosts``)
+``goodput_floor``     ``paddle_tpu_goodput`` below ``floor`` on any
+                      host whose wall clock has run ``min_wall_s``
 =================  =======================================================
+
+The two fleet rules are registered in ``RULE_TYPES`` (spec-string /
+env constructible) but NOT in ``default_rules()`` — they only make
+sense against a registry carrying fleet gauges (a single process, or
+an aggregator's ``merged_registry()`` where gauges are host-labeled).
 
 Rules are also constructible from a spec string (the env-var syntax,
 ``PADDLE_TPU_SLO_RULES``)::
@@ -58,7 +69,8 @@ from typing import Dict, List, Optional
 
 __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
-           "MfuDriftRule", "CompileStormRule",
+           "MfuDriftRule", "CompileStormRule", "StragglerRule",
+           "GoodputFloorRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -316,6 +328,99 @@ class CompileStormRule(Rule):
         return None
 
 
+def _values_by_host(metric) -> Dict[str, float]:
+    """Finite series values keyed by their ``host`` label — the shape a
+    fleet aggregator's merged gauges have.  A metric without a ``host``
+    label yields one entry keyed ``""`` (single-process)."""
+    out: Dict[str, float] = {}
+    names = metric.labelnames
+    for values, child in metric.series():
+        labels = dict(zip(names, values))
+        v = child.value()
+        if v != v:
+            continue
+        out[labels.get("host", "")] = v
+    return out
+
+
+class StragglerRule(Rule):
+    """One host's step-time EMA
+    (``paddle_tpu_train_step_ema_seconds``, host-labeled on a fleet
+    aggregator's merged registry) sitting above ``factor``× the fleet
+    median — the multi-controller SPMD failure mode a per-process view
+    cannot see: every host runs the same program, so one slow host
+    drags every collective.  Needs ``min_hosts`` live hosts to judge;
+    a single process never breaches."""
+
+    def __init__(self, metric: str = "paddle_tpu_train_step_ema_seconds",
+                 factor: float = 1.75, min_hosts: int = 2,
+                 name: str = "straggler"):
+        self.name = name
+        self.metric = metric
+        self.factor = float(factor)
+        self.min_hosts = int(min_hosts)
+
+    def evaluate(self, registry, now):
+        import statistics
+        m = registry.get(self.metric)
+        if m is None or "host" not in m.labelnames:
+            return None
+        per_host = {h: v for h, v in _values_by_host(m).items()
+                    if h and v > 0}
+        if len(per_host) < self.min_hosts:
+            return None
+        med = statistics.median(per_host.values())
+        if med <= 0:
+            return None
+        worst_host, worst = max(per_host.items(), key=lambda kv: kv[1])
+        if worst > self.factor * med:
+            return (f"host {worst_host} step-time EMA "
+                    f"{worst * 1e3:.2f}ms > {self.factor:g}x fleet "
+                    f"median {med * 1e3:.2f}ms "
+                    f"({len(per_host)} hosts)")
+        return None
+
+
+class GoodputFloorRule(Rule):
+    """``paddle_tpu_goodput`` below ``floor`` on any host whose
+    denominator (``paddle_tpu_goodput_wall_seconds``) has accumulated
+    at least ``min_wall_s`` — young processes are still paying their
+    compile tax and get grace; a mature host spending most of its wall
+    clock unproductively is the page."""
+
+    def __init__(self, metric: str = "paddle_tpu_goodput",
+                 wall_metric: str = "paddle_tpu_goodput_wall_seconds",
+                 floor: float = 0.5, min_wall_s: float = 60.0,
+                 name: str = "goodput_floor"):
+        self.name = name
+        self.metric = metric
+        self.wall_metric = wall_metric
+        self.floor = float(floor)
+        self.min_wall_s = float(min_wall_s)
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        goodput = _values_by_host(m)
+        wall_m = registry.get(self.wall_metric)
+        walls = _values_by_host(wall_m) if wall_m is not None else {}
+        breaching = []
+        for host, g in goodput.items():
+            if walls.get(host, 0.0) < self.min_wall_s:
+                continue
+            if g < self.floor:
+                breaching.append((host, g))
+        if not breaching:
+            return None
+        host, g = min(breaching, key=lambda kv: kv[1])
+        who = f"host {host}" if host else "this process"
+        return (f"goodput {g:.3f} on {who} < floor {self.floor:g} "
+                f"after {walls.get(host, 0.0):.0f}s of wall clock"
+                + (f" ({len(breaching)} hosts below floor)"
+                   if len(breaching) > 1 else ""))
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
@@ -324,6 +429,8 @@ RULE_TYPES = {
     "heartbeat_gap": HeartbeatGapRule,
     "mfu_drift": MfuDriftRule,
     "compile_storm": CompileStormRule,
+    "straggler": StragglerRule,
+    "goodput_floor": GoodputFloorRule,
 }
 
 

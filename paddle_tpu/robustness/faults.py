@@ -37,6 +37,18 @@ point                                 site
                                       the engine must shed load through
                                       the bounded-admission path — defer,
                                       never crash)
+``train.straggler_delay``             sleeps inside the timed train-step
+                                      region (bool-style;
+                                      ``PADDLE_TPU_STRAGGLER_DELAY_S``,
+                                      default 50ms) — the injected
+                                      per-host straggler the fleet
+                                      ``straggler`` SLO rule must catch
+``obs.fleet.publish``                 fails a fleet metrics-snapshot
+                                      publish; consecutive failures kill
+                                      the publisher thread and the
+                                      aggregator must degrade to marking
+                                      the host stale while still serving
+                                      fleet metrics
 ====================================  =====================================
 
 Env syntax (comma-separated specs, colon-separated options)::

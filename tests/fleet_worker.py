@@ -1,0 +1,63 @@
+"""Elastic fleet-observability worker (slow 2-process smoke + CI gate).
+
+Launched by ElasticManager with nproc=2.  Every rank heartbeats through
+an ElasticAgent (adopting the manager's generation trace context),
+trains a few tiny steps, and publishes metric snapshots + its span ring
+to the manager's TCPStore through the fleet publisher.  In generation 0
+rank 0 hard-crashes mid-training AFTER publishing — the driver then
+asserts the federated view contains both generations' hosts, the merged
+Perfetto export has per-host tracks joined by the generation trace id,
+and goodput reflects the restart debit.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    import numpy as np
+
+    import paddle_tpu as pp
+    from paddle_tpu.distributed.elastic import ElasticAgent
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability.fleet import MetricsPublisher
+
+    agent = ElasticAgent(interval=0.2)
+    gen, rank = agent.generation, agent.rank
+    host, port = os.environ["PADDLE_ELASTIC_STORE"].rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=False)
+    pub = MetricsPublisher(store, interval=0.2)
+
+    pp.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2,
+        num_key_value_heads=1, max_position_embeddings=32))
+    opt = pp.optimizer.SGD(learning_rate=1e-2,
+                           parameters=model.parameters())
+    step = TrainStep(model, opt)
+    rng = np.random.default_rng(rank)
+    ids = rng.integers(0, 64, (2, 9)).astype(np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    for i in range(3):
+        step(batch)
+        pub.publish_once()
+        if gen == 0 and rank == 0 and i == 1:
+            # crash the generation: snapshot already on the store, so
+            # the aggregator must keep this host's counters (marked
+            # stale) while the relaunched generation publishes fresh
+            os._exit(1)
+    pub.publish_once()
+    agent.stop()
+    store.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,654 @@
+"""Fleet observability plane (ISSUE 11): cross-process metric
+federation (type-correct merges under the cardinality cap), stitched
+multi-host traces, goodput/straggler accounting, the fleet SLO rules,
+and graceful degradation under publisher death — plus the slow
+2-process elastic drill the CI gate runs unfiltered."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pp
+from paddle_tpu.observability import default_registry
+from paddle_tpu.observability.exposition import render_prometheus
+from paddle_tpu.observability.fleet import (FleetAggregator, LocalStore,
+                                            MetricsPublisher,
+                                            fleet_host_id,
+                                            merge_snapshots)
+from paddle_tpu.observability.goodput import (GoodputMonitor,
+                                              compute_goodput,
+                                              slo_attainment)
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.tracing import (SpanContext, Tracer,
+                                              extract_spans,
+                                              inject_spans)
+from paddle_tpu.observability.watchdog import (GoodputFloorRule,
+                                               StragglerRule, Watchdog,
+                                               rules_from_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _publish(store, reg, host, tracer_=None, **kw):
+    pub = MetricsPublisher(store, registry=reg, tracer_=tracer_,
+                           host=host, interval=999,
+                           publish_goodput=False,
+                           publish_traces=tracer_ is not None, **kw)
+    pub.publish_once()
+    return pub
+
+
+# ------------------------------------------------------------- merge laws
+class TestMergeSemantics:
+    def test_counters_sum_exactly_per_label_set(self):
+        store = LocalStore()
+        for i in range(3):
+            reg = MetricsRegistry()
+            reg.counter("paddle_tpu_t_total").inc(10 + i)
+            lab = reg.counter("paddle_tpu_l_total", labelnames=("k",))
+            lab.labels(k="a").inc(i + 1)
+            if i == 2:              # label-set present on ONE host only
+                lab.labels(k="b").inc(7)
+            _publish(store, reg, f"h{i}")
+        agg = FleetAggregator(store=store)
+        merged = agg.merged_registry()
+        assert merged.get("paddle_tpu_t_total").value() == 33
+        lab = merged.get("paddle_tpu_l_total")
+        vals = {k: c.value() for k, c in lab.series()}
+        assert vals[("a",)] == 6 and vals[("b",)] == 7
+
+    def test_histogram_merge_matches_pooled_observations(self):
+        """Satellite: histogram_quantile over the federated exposition
+        must equal the same estimator over the POOLED raw observations
+        across 3 simulated hosts (and land near numpy's percentile)."""
+        bounds = (0.01, 0.05, 0.1, 0.5, 1.0)
+        rng = np.random.default_rng(7)
+        store = LocalStore()
+        pooled = []
+        for i in range(3):
+            reg = MetricsRegistry()
+            h = reg.histogram("paddle_tpu_lat_seconds", buckets=bounds)
+            obs = rng.gamma(2.0, 0.05, size=40 + 10 * i)
+            for v in obs:
+                h.observe(float(v))
+            pooled.extend(float(v) for v in obs)
+            _publish(store, reg, f"h{i}")
+        agg = FleetAggregator(store=store)
+        merged = agg.merged_registry()
+        mh = merged.get("paddle_tpu_lat_seconds")
+        assert mh.count() == len(pooled)
+        assert abs(mh.sum() - sum(pooled)) < 1e-9
+        # ground truth: one histogram that observed the pooled stream
+        ref = MetricsRegistry().histogram("ref", buckets=bounds)
+        for v in pooled:
+            ref.observe(v)
+        for q in (0.5, 0.9, 0.99):
+            assert abs(mh.quantile(q) - ref.quantile(q)) < 1e-12, q
+        # and the PromQL path: cumulative le-buckets from the rendered
+        # federated text bracket numpy's percentile of the raw pool
+        text = render_prometheus(agg)
+        buckets = {}
+        for line in text.splitlines():
+            if line.startswith("paddle_tpu_lat_seconds_bucket"):
+                le = line.split('le="')[1].split('"')[0]
+                buckets[le] = float(line.rsplit(" ", 1)[1])
+        assert buckets["+Inf"] == len(pooled)
+        target = 0.9 * buckets["+Inf"]
+        prev_b, prev_c = 0.0, 0.0
+        for b in [k for k in buckets if k != "+Inf"]:
+            if buckets[b] >= target:
+                est = prev_b + (float(b) - prev_b) * \
+                    (target - prev_c) / (buckets[b] - prev_c)
+                break
+            prev_b, prev_c = float(b), buckets[b]
+        true_p90 = float(np.percentile(pooled, 90))
+        lo = max(pb for pb in [0.0] + [float(k) for k in buckets
+                                       if k != "+Inf"]
+                 if pb < est)
+        assert lo <= true_p90 <= float(b), (est, true_p90)
+
+    def test_gauges_host_labeled_with_min_mean_max_rollups(self):
+        store = LocalStore()
+        for i, v in enumerate((1.0, 3.0, 8.0)):
+            reg = MetricsRegistry()
+            reg.gauge("paddle_tpu_g").set(v)
+            _publish(store, reg, f"h{i}")
+        agg = FleetAggregator(store=store)
+        text = render_prometheus(agg)
+        assert 'paddle_tpu_g{host="h1"} 3' in text
+        assert 'paddle_tpu_g_fleet{stat="min"} 1' in text
+        assert 'paddle_tpu_g_fleet{stat="mean"} 4' in text
+        assert 'paddle_tpu_g_fleet{stat="max"} 8' in text
+
+    def test_cardinality_cap_collapses_into_overflow(self):
+        snaps = {}
+        for i in range(70):         # 70 hosts > the 64-series cap
+            reg = MetricsRegistry()
+            reg.gauge("paddle_tpu_wide").set(float(i))
+            snaps[f"h{i:03d}"] = {
+                "schema": 1, "host": f"h{i:03d}", "time": time.time(),
+                "seq": 1, "metrics": reg.collect()}
+        merged, _owned, conflicts = merge_snapshots(snaps)
+        g = merged.get("paddle_tpu_wide")
+        # 64 distinct hosts + the single overflow series the tail
+        # collapsed into — never 70
+        assert len(g.series()) <= 65
+        assert ("__overflow__",) in dict(g.series())
+        assert conflicts == 0
+
+    def test_kind_and_bound_conflicts_are_skipped_not_fatal(self):
+        ra = MetricsRegistry()
+        ra.counter("paddle_tpu_c_total").inc(2)
+        ra.histogram("paddle_tpu_h_seconds", buckets=(0.1, 1.0)) \
+            .observe(0.05)
+        rb = MetricsRegistry()
+        rb.gauge("paddle_tpu_c_total").set(9)      # kind conflict
+        rb.histogram("paddle_tpu_h_seconds", buckets=(0.2, 2.0)) \
+            .observe(0.05)                         # bound conflict
+        snaps = {
+            h: {"schema": 1, "host": h, "time": time.time(), "seq": 1,
+                "metrics": r.collect()}
+            for h, r in (("a", ra), ("b", rb))}
+        merged, _o, conflicts = merge_snapshots(snaps)
+        assert conflicts >= 2
+        assert merged.get("paddle_tpu_c_total").value() == 2
+        assert merged.get("paddle_tpu_h_seconds").count() == 1
+
+    def test_bad_schema_snapshot_is_a_conflict(self):
+        merged, _o, conflicts = merge_snapshots(
+            {"x": {"schema": 99, "metrics": []}})
+        assert conflicts == 1
+
+
+# -------------------------------------------------------- aggregator plane
+class TestAggregator:
+    def test_publish_poll_serve_over_local_store(self):
+        store = LocalStore()
+        reg = MetricsRegistry()
+        reg.counter("paddle_tpu_t_total").inc(5)
+        pub = _publish(store, reg, "solo")
+        agg = FleetAggregator(store=store)
+        assert agg.poll() == ["solo"]
+        fams = {f["name"] for f in agg.collect()}
+        assert {"paddle_tpu_t_total", "paddle_tpu_fleet_hosts",
+                "paddle_tpu_fleet_host_up"} <= fams
+        # snapshots re-publish with advancing seq keep the host fresh
+        pub.publish_once()
+        agg.refresh()
+        assert not agg.hosts()["solo"]["stale"]
+
+    def test_stale_host_marked_but_counters_still_served(self):
+        store = LocalStore()
+        reg = MetricsRegistry()
+        reg.counter("paddle_tpu_t_total").inc(4)
+        _publish(store, reg, "dying")
+        agg = FleetAggregator(store=store, stale_after=0.05)
+        agg.refresh()
+        assert not agg.hosts()["dying"]["stale"]
+        time.sleep(0.12)            # no new snapshot: seq stops moving
+        merged = agg.merged_registry()
+        assert agg.hosts()["dying"]["stale"]
+        up = dict(merged.get("paddle_tpu_fleet_host_up").series())
+        assert up[("dying",)].value() == 0.0
+        # degraded, not gone: the last-known counter still federates
+        assert merged.get("paddle_tpu_t_total").value() == 4
+
+    def test_publisher_death_fault_degrades_gracefully(self):
+        """Chaos satellite: arm obs.fleet.publish — the publisher dies
+        after max_failures consecutive fires, errors are counted, and
+        the aggregator keeps serving the pre-fault snapshot with the
+        host marked stale."""
+        from paddle_tpu import robustness
+        store = LocalStore()
+        reg = MetricsRegistry()
+        reg.counter("paddle_tpu_t_total").inc(11)
+        pub = MetricsPublisher(store, registry=reg, host="chaos",
+                               interval=0.01, publish_goodput=False,
+                               publish_traces=False, max_failures=3)
+        pub.publish_once()          # healthy snapshot reaches the store
+        robustness.inject("obs.fleet.publish")
+        try:
+            pub.start()
+            deadline = time.time() + 5.0
+            while pub.alive and time.time() < deadline:
+                time.sleep(0.02)
+            assert not pub.alive, "publisher must die after 3 failures"
+            assert reg.get(
+                "paddle_tpu_fleet_publish_errors_total").value() >= 3
+            assert robustness.fault_stats(
+                "obs.fleet.publish")["fires"] >= 3
+        finally:
+            robustness.clear_faults()
+            pub.stop()
+        agg = FleetAggregator(store=store, stale_after=0.01)
+        agg.poll()                  # staleness clock starts here
+        time.sleep(0.05)            # publisher is dead: seq frozen
+        merged = agg.merged_registry()
+        assert merged.get("paddle_tpu_t_total").value() == 11
+        assert agg.hosts()["chaos"]["stale"]
+
+    def test_merged_registry_preserves_foreign_metrics(self):
+        """A watchdog's breach counter registered ON the merged
+        registry must survive refresh() — only merge-owned families are
+        replaced."""
+        store = LocalStore()
+        reg = MetricsRegistry()
+        reg.gauge("paddle_tpu_g").set(1.0)
+        _publish(store, reg, "h0")
+        agg = FleetAggregator(store=store)
+        merged = agg.merged_registry()
+        wd = Watchdog(rules=[], registry=merged)
+        wd._breaches.labels(rule="synthetic").inc()
+        merged2 = agg.merged_registry()
+        assert merged2 is merged
+        b = merged2.get("paddle_tpu_slo_breaches_total")
+        assert b is not None and dict(b.series())[
+            ("synthetic",)].value() == 1
+
+    def test_http_exposition_over_aggregator(self):
+        store = LocalStore()
+        reg = MetricsRegistry()
+        reg.counter("paddle_tpu_t_total").inc(3)
+        _publish(store, reg, "h0")
+        agg = FleetAggregator(store=store)
+        server = agg.serve(port=0)
+        try:
+            with urllib.request.urlopen(server.url, timeout=10) as r:
+                text = r.read().decode()
+        finally:
+            server.close()
+        assert "paddle_tpu_t_total 3" in text
+        assert "paddle_tpu_fleet_hosts 1" in text
+
+
+# ------------------------------------------------------- stitched traces
+class TestStitchedTraces:
+    def test_span_payload_roundtrip_and_garbage_tolerance(self):
+        store = LocalStore()
+        tr = Tracer(capacity=16, sample=1.0)
+        with tr.span("a"):
+            pass
+        n = inject_spans(store, "obs/trace/h0", host="h0", tracer_=tr)
+        assert n == 1
+        payload = extract_spans(store, "obs/trace/h0")
+        assert payload["host"] == "h0"
+        (span,) = payload["spans"]
+        assert abs(span["t0"] - time.time()) < 60  # wall-clock epochs
+        store.set("obs/trace/bad", b"{not json")
+        assert extract_spans(store, "obs/trace/bad") is None
+        store.set("obs/trace/old", json.dumps({"schema": 0}).encode())
+        assert extract_spans(store, "obs/trace/old") is None
+
+    def test_merged_chrome_has_host_tracks_joined_by_trace_id(self):
+        store = LocalStore()
+        t0 = Tracer(capacity=16, sample=1.0)
+        with t0.span("elastic.generation") as root:
+            ctx = root.context
+        t1 = Tracer(capacity=16, sample=1.0)
+        with t1.span("train.step",
+                     parent=SpanContext(ctx.trace_id, ctx.span_id,
+                                        True)):
+            pass
+        inject_spans(store, "obs/trace/h0", host="h0", tracer_=t0)
+        inject_spans(store, "obs/trace/h1", host="h1", tracer_=t1)
+        agg = FleetAggregator(store=store)
+        store.set("obs/hosts", b"h0,h1")
+        # traces ride poll() once the hosts are registered
+        for h in ("h0", "h1"):
+            store.set(f"obs/metrics/{h}", json.dumps(
+                {"schema": 1, "host": h, "time": time.time(), "seq": 1,
+                 "metrics": []}).encode())
+        agg.poll()
+        trace = agg.export_chrome()
+        tracks = {e["args"]["name"] for e in trace["traceEvents"]
+                  if e.get("name") == "process_name"}
+        assert tracks == {"paddle_tpu host h0", "paddle_tpu host h1"}
+        xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        by_pid = {}
+        for e in xs:
+            by_pid.setdefault(e["pid"], set()).add(
+                e["args"]["trace_id"])
+        assert len(by_pid) == 2
+        # cross-host join: both tracks share the generation trace id
+        (a, b) = by_pid.values()
+        assert a & b
+
+
+# ------------------------------------------------------------- goodput
+class TestGoodput:
+    def test_ledger_math_and_lost_attribution(self):
+        reg = MetricsRegistry()
+        reg.counter(
+            "paddle_tpu_train_productive_seconds_total").inc(6.0)
+        reg.histogram("paddle_tpu_compile_seconds").observe(2.0)
+        reg.histogram(
+            "paddle_tpu_checkpoint_save_seconds").observe(0.5)
+        reg.counter(
+            "paddle_tpu_elastic_downtime_seconds_total").inc(0.5)
+        reg.counter(
+            "paddle_tpu_train_skipped_seconds_total").inc(0.5)
+        led = compute_goodput(reg, wall_s=10.0)
+        assert abs(led["goodput"] - 0.6) < 1e-9
+        assert abs(led["lost"]["compile"] - 2.0) < 1e-9
+        assert abs(led["lost"]["other"] - 0.5) < 1e-9
+
+    def test_fallback_to_step_histogram_without_counter(self):
+        reg = MetricsRegistry()
+        reg.histogram("paddle_tpu_train_step_seconds").observe(3.0)
+        led = compute_goodput(reg, wall_s=10.0)
+        assert abs(led["goodput"] - 0.3) < 1e-9
+
+    def test_slo_attainment_from_counters(self):
+        reg = MetricsRegistry()
+        slo = reg.counter("paddle_tpu_serving_slo_total",
+                          labelnames=("kind", "result"))
+        slo.labels(kind="ttft", result="hit").inc(3)
+        slo.labels(kind="ttft", result="miss").inc(1)
+        att = slo_attainment(reg)
+        assert att["ttft"] == 0.75 and att["tpot"] is None
+
+    def test_monitor_publishes_first_class_gauges(self):
+        reg = MetricsRegistry()
+        reg.counter(
+            "paddle_tpu_train_productive_seconds_total").inc(1.0)
+        slo = reg.counter("paddle_tpu_serving_slo_total",
+                          labelnames=("kind", "result"))
+        slo.labels(kind="tpot", result="hit").inc(4)
+        mon = GoodputMonitor(reg, t0=time.monotonic() - 10.0)
+        led = mon.publish()
+        g = reg.get("paddle_tpu_goodput").value()
+        assert abs(g - led["goodput"]) < 1e-6 and 0 < g < 1
+        assert reg.get("paddle_tpu_goodput_wall_seconds").value() >= 10
+        lost = dict(reg.get(
+            "paddle_tpu_goodput_lost_seconds").series())
+        assert ("other",) in lost
+        att = dict(reg.get("paddle_tpu_slo_attainment").series())
+        assert att[("tpot",)].value() == 1.0
+
+    def test_train_step_splits_productive_vs_skipped(self):
+        """TrainStep accounting: applied updates feed the productive
+        counter; a guard-skipped (NaN) step feeds the skipped-seconds
+        counter instead."""
+        import paddle_tpu.nn as nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        pp.seed(0)
+        model = M()
+        opt = pp.optimizer.SGD(learning_rate=1e-2,
+                               parameters=model.parameters())
+        from paddle_tpu.jit import TrainStep
+        step = TrainStep(model, opt,
+                         loss_fn=lambda out, y: ((out - y) ** 2).mean())
+        reg = default_registry()
+        prod0 = reg.counter(
+            "paddle_tpu_train_productive_seconds_total").value()
+        skip0 = reg.counter(
+            "paddle_tpu_train_skipped_seconds_total").value()
+        x = np.ones((2, 4), np.float32)
+        step((x, x))
+        assert reg.counter(
+            "paddle_tpu_train_productive_seconds_total").value() > prod0
+        bad = np.full((2, 4), np.nan, np.float32)
+        step((bad, x))              # guard skips -> lost time
+        assert reg.counter(
+            "paddle_tpu_train_skipped_seconds_total").value() > skip0
+        assert reg.get(
+            "paddle_tpu_train_step_ema_seconds").value() > 0
+
+
+# ------------------------------------------------------ fleet SLO rules
+class TestFleetRules:
+    def _fleet_reg(self, emas):
+        reg = MetricsRegistry()
+        g = reg.gauge("paddle_tpu_train_step_ema_seconds",
+                      labelnames=("host",))
+        for h, v in emas.items():
+            g.labels(host=h).set(v)
+        return reg
+
+    def test_straggler_fires_exactly_once_per_cooldown(self):
+        reg = self._fleet_reg({"h0": 0.01, "h1": 0.012, "h2": 0.05})
+        wd = Watchdog(rules=[StragglerRule(factor=1.75)], registry=reg,
+                      cooldown=60.0)
+        assert len(wd.evaluate_once(now=1.0)) == 1
+        assert len(wd.evaluate_once(now=30.0)) == 0   # inside cooldown
+        alerts = wd.evaluate_once(now=120.0)          # past cooldown
+        assert len(alerts) == 1 and "h2" in alerts[0].detail
+
+    def test_straggler_needs_host_label_and_min_hosts(self):
+        reg = MetricsRegistry()
+        reg.gauge("paddle_tpu_train_step_ema_seconds").set(9.0)
+        assert StragglerRule().evaluate(reg, 0) is None   # no host dim
+        reg2 = self._fleet_reg({"h0": 0.5})
+        assert StragglerRule().evaluate(reg2, 0) is None  # 1 host
+
+    def test_straggler_silent_when_fleet_is_even(self):
+        reg = self._fleet_reg({"h0": 0.010, "h1": 0.011, "h2": 0.012})
+        assert StragglerRule(factor=1.75).evaluate(reg, 0) is None
+
+    def test_goodput_floor_grace_then_fire(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("paddle_tpu_goodput", labelnames=("host",))
+        w = reg.gauge("paddle_tpu_goodput_wall_seconds",
+                      labelnames=("host",))
+        g.labels(host="h0").set(0.2)
+        w.labels(host="h0").set(10.0)
+        rule = GoodputFloorRule(floor=0.5, min_wall_s=60.0)
+        assert rule.evaluate(reg, 0) is None          # young: grace
+        w.labels(host="h0").set(90.0)
+        detail = rule.evaluate(reg, 0)
+        assert detail and "h0" in detail
+        g.labels(host="h0").set(0.8)
+        assert rule.evaluate(reg, 0) is None          # recovered
+
+    def test_injected_delay_inflates_ema_and_trips_straggler(self,
+                                                             monkeypatch):
+        """Acceptance: the straggler rule demonstrably fires under an
+        injected per-host step delay — arm train.straggler_delay, run
+        real TrainStep steps, and use the inflated EMA as one host of a
+        federated registry against two healthy peers."""
+        import paddle_tpu.nn as nn
+        from paddle_tpu import robustness
+        from paddle_tpu.jit import TrainStep
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        pp.seed(0)
+        opt_model = M()
+        opt = pp.optimizer.SGD(learning_rate=1e-2,
+                               parameters=opt_model.parameters())
+        step = TrainStep(opt_model, opt,
+                         loss_fn=lambda out, y: ((out - y) ** 2).mean())
+        x = np.ones((2, 4), np.float32)
+        step((x, x))                # compile outside the fault window
+        monkeypatch.setenv("PADDLE_TPU_STRAGGLER_DELAY_S", "0.05")
+        robustness.inject("train.straggler_delay")
+        try:
+            for _ in range(6):      # EMA converges onto the delay
+                step((x, x))
+            fires = robustness.fault_stats(
+                "train.straggler_delay")["fires"]
+        finally:
+            robustness.clear_faults()
+        assert fires >= 6
+        ema = default_registry().get(
+            "paddle_tpu_train_step_ema_seconds").value()
+        assert ema >= 0.03, ema
+        fleet = self._fleet_reg({"r0": 0.002, "r1": 0.0025,
+                                 "straggler": ema})
+        detail = StragglerRule(factor=1.75).evaluate(fleet, 0)
+        assert detail and "straggler" in detail
+
+    def test_new_rules_constructible_from_spec(self):
+        rules = rules_from_spec(
+            "straggler:factor=2.0,min_hosts=3;"
+            "goodput_floor:floor=0.4,min_wall_s=10")
+        assert isinstance(rules[0], StragglerRule)
+        assert rules[0].factor == 2.0 and rules[0].min_hosts == 3
+        assert isinstance(rules[1], GoodputFloorRule)
+        assert rules[1].floor == 0.4
+
+
+# -------------------------------------------------------------- CLI/table
+class TestFleetTable:
+    def test_table_rows_and_straggler_footer(self):
+        store = LocalStore()
+        for host, ema, gp in (("r0", 0.010, 0.9), ("r1", 0.011, 0.85),
+                              ("r2", 0.040, 0.4)):
+            reg = MetricsRegistry()
+            reg.counter("paddle_tpu_train_steps_total").inc(12)
+            reg.gauge("paddle_tpu_train_step_ema_seconds").set(ema)
+            reg.gauge("paddle_tpu_goodput").set(gp)
+            reg.gauge("paddle_tpu_slo_attainment",
+                      labelnames=("kind",)).labels(kind="ttft").set(0.97)
+            _publish(store, reg, host)
+        agg = FleetAggregator(store=store)
+        agg.refresh()
+        table = agg.table()
+        assert "r2" in table and "top stragglers" in table
+        assert "r2 (" in table.split("top stragglers:")[1]
+        assert "97.0%" in table
+
+    def test_host_id_respects_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FLEET_HOST", "custom")
+        assert fleet_host_id() == "custom"
+        monkeypatch.delenv("PADDLE_TPU_FLEET_HOST")
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+        monkeypatch.delenv("PADDLE_ELASTIC_GEN", raising=False)
+        assert fleet_host_id() == "r3"
+        monkeypatch.setenv("PADDLE_ELASTIC_GEN", "2")
+        assert fleet_host_id() == "g2r3"
+
+
+# ------------------------------------------------- serving SLO counters
+class TestServingSLOFeed:
+    def test_engine_counts_hits_and_misses(self, monkeypatch):
+        from paddle_tpu.inference.serving import ContinuousBatchingEngine
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        # generous TTFT target (hit) + impossible TPOT target (miss)
+        monkeypatch.setenv("PADDLE_TPU_SLO_TTFT_TARGET", "100.0")
+        monkeypatch.setenv("PADDLE_TPU_SLO_TPOT_TARGET", "1e-9")
+        pp.seed(0)
+        model = LlamaForCausalLM(LlamaConfig.tiny(
+            vocab_size=64, hidden_size=16, intermediate_size=32,
+            num_hidden_layers=1, num_attention_heads=2,
+            num_key_value_heads=1, max_position_embeddings=64))
+        m = default_registry().get("paddle_tpu_serving_slo_total")
+        before = {k: c.value() for k, c in m.series()} if m else {}
+        with ContinuousBatchingEngine(model, slots=2, max_len=32,
+                                      prefill_buckets=(8,)) as eng:
+            rid = eng.add_request(np.arange(5, dtype=np.int32),
+                                  max_new_tokens=4)
+            eng.run()
+        m = default_registry().get("paddle_tpu_serving_slo_total")
+        after = {k: c.value() for k, c in m.series()}
+
+        def delta(kind, result):
+            k = (kind, result)
+            return after.get(k, 0) - before.get(k, 0)
+        assert delta("ttft", "hit") == 1
+        assert delta("tpot", "miss") == 1
+        att = slo_attainment(default_registry())
+        assert att["ttft"] is not None and att["tpot"] is not None
+
+
+# --------------------------------------------- slow: 2-process elastic
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fleet_worker.py")
+
+
+@pytest.mark.slow
+def test_elastic_two_process_fleet(tmp_path):
+    """The acceptance drill (CI runs it unfiltered): 2 elastic workers
+    publish into the manager's store, generation 0 is killed, and the
+    federated view must show summed counters across BOTH generations'
+    hosts, host-labeled gauges, a merged Perfetto export with >= 2 host
+    tracks joined by trace ids, and goodput < 1.0 with the restart
+    debit visible."""
+    from paddle_tpu.distributed.elastic import ElasticManager
+
+    env = {"PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "JAX_PLATFORMS": "cpu"}
+    t0 = time.monotonic()
+    mgr = ElasticManager([sys.executable, WORKER], nproc=2,
+                         max_restarts=2, heartbeat_timeout=120.0,
+                         backoff_base=0.2, env=env,
+                         log_dir=str(tmp_path / "logs"))
+    try:
+        rc = mgr.run()
+        wall = time.monotonic() - t0
+        logs = ""
+        log_dir = tmp_path / "logs"
+        if log_dir.exists():
+            for f in sorted(log_dir.iterdir()):
+                logs += f"\n--- {f.name} ---\n" + f.read_text()[-2000:]
+        assert rc == 0, f"manager rc={rc}\n{logs}"
+        assert mgr.restarts == 1, logs
+
+        agg = FleetAggregator(store=mgr._store, stale_after=3600.0)
+        hosts = agg.poll()
+        # gen-0 hosts (at least the publishing crasher) + both gen-1
+        gens = {h[:2] for h in hosts}
+        assert "g1" in gens and "g0" in gens, hosts
+        assert {"g1r0", "g1r1"} <= set(hosts), hosts
+
+        merged = agg.merged_registry()
+        # counters sum EXACTLY across per-host snapshots
+        expect = sum(
+            FleetAggregator._snap_value(
+                s, "paddle_tpu_train_steps_total") or 0.0
+            for s in agg._snapshots.values())
+        assert merged.get(
+            "paddle_tpu_train_steps_total").value() == expect > 0
+        text = render_prometheus(agg)
+        assert 'paddle_tpu_train_step_ema_seconds{host="g1r0"}' in text
+        assert 'paddle_tpu_goodput{host=' in text
+
+        # stitched trace: >= 2 host tracks, joined by the generation
+        # trace id the workers adopted from the manager
+        trace = agg.export_chrome(str(tmp_path / "fleet_trace.json"))
+        tracks = [e for e in trace["traceEvents"]
+                  if e.get("name") == "process_name"]
+        assert len(tracks) >= 2, tracks
+        by_pid = {}
+        for e in trace["traceEvents"]:
+            if e.get("ph") == "X":
+                by_pid.setdefault(e["pid"], set()).add(
+                    e["args"]["trace_id"])
+        # each generation is one trace: its two hosts' tracks must
+        # share that generation's trace id (gen0 and gen1 are distinct
+        # traces, so the join is pairwise, not fleet-global)
+        pids = list(by_pid)
+        shared = {tid for i, a in enumerate(pids) for b in pids[i + 1:]
+                  for tid in by_pid[a] & by_pid[b]}
+        assert shared, f"no cross-host trace id: {by_pid}"
+
+        # goodput: restart debit visible, fleet ratio < 1
+        downtime = default_registry().get(
+            "paddle_tpu_elastic_downtime_seconds_total").value()
+        assert downtime > 0
+        productive = merged.get(
+            "paddle_tpu_train_productive_seconds_total").value()
+        assert 0 < productive < wall
+        assert (productive / (2 * wall)) < 1.0
+    finally:
+        mgr.close()

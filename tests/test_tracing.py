@@ -550,6 +550,57 @@ class TestPrometheusBuckets:
             prev_b, prev_c = float(b), buckets[b]
         assert 0.1 < est <= 0.5
 
+    def test_histogram_quantile_from_federated_exposition(self):
+        """ISSUE 11 satellite: the SAME histogram_quantile math over
+        the FEDERATED (3-host, bucket-summed) exposition must match the
+        estimate from one histogram that observed the pooled raw
+        stream — federation must not bend quantiles."""
+        from paddle_tpu.observability.fleet import (FleetAggregator,
+                                                    LocalStore,
+                                                    MetricsPublisher)
+        bounds = (0.01, 0.05, 0.1, 0.5)
+        per_host = ([0.02] * 30 + [0.3] * 5, [0.02] * 30 + [0.3] * 10,
+                    [0.02] * 20 + [0.3] * 5)
+        store = LocalStore()
+        pooled = []
+        for i, obs in enumerate(per_host):
+            reg = MetricsRegistry()
+            h = reg.histogram("paddle_tpu_q_seconds", "q",
+                              buckets=bounds)
+            for v in obs:
+                h.observe(v)
+            pooled.extend(obs)
+            MetricsPublisher(store, registry=reg, host=f"h{i}",
+                             interval=999, publish_goodput=False,
+                             publish_traces=False).publish_once()
+        agg = FleetAggregator(store=store)
+
+        def quantile_from_text(text, q):
+            buckets = {}
+            for line in text.splitlines():
+                if line.startswith("paddle_tpu_q_seconds_bucket"):
+                    le = line.split('le="')[1].split('"')[0]
+                    buckets[le] = float(line.rsplit(" ", 1)[1])
+            target = q * buckets["+Inf"]
+            prev_b, prev_c = 0.0, 0.0
+            for b in [k for k in buckets if k != "+Inf"]:
+                if buckets[b] >= target:
+                    return prev_b + (float(b) - prev_b) * \
+                        (target - prev_c) / (buckets[b] - prev_c)
+                prev_b, prev_c = float(b), buckets[b]
+            return float(b)
+
+        fed_text = render_prometheus(agg)
+        ref = MetricsRegistry()
+        rh = ref.histogram("paddle_tpu_q_seconds", "q", buckets=bounds)
+        for v in pooled:
+            rh.observe(v)
+        ref_text = render_prometheus(ref)
+        assert f"paddle_tpu_q_seconds_count {len(pooled)}" in fed_text
+        for q in (0.5, 0.9, 0.99):
+            assert abs(quantile_from_text(fed_text, q)
+                       - quantile_from_text(ref_text, q)) < 1e-12, q
+
     def test_jsonl_payload_keeps_quantile_summaries(self):
         from paddle_tpu.observability import render_json
         reg = MetricsRegistry()
