@@ -129,6 +129,18 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
             regressions.append(
                 f"slo_attainment.{kind} {float(ca):.3f} < prev "
                 f"{float(pa):.3f} - {tolerance:.0%} tolerance")
+    # fleet serving (router speedup over the in-process single-engine
+    # baseline is better-higher; guarded once both artifacts ran
+    # --fleet with the same replica count)
+    pf, cf = pd.get("fleet") or {}, cd.get("fleet") or {}
+    if pf.get("speedup") and cf.get("speedup") is not None and \
+            pf.get("replicas") == cf.get("replicas"):
+        if float(cf["speedup"]) < float(pf["speedup"]) \
+                * (1.0 - tolerance):
+            regressions.append(
+                f"fleet.speedup {float(cf['speedup']):.3f} < prev "
+                f"{float(pf['speedup']):.3f} - {tolerance:.0%} "
+                "tolerance")
     return regressions
 
 

@@ -65,6 +65,61 @@ def _build_engine(model, args, paged):
         spec_decode=args.spec if paged else 0)
 
 
+def _build_router(model, args):
+    """The fleet under test: dedicated prefill replica(s) feeding a
+    decode tier that runs DEEP step fusion (--decode-sync) — legal only
+    because disaggregation means prefill never interleaves there.  The
+    host-dispatch amortization is the measured fleet win; --fleet-mixed
+    builds a homogeneous fleet instead (routing/spill only)."""
+    from paddle_tpu.inference.router import ServingRouter
+    ek = dict(slots=args.slots, max_len=args.max_len,
+              prefill_buckets=(args.max_len // 2,),
+              steps_per_sync=1, paged_kv=True,
+              kv_block_size=args.block_size, prefill_chunk=args.chunk)
+    prefill = 0 if args.fleet_mixed else max(1, args.prefill_replicas)
+    return ServingRouter(
+        model, replicas=args.fleet, prefill_replicas=prefill,
+        engine_kwargs=ek,
+        decode_kwargs=dict(
+            steps_per_sync=args.decode_sync if not args.spec else 1,
+            spec_decode=args.spec),
+        warm_on_spawn=False)   # bench warms explicitly, outside timing
+
+
+def _run_stats(eng, prompts, arrivals, args):
+    """Drive one workload and fold the per-request timings."""
+    results, rids, t0, t1 = _run_workload(eng, prompts, arrivals,
+                                          args.max_new)
+    ttfts, tpots, total_tokens = [], [], 0
+    reused_tokens = 0.0
+    accept_rates = []
+    route_s, handoff_s = [], []
+    for rid in rids:
+        st = eng.request_status(rid)
+        out = results.get(rid, [])
+        total_tokens += len(out)
+        t = st.timings if st is not None else {}
+        if t.get("ttft_s"):
+            ttfts.append(t["ttft_s"])
+        if t.get("decode_s") and len(out) > 1:
+            tpots.append(t["decode_s"] / (len(out) - 1))
+        reused_tokens += t.get("prefix_tokens_reused", 0.0)
+        if t.get("route_s"):
+            route_s.append(t["route_s"])
+        if t.get("handoff_s"):
+            handoff_s.append(t["handoff_s"])
+        if args.spec:
+            accept_rates.append(t.get("speculative_accept_rate", 0.0))
+    wall = t1 - t0
+    return {"results": results, "rids": rids, "wall": wall,
+            "tokens": total_tokens,
+            "tok_s": total_tokens / wall if wall > 0 else 0.0,
+            "ttfts": ttfts, "tpots": tpots,
+            "reused_tokens": reused_tokens,
+            "accept_rates": accept_rates,
+            "route_s": route_s, "handoff_s": handoff_s}
+
+
 def _workload(args, vocab):
     """(prompts, max_new, arrival_offsets): shared system prefix + unique
     suffixes, Poisson inter-arrival gaps at --rps."""
@@ -141,7 +196,24 @@ def main(argv=None):
                     help="regression-check vs the newest "
                          "BENCH_serve_r*.json (exit 1 beyond tolerance)")
     ap.add_argument("--tolerance", type=float, default=0.25)
+    from paddle_tpu.inference.router import fleet_serve_replicas
+    ap.add_argument("--fleet", type=int,
+                    default=fleet_serve_replicas(0),
+                    help="route the workload through a ServingRouter "
+                         "over N replicas (default PADDLE_TPU_FLEET_"
+                         "SERVE; 0 = single engine).  The single-engine "
+                         "baseline runs first in the same process so "
+                         "detail.fleet carries the measured speedup")
+    ap.add_argument("--prefill-replicas", type=int, default=1,
+                    help="dedicated prefill replicas in the fleet")
+    ap.add_argument("--fleet-mixed", action="store_true",
+                    help="homogeneous mixed fleet (no disaggregation)")
+    ap.add_argument("--decode-sync", type=int, default=4,
+                    help="decode-tier steps_per_sync under "
+                         "disaggregation")
     args = ap.parse_args(argv)
+    if args.fleet and args.fleet < 2:
+        ap.error("--fleet needs >= 2 replicas")
 
     import jax
 
@@ -166,6 +238,8 @@ def main(argv=None):
     model = LlamaForCausalLM(cfg)
 
     prompts, arrivals = _workload(args, cfg.vocab_size)
+    if args.fleet:
+        paged = True            # the fleet handoff rides paged blocks
     eng = _build_engine(model, args, paged)
     # explicit AOT warmup outside the timed window: compiles (or, with
     # PADDLE_TPU_COMPILE_CACHE=1, deserialize-and-loads) every serving
@@ -185,26 +259,62 @@ def main(argv=None):
     first_token_s = (st_warm.timings.get("ttft_s")
                      if st_warm is not None else None)
 
-    results, rids, t0, t1 = _run_workload(eng, prompts, arrivals,
-                                          args.max_new)
+    base = _run_stats(eng, prompts, arrivals, args)
 
-    ttfts, tpots, total_tokens = [], [], 0
-    reused_tokens = 0.0
-    accept_rates = []
-    for i, rid in enumerate(rids):
-        st = eng.request_status(rid)
-        out = results.get(rid, [])
-        total_tokens += len(out)
-        t = st.timings if st is not None else {}
-        if t.get("ttft_s"):
-            ttfts.append(t["ttft_s"])
-        if t.get("decode_s") and len(out) > 1:
-            tpots.append(t["decode_s"] / (len(out) - 1))
-        reused_tokens += t.get("prefix_tokens_reused", 0.0)
-        if args.spec:
-            accept_rates.append(t.get("speculative_accept_rate", 0.0))
-    wall = t1 - t0
-    tok_s = total_tokens / wall if wall > 0 else 0.0
+    fleet_detail = None
+    if args.fleet:
+        # the fleet under test: same workload, fresh arrival clock; the
+        # run above is the in-process single-engine baseline the
+        # speedup/TTFT-ratio acceptance numbers divide by
+        router = _build_router(model, args)
+        for rep in router._replicas.values():
+            stats = rep.engine.aot_warmup()
+            warm_stats.update(stats)
+        w = router.add_request(
+            prompts[0][: max(2, len(prompts[0]) // 2)],
+            max_new_tokens=2)
+        router.run()
+        fleet = _run_stats(router, prompts, arrivals, args)
+        serving = fleet
+        serving_eng = router
+        base_ttft99 = _percentiles(base["ttfts"])["p99"]
+        fl_ttft99 = _percentiles(fleet["ttfts"])["p99"]
+        fleet_detail = {
+            "replicas": args.fleet,
+            "prefill_replicas": (0 if args.fleet_mixed
+                                 else max(1, args.prefill_replicas)),
+            "decode_steps_per_sync": (args.decode_sync if not args.spec
+                                      else 1),
+            "baseline_tokens_per_s": round(base["tok_s"], 2),
+            "speedup": round(fleet["tok_s"] / base["tok_s"], 4)
+            if base["tok_s"] else None,
+            "baseline_ttft_p99_s": base_ttft99,
+            "ttft_p99_ratio": round(fl_ttft99 / base_ttft99, 4)
+            if base_ttft99 and fl_ttft99 else None,
+            "baseline_tpot_p99_s": _percentiles(base["tpots"])["p99"],
+            "route_p50_s": _percentiles(fleet["route_s"],
+                                        ps=(50,))["p50"],
+            "handoff_p50_s": _percentiles(fleet["handoff_s"],
+                                          ps=(50,))["p50"],
+            "handoffs": _series("paddle_tpu_router_handoffs_total"),
+            "dispatch": _series("paddle_tpu_router_affinity_total"),
+            "requeues": _series("paddle_tpu_router_requeues_total"),
+            "replica_deaths": _series(
+                "paddle_tpu_router_replica_deaths_total"),
+            "handoff_bytes": _series(
+                "paddle_tpu_router_handoff_bytes_total"),
+        }
+    else:
+        serving = base
+        serving_eng = eng
+
+    results, rids = serving["results"], serving["rids"]
+    reused_tokens = serving["reused_tokens"]
+    accept_rates = serving["accept_rates"]
+    wall = serving["wall"]
+    total_tokens = serving["tokens"]
+    tok_s = serving["tok_s"]
+    ttfts, tpots = serving["ttfts"], serving["tpots"]
     ttft = _percentiles(ttfts)
     tpot = _percentiles(tpots)
 
@@ -242,6 +352,8 @@ def main(argv=None):
         "spec_accept_rate_mean": (float(np.mean(accept_rates))
                                   if accept_rates else None),
     }
+    if fleet_detail is not None:
+        detail["fleet"] = fleet_detail
     # replica cold-start ledger (ROADMAP 5): wall time to acquire every
     # serving executable (trace+compile live, or deserialize on a
     # compile-cache hit), TTFT of the first request after warmup, and
@@ -288,13 +400,15 @@ def main(argv=None):
 
     if args.check_equivalence:
         # replay sequentially through the slot-contiguous engine: paged
-        # greedy decode must be token-for-token identical
-        base = _build_engine(model, argparse.Namespace(
+        # (and routed/disaggregated) greedy decode must be
+        # token-for-token identical
+        base_eng = _build_engine(model, argparse.Namespace(
             **{**vars(args), "spec": 0}), paged=False)
         mismatches = 0
         for i, rid in enumerate(rids):
-            b = base.add_request(prompts[i], max_new_tokens=args.max_new)
-            got = base.run()[b][1]
+            b = base_eng.add_request(prompts[i],
+                                     max_new_tokens=args.max_new)
+            got = base_eng.run()[b][1]
             if got != results.get(rid):
                 mismatches += 1
                 print(f"EQUIVALENCE MISMATCH req {i}: paged="

@@ -54,7 +54,7 @@ import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "SequenceBlocks", "PrefixCache",
            "PagedKVPool", "PagedCache", "paged_cache_attention",
-           "paged_kv_enabled"]
+           "paged_kv_enabled", "serialize_handoff", "deserialize_handoff"]
 
 
 def paged_kv_enabled(default: bool = False) -> bool:
@@ -340,6 +340,15 @@ class PagedKVPool:
         self._copy = jax.jit(
             lambda pool, src, dst: pool.at[dst].set(pool[src]),
             donate_argnums=(0,))
+        # block export/import (cross-replica KV handoff): one compiled
+        # gather / scatter covers every layer's k AND v pool, so a
+        # prefill->decode transfer costs two device dispatches, not
+        # 4 * num_layers
+        self._gather = jax.jit(lambda pools, idx: [p[idx] for p in pools])
+        self._scatter = jax.jit(
+            lambda pools, idx, vals: [p.at[idx].set(v.astype(p.dtype))
+                                      for p, v in zip(pools, vals)],
+            donate_argnums=(0,))
         self.cow_copies = 0
 
     def copy_block(self, src: int, dst: int):
@@ -357,6 +366,173 @@ class PagedKVPool:
         n = len(self.kpools)
         self.kpools = [jnp.zeros(shape, dtype) for _ in range(n)]
         self.vpools = [jnp.zeros(shape, dtype) for _ in range(n)]
+
+    # -- cross-replica block transfer (prefill/decode disaggregation) --------
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Transfer shapes are padded to powers of two so the gather/
+        scatter executables see a handful of shapes, not one per prompt
+        length (a shape-fresh transfer would pay an XLA compile INSIDE
+        the handoff)."""
+        return 1 << max(0, n - 1).bit_length()
+
+    def export_blocks(self, bids: Sequence[int]) -> dict:
+        """Read physical blocks `bids` out of every layer's k/v pool as
+        host arrays — the payload side of a prefill→decode KV handoff.
+        Layout: ``{"block_size", "k": [L x [n, bs, kvh, hd]], "v": [...]}``
+        with blocks ordered as `bids` (logical order for a sequence's
+        prompt).  Pure read: the pools are untouched.  The device
+        gather runs at the padded bucket size (pad ids = scratch block
+        0), but the returned arrays are trimmed to the real count so
+        the wire payload carries no padding."""
+        bids = list(bids)
+        n = len(bids)
+        idx = jnp.asarray(bids + [0] * (self._bucket(n) - n), jnp.int32)
+        outs = self._gather(self.kpools + self.vpools, idx)
+        L = len(self.kpools)
+        return {"block_size": int(self.block_size),
+                "k": [np.asarray(o)[:n] for o in outs[:L]],
+                "v": [np.asarray(o)[:n] for o in outs[L:]]}
+
+    def import_blocks(self, payload: dict, dst_bids: Sequence[int],
+                      src_start: int = 0):
+        """Scatter exported blocks into this pool at physical ids
+        `dst_bids` (the receiving replica's own allocation), starting at
+        logical block `src_start` of the payload — a receiver whose
+        prefix cache already holds the leading blocks imports only the
+        tail.  Pad writes land in the scratch block (never observable).
+        Raises on geometry mismatch (block size / kv heads / head dim /
+        layer count must agree across the fleet)."""
+        dst_bids = list(dst_bids)
+        if not dst_bids:
+            return
+        L = len(self.kpools)
+        if len(payload["k"]) != L or len(payload["v"]) != L:
+            raise ValueError(
+                f"handoff payload has {len(payload['k'])}/"
+                f"{len(payload['v'])} k/v layers, pool has {L}")
+        want = self.kpools[0].shape[1:]
+        got = tuple(payload["k"][0].shape[1:])
+        if got != want:
+            raise ValueError(
+                f"handoff block geometry {got} != pool {want} "
+                "(block_size / kv_heads / head_dim must match)")
+        if src_start + len(dst_bids) > payload["k"][0].shape[0]:
+            raise ValueError(
+                f"import of {len(dst_bids)} blocks from offset "
+                f"{src_start} exceeds payload of "
+                f"{payload['k'][0].shape[0]} blocks")
+        n = len(dst_bids)
+        pad = self._bucket(n) - n
+        sel = slice(src_start, src_start + n)
+        idx = jnp.asarray(dst_bids + [0] * pad, jnp.int32)
+
+        def prep(a):
+            a = np.ascontiguousarray(a[sel])
+            if pad:
+                a = np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            return jnp.asarray(a)
+
+        vals = [prep(a) for a in list(payload["k"]) + list(payload["v"])]
+        pools = self._scatter(self.kpools + self.vpools, idx, vals)
+        self.kpools, self.vpools = pools[:L], pools[L:]
+
+    def warm_transfer(self, max_blocks: int):
+        """Compile the export/import executables for every pow-2 bucket
+        up to `max_blocks` (pad target = scratch block, so the dummy
+        import is invisible) — keeps XLA compiles out of the first real
+        handoff's latency."""
+        b = 1
+        while b <= max(1, max_blocks):
+            payload = self.export_blocks([0] * b)
+            self.import_blocks(payload, [0] * b)
+            b *= 2
+
+
+# -- handoff wire format -----------------------------------------------------
+
+def _dtype_of(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 & friends (jax's extended dtypes)
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_handoff(payload: dict) -> bytes:
+    """Flatten a handoff payload (scalars + numpy arrays + the nested
+    ``kv`` block export) into one length-prefixed bytes blob that rides
+    any byte transport — the TCPStore for a multi-process fleet, shared
+    memory in-process.  Arrays are raw little-endian buffers with dtype
+    recorded by name (bfloat16 survives; no pickle anywhere)."""
+    import json as _json
+    meta: dict = {"scalars": {}, "arrays": []}
+    chunks: List[bytes] = []
+
+    def add_array(name, a):
+        a = np.ascontiguousarray(a)
+        meta["arrays"].append({"name": name, "dtype": str(a.dtype),
+                               "shape": list(a.shape)})
+        chunks.append(a.tobytes())
+
+    for key, val in payload.items():
+        if key == "kv":
+            meta["scalars"]["kv_block_size"] = int(val["block_size"])
+            meta["kv_layers"] = len(val["k"])
+            for i, a in enumerate(val["k"]):
+                add_array(f"kv.k{i}", a)
+            for i, a in enumerate(val["v"]):
+                add_array(f"kv.v{i}", a)
+        elif isinstance(val, np.ndarray):
+            add_array(key, val)
+        else:
+            meta["scalars"][key] = val
+    head = _json.dumps(meta).encode()
+    return len(head).to_bytes(8, "big") + head + b"".join(chunks)
+
+
+def deserialize_handoff(data: bytes) -> dict:
+    """Inverse of :func:`serialize_handoff`."""
+    import json as _json
+    hlen = int.from_bytes(data[:8], "big")
+    meta = _json.loads(data[8:8 + hlen].decode())
+    off = 8 + hlen
+    arrays: Dict[str, np.ndarray] = {}
+    for ent in meta["arrays"]:
+        dt = _dtype_of(ent["dtype"])
+        n = int(np.prod(ent["shape"], dtype=np.int64)) * dt.itemsize
+        arrays[ent["name"]] = np.frombuffer(
+            data[off:off + n], dtype=dt).reshape(ent["shape"])
+        off += n
+    out: dict = {k: v for k, v in meta["scalars"].items()
+                 if k != "kv_block_size"}
+    for name, a in arrays.items():
+        if not name.startswith("kv."):
+            out[name] = a
+    L = meta.get("kv_layers", 0)
+    if L:
+        out["kv"] = {
+            "block_size": int(meta["scalars"]["kv_block_size"]),
+            "k": [arrays[f"kv.k{i}"] for i in range(L)],
+            "v": [arrays[f"kv.v{i}"] for i in range(L)],
+        }
+    return out
+
+
+def publish_handoff(store, key: str, payload: dict):
+    """Ship a serialized handoff through a TCPStore-contract store —
+    the multi-process fleet transport (the router's in-process path
+    hands the payload over directly)."""
+    store.set(key, serialize_handoff(payload))
+
+
+def fetch_handoff(store, key: str) -> Optional[dict]:
+    """Read a handoff published by :func:`publish_handoff`; None when
+    the key is absent."""
+    if not store.check(key):
+        return None
+    return deserialize_handoff(store.get(key, wait=False))
 
 
 # -- the paged attention path ------------------------------------------------

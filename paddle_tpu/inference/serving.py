@@ -210,6 +210,14 @@ class _Request:
     #                                 prefix cache (paged engine)
     spec_proposed: int = 0          # speculative drafts proposed
     spec_accepted: int = 0          # speculative drafts accepted
+    # fleet routing (ServingRouter): "full" is a normal request;
+    # "prefill_only" retires after its first token with the prompt KV
+    # parked for export; "resume" skips prefill, importing that KV
+    mode: str = "full"
+    handoff: Optional[dict] = None  # resume payload (blocks + first tok)
+    router_t0: Optional[float] = None  # router enqueue (end-to-end TTFT)
+    route_s: float = 0.0            # router queue -> slot admission
+    handoff_s: float = 0.0          # prefill->decode block transfer
 
 
 class RequestStatus(str):
@@ -235,9 +243,16 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
          "first_token": req.first_token_at, "retired": req.retired_at}
     if req.admitted_at and req.enqueued_at:
         t["queue_s"] = req.admitted_at - req.enqueued_at
-    if req.first_token_at and req.enqueued_at:
-        t["ttft_s"] = req.first_token_at - req.enqueued_at
-    if req.first_token_at and req.admitted_at:
+    # routed requests measure TTFT from the ROUTER's enqueue stamp —
+    # the client-visible origin; the engine-local stamp stays the
+    # origin for direct requests
+    origin = req.router_t0 or req.enqueued_at
+    if req.first_token_at and origin and req.first_token_at >= origin:
+        t["ttft_s"] = req.first_token_at - origin
+    if req.first_token_at and req.admitted_at \
+            and req.first_token_at >= req.admitted_at:
+        # absent for "resume" requests: their first token predates this
+        # engine's admission (it happened on the prefill replica)
         t["prefill_s"] = req.first_token_at - req.admitted_at
     if req.retired_at and req.first_token_at:
         t["decode_s"] = req.retired_at - req.first_token_at
@@ -251,6 +266,11 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
     t["speculative_accept_rate"] = (
         req.spec_accepted / req.spec_proposed if req.spec_proposed
         else 0.0)
+    # fleet routing evidence (router queue -> slot admission, and the
+    # prefill->decode block transfer) — 0.0 for unrouted requests, but
+    # ALWAYS present so TTFT decomposition needs no feature detection
+    t["route_s"] = float(req.route_s)
+    t["handoff_s"] = float(req.handoff_s)
     return t
 
 
@@ -282,7 +302,8 @@ class ContinuousBatchingEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
                  spec_decode: int = 0,
-                 spec_ngram: int = 3):
+                 spec_ngram: int = 3,
+                 role: str = "mixed"):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -385,6 +406,10 @@ class ContinuousBatchingEngine:
                                  f"max_len), got {prefill_chunk}")
             self._interleave_decode = False
             self._blocks_used_peak = 0
+        # prefill-only requests park their prompt blocks here at
+        # retirement (rid -> (request, SequenceBlocks, first_token));
+        # the router exports/discards them (prefill/decode handoff)
+        self._handoff_ready: Dict[int, tuple] = {}
         self._pos = np.zeros((slots,), np.int32)       # next write row
         self._active: List[Optional[_Request]] = [None] * slots
         self._budget = np.zeros((slots,), np.int32)    # tokens remaining
@@ -434,6 +459,17 @@ class ContinuousBatchingEngine:
             lambda a=self._active: sum(r is not None for r in a))
         reg.gauge("paddle_tpu_serving_slots",
                   "slot pool size").set(slots)
+        # fleet role marker (disaggregated serving): one-replica-per-
+        # process fleets publish this through the metrics publisher and
+        # the fleet table renders it as the replica's role column
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"role must be mixed|prefill|decode, got "
+                             f"{role!r}")
+        self.role = role
+        reg.gauge("paddle_tpu_serving_replica_role",
+                  "serving role this engine plays in a disaggregated "
+                  "fleet (value 1 marks the active role)",
+                  labelnames=("role",)).labels(role=role).set(1.0)
         if self.paged:
             # read through the engine, not a bound allocator: _recover
             # rebuilds the allocator/prefix objects on error containment
@@ -717,6 +753,10 @@ class ContinuousBatchingEngine:
                      target="serving.spec_verify")
             if c is not None:
                 self._spec_verify_compiled = c
+        # handoff transfer executables (prefill/decode disaggregation):
+        # one pow-2-bucketed gather/scatter pair per size, compiled now
+        # so a fleet's first KV handoff doesn't pay an XLA compile
+        self._pool.warm_transfer(self._max_blocks)
 
     def analyze(self, strict: bool = False, passes=None, options=None):
         """Lint the compiled decode step (the hot serving path) with the
@@ -749,17 +789,43 @@ class ContinuousBatchingEngine:
 
     # -- public API ----------------------------------------------------------
     def add_request(self, prompt_ids, max_new_tokens: int = 64,
-                    timeout_s: Optional[float] = None) -> int:
+                    timeout_s: Optional[float] = None, *,
+                    prefill_only: bool = False,
+                    handoff: Optional[Dict] = None,
+                    router_enqueued_at: Optional[float] = None,
+                    span_parent=None) -> int:
         """Enqueue a prompt.  `timeout_s` (or the engine-wide
         ``request_timeout_s`` default) is a wall-clock deadline from NOW:
         a request still queued or decoding past it is retired with
         status "timeout".  Raises :class:`QueueFullError` when the
-        bounded admission queue is at capacity."""
+        bounded admission queue is at capacity.
+
+        Fleet-router hooks (both require the paged engine):
+        ``prefill_only=True`` retires the request right after its first
+        token with status ``"prefilled"`` and parks the prompt's KV
+        blocks for :meth:`export_handoff`; ``handoff=payload`` is the
+        receiving side — the request skips prefill entirely, importing
+        the exported blocks at admission.  ``router_enqueued_at``
+        re-anchors TTFT at the router's clock and ``span_parent`` nests
+        the request span under the router's (the cross-hop trace)."""
         p = np.asarray(prompt_ids, np.int32).reshape(-1)
         if max_new_tokens < 1:
             raise ValueError(f"max_new_tokens must be >= 1 (the prefill "
                              f"already emits one token); got "
                              f"{max_new_tokens}")
+        if prefill_only and handoff is not None:
+            raise ValueError("prefill_only and handoff are the two ends "
+                             "of one transfer; a request can't be both")
+        if (prefill_only or handoff is not None) and not self.paged:
+            raise ValueError(
+                "prefill/decode disaggregation needs the paged KV "
+                "engine (paged_kv=True or PADDLE_TPU_PAGED_KV=1)")
+        if handoff is not None and \
+                int(handoff.get("block_size", self._block_size if
+                    self.paged else 0)) != self._block_size:
+            raise ValueError(
+                f"handoff block_size {handoff.get('block_size')} != "
+                f"engine kv_block_size {self._block_size}")
         if self._max_queue is not None and \
                 len(self._queue) >= self._max_queue:
             from paddle_tpu.robustness import QueueFullError
@@ -773,7 +839,14 @@ class ContinuousBatchingEngine:
         # must stay unreachable; chunked decode over-writes up to the next
         # steps_per_sync boundary, so budget in whole chunks
         K = self.steps_per_sync
-        if self.paged and self.spec_tokens:
+        if prefill_only:
+            # prefill writes rows 0..Lp-1 only; the first token is
+            # sampled, never cached here — the decode replica writes it
+            if len(p) > self.max_len - 1:
+                raise ValueError(
+                    f"prompt {len(p)} exceeds max_len-1 = "
+                    f"{self.max_len - 1} (last row is reserved)")
+        elif self.paged and self.spec_tokens:
             # spec verify writes up to spec_tokens draft rows past the
             # accepted position; budget that headroom up front
             span = max_new_tokens + self.spec_tokens
@@ -800,7 +873,9 @@ class ContinuousBatchingEngine:
             # queue forever — reject at submission, like the bucket and
             # max_len bounds (transient exhaustion, by contrast, defers
             # admission and resolves as running slots retire)
-            if self.spec_tokens:
+            if prefill_only:
+                span = 0
+            elif self.spec_tokens:
                 span = max_new_tokens + self.spec_tokens
             else:
                 span = -(-max_new_tokens // K) * K
@@ -817,13 +892,23 @@ class ContinuousBatchingEngine:
         now = time.perf_counter()
         req = _Request(
             rid, p, max_new_tokens, enqueued_at=now,
-            deadline=(now + timeout) if timeout is not None else None)
+            deadline=(now + timeout) if timeout is not None else None,
+            mode=("prefill_only" if prefill_only
+                  else "resume" if handoff is not None else "full"),
+            handoff=handoff, router_t0=router_enqueued_at)
         # per-request root span, open until retirement.  The engine loop
         # may run on another thread; the span rides the request object —
-        # explicit propagation, no thread-local assumptions.
-        req.span = self._tracer.start_span(
-            "serving.request", rid=rid, prompt_len=len(p),
-            max_new_tokens=max_new_tokens)
+        # explicit propagation, no thread-local assumptions.  A routed
+        # request parents under the router's span (the cross-hop trace).
+        if span_parent is not None:
+            req.span = self._tracer.start_span(
+                "serving.request", parent=span_parent, rid=rid,
+                prompt_len=len(p), max_new_tokens=max_new_tokens,
+                mode=req.mode)
+        else:
+            req.span = self._tracer.start_span(
+                "serving.request", rid=rid, prompt_len=len(p),
+                max_new_tokens=max_new_tokens)
         self._queue.append(req)
         self._metrics["requests"].inc()
         ev = dict(rid=rid, prompt_len=len(p),
@@ -853,6 +938,8 @@ class ContinuousBatchingEngine:
         Lp = len(req.prompt)
         Lb = self._bucket(Lp)
         req.admitted_at = time.perf_counter()
+        if req.router_t0 is not None:
+            req.route_s = req.admitted_at - req.router_t0
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :Lp] = req.prompt
         cfgm = self.model.config
@@ -885,8 +972,9 @@ class ContinuousBatchingEngine:
                            fit="exact" if Lp == Lb else "padded").inc()
         if Lb > Lp:
             m["pad_tokens"].inc(Lb - Lp)
-        if req.enqueued_at:
-            m["ttft"].observe(time.perf_counter() - req.enqueued_at)
+        origin = req.router_t0 or req.enqueued_at
+        if origin:
+            m["ttft"].observe(time.perf_counter() - origin)
         self._recorder.record("serving.admit", rid=req.rid, slot=slot,
                               prompt_len=Lp, bucket=Lb)
         self._active[slot] = req
@@ -906,9 +994,13 @@ class ContinuousBatchingEngine:
         add_request already sheds load (QueueFullError)."""
         from paddle_tpu.inference.kv_cache import SequenceBlocks
         from paddle_tpu.robustness import fault_fires
+        if req.handoff is not None:
+            return self._admit_resume(slot, req)
         bs = self._block_size
         Lp = len(req.prompt)
-        if self.spec_tokens:
+        if req.mode == "prefill_only":
+            gen_span = 0             # this replica never decodes it
+        elif self.spec_tokens:
             gen_span = req.max_new_tokens + self.spec_tokens
         else:
             K = self.steps_per_sync
@@ -948,6 +1040,8 @@ class ContinuousBatchingEngine:
         reused = len(reuse_bids) * bs
         req.prefix_reused = reused
         req.admitted_at = time.perf_counter()
+        if req.router_t0 is not None:
+            req.route_s = req.admitted_at - req.router_t0
         if reused:
             m["prefix_tokens"].inc(reused)
         m["admissions"].inc()
@@ -959,6 +1053,126 @@ class ContinuousBatchingEngine:
                               prompt_len=Lp, prefix_reused=reused,
                               blocks=len(seq.bids))
         return True
+
+    def _admit_resume(self, slot: int, req: _Request) -> bool:
+        """Admit a handed-off request: allocate blocks for the full
+        span, IMPORT the prefill replica's exported prompt KV (skipping
+        any leading blocks this replica's prefix cache already holds),
+        and enter decode directly — the handoff is a copy, never a
+        recompute.  Returns False on allocator exhaustion, exactly like
+        :meth:`_admit_paged` (the request stays queued)."""
+        from paddle_tpu.inference.kv_cache import SequenceBlocks
+        from paddle_tpu.robustness import fault_fires
+        h = req.handoff
+        bs = self._block_size
+        Lp = len(req.prompt)
+        if self.spec_tokens:
+            gen_span = req.max_new_tokens + self.spec_tokens
+        else:
+            K = self.steps_per_sync
+            gen_span = -(-req.max_new_tokens // K) * K
+        total = Lp + gen_span
+        m = self._metrics
+        reuse_bids: List[int] = []
+        if self._prefix is not None:
+            matched = self._prefix.match(req.prompt)
+            reuse_bids = matched[:(Lp - 1) // bs]
+            m["prefix_lookups"].labels(
+                result="hit" if reuse_bids else "miss").inc()
+        need = -(-total // bs) - len(reuse_bids)
+        exhausted = fault_fires("serving.kv_alloc", slot=slot,
+                                rid=req.rid, need=need)
+        if not exhausted and self._allocator.free_blocks < need and \
+                self._prefix is not None:
+            m["evictions"].inc(
+                self._prefix.evict(need - self._allocator.free_blocks))
+        if exhausted or self._allocator.free_blocks < need:
+            m["alloc_failures"].inc()
+            self._recorder.record(
+                "serving.kv_alloc_exhausted", rid=req.rid, need=need,
+                free=self._allocator.free_blocks,
+                injected=bool(exhausted))
+            return False
+        seq = SequenceBlocks(self._allocator, bs)
+        seq.adopt_shared(reuse_bids)
+        seq.ensure_capacity(total)
+        nprompt = -(-Lp // bs)       # blocks the payload covers
+        t0 = time.perf_counter()
+        if nprompt > len(reuse_bids):
+            self._pool.import_blocks(
+                h["kv"], seq.bids[len(reuse_bids):nprompt],
+                src_start=len(reuse_bids))
+        req.handoff_s = float(h.get("transfer_s", 0.0)) \
+            + (time.perf_counter() - t0)
+        req.route_s = float(h.get("route_s", 0.0))
+        self._seq[slot] = seq
+        self._bt[slot, :] = 0
+        self._bt[slot, :len(seq.bids)] = seq.bids
+        reused = len(reuse_bids) * bs
+        req.prefix_reused = reused
+        if reused:
+            m["prefix_tokens"].inc(reused)
+        if self._prefix is not None:
+            # the imported prompt blocks are as shareable as locally
+            # prefilled ones: register them so later affine requests
+            # (or handoffs) skip even the copy
+            self._prefix.register(req.prompt, seq.bids, limit_tokens=Lp)
+        req.admitted_at = time.perf_counter()
+        m["admissions"].inc()
+        first = int(h["first_token"])
+        # the first token was produced (and counted: tokens counter,
+        # TTFT observation, slo ttft verdict) on the PREFILL replica —
+        # only the lifecycle stamps carry over
+        req.first_token_at = float(h.get("first_token_at") or
+                                   time.perf_counter())
+        req.out.append(first)
+        self._active[slot] = req
+        self._pos[slot] = Lp
+        self._budget[slot] = req.max_new_tokens - 1
+        self._last_tok[slot] = first
+        self._blocks_used_peak = max(self._blocks_used_peak,
+                                     self._allocator.used_blocks)
+        self._recorder.record("serving.admit", rid=req.rid, slot=slot,
+                              prompt_len=Lp, resume=True,
+                              prefix_reused=reused,
+                              handoff_s=round(req.handoff_s, 6),
+                              blocks=len(seq.bids))
+        if (self.eos is not None and first == self.eos) \
+                or self._budget[slot] <= 0:
+            self._retire(slot)
+        return True
+
+    def export_handoff(self, rid: int) -> Dict:
+        """Package a ``"prefilled"`` request's prompt KV for transfer:
+        the exported blocks, the sampled first token, and the lifecycle
+        stamps the decode replica's timings need.  Releases the parked
+        blocks (the prefix trie keeps its own refs on the prompt's full
+        blocks, so affine repeats still hit).  The payload feeds
+        ``add_request(handoff=...)`` directly, or
+        :func:`~paddle_tpu.inference.kv_cache.serialize_handoff` for a
+        byte transport."""
+        req, seq, first = self._handoff_ready.pop(rid)
+        bs = self._block_size
+        Lp = len(req.prompt)
+        nblocks = -(-Lp // bs)
+        payload = {
+            "prompt": np.asarray(req.prompt, np.int32),
+            "tokens": int(Lp),
+            "first_token": int(first),
+            "block_size": int(bs),
+            "first_token_at": float(req.first_token_at),
+            "route_s": float(req.route_s),
+            "kv": self._pool.export_blocks(seq.bids[:nblocks]),
+        }
+        seq.release()
+        return payload
+
+    def discard_handoff(self, rid: int):
+        """Drop a parked handoff (transfer failed / replica drained);
+        tolerates an already-exported or unknown rid."""
+        ent = self._handoff_ready.pop(rid, None)
+        if ent is not None:
+            ent[1].release()
 
     def _prefill_chunk_step(self, slot: int):
         """Advance `slot`'s prefill by one fixed-width chunk.  The final
@@ -1002,8 +1216,18 @@ class ContinuousBatchingEngine:
         req.first_token_at = time.perf_counter()
         req.out.append(first)
         m["tokens"].inc()
-        if req.enqueued_at:
-            m["ttft"].observe(time.perf_counter() - req.enqueued_at)
+        origin = req.router_t0 or req.enqueued_at
+        if origin:
+            m["ttft"].observe(time.perf_counter() - origin)
+        if req.mode == "prefill_only":
+            # park the prompt blocks for the router's KV transfer: the
+            # slot frees NOW (the prefill tier keeps admitting) but the
+            # blocks stay referenced until export_handoff/discard_handoff
+            seq = self._seq[slot]
+            self._seq[slot] = None
+            self._handoff_ready[req.rid] = (req, seq, first)
+            self._retire(slot, status="prefilled")
+            return
         self._pos[slot] = Lp
         self._budget[slot] = req.max_new_tokens - 1
         self._last_tok[slot] = first
@@ -1228,9 +1452,12 @@ class ContinuousBatchingEngine:
         definition); TPOT only once there are >= 2 output tokens to
         average over."""
         ttft_target = self._slo_targets.get("ttft", 0.0)
-        if ttft_target > 0:
-            ttft = (req.first_token_at - req.enqueued_at
-                    if req.first_token_at and req.enqueued_at else None)
+        # a resumed (handed-off) request's TTFT verdict was already
+        # counted by the prefill replica at its "prefilled" retirement
+        if ttft_target > 0 and req.mode != "resume":
+            origin = req.router_t0 or req.enqueued_at
+            ttft = (req.first_token_at - origin
+                    if req.first_token_at and origin else None)
             hit = ttft is not None and ttft <= ttft_target
             self._metrics["slo"].labels(
                 kind="ttft", result="hit" if hit else "miss").inc()
@@ -1311,6 +1538,10 @@ class ContinuousBatchingEngine:
             self._bt[:] = 0
             self._seq = [None] * self.slots
             self._prefilling.clear()
+            # parked handoffs reference the replaced allocator/pool —
+            # they are gone with it (the router's transfer will fail
+            # and fall back to a fresh prefill elsewhere)
+            self._handoff_ready.clear()
         else:
             cfgm = self.model.config
             kv_shape = (self.slots, self.max_len,

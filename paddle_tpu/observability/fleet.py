@@ -630,15 +630,35 @@ class FleetAggregator:
             return total if seen else None
         return None
 
+    @staticmethod
+    def _snap_role(snap: dict) -> Optional[str]:
+        """Serving role from the engine-published
+        ``paddle_tpu_serving_replica_role`` marker gauge (value 1 on
+        the active role's series).  A host running several in-process
+        engines with different roles reads as ``mixed``."""
+        for fam in snap.get("metrics", []):
+            if fam["name"] != "paddle_tpu_serving_replica_role":
+                continue
+            roles = sorted({
+                (s.get("labels") or {}).get("role", "")
+                for s in fam.get("series", [])
+                if (s.get("value") or 0) >= 1})
+            roles = [r for r in roles if r]
+            if not roles:
+                return None
+            return roles[0] if len(roles) == 1 else "mixed"
+        return None
+
     def table(self) -> str:
         """The fleet at a glance: one row per host (step EMA, steps,
-        goodput, restarts, serving SLO attainment, staleness), plus the
-        straggler footer — hosts whose step-time EMA sits above the
-        fleet median."""
+        goodput, restarts, serving role/queue/slot occupancy, SLO
+        attainment, staleness), plus the straggler footer — hosts whose
+        step-time EMA sits above the fleet median."""
         roster = self.hosts()
         header = (f"{'host':<14} {'up':<6} {'age_s':>6} {'gen':>4} "
                   f"{'restarts':>8} {'steps':>7} {'step_ms':>8} "
-                  f"{'goodput':>8} {'slo_ttft':>8} {'slo_tpot':>8}")
+                  f"{'goodput':>8} {'role':>8} {'queue':>6} "
+                  f"{'slots':>7} {'slo_ttft':>8} {'slo_tpot':>8}")
         lines = [header, "-" * len(header)]
         emas: Dict[str, float] = {}
         for host in sorted(self._snapshots):
@@ -655,6 +675,14 @@ class FleetAggregator:
                                     labels={"kind": "ttft"})
             tpot = self._snap_value(snap, "paddle_tpu_slo_attainment",
                                     labels={"kind": "tpot"})
+            role = self._snap_role(snap)
+            queue = self._snap_value(snap,
+                                     "paddle_tpu_serving_queue_depth")
+            active = self._snap_value(snap,
+                                      "paddle_tpu_serving_active_slots")
+            slots = self._snap_value(snap, "paddle_tpu_serving_slots")
+            occupancy = (f"{active:.0f}/{slots:.0f}"
+                         if active is not None and slots else "-")
 
             def fmt(v, scale=1.0, pct=False):
                 if v is None:
@@ -667,7 +695,9 @@ class FleetAggregator:
                 f"{str(info.get('generation') or '-'):>4} "
                 f"{str(info.get('restarts') or '0'):>8} "
                 f"{fmt(steps):>7} {fmt(ema, 1e3):>8} "
-                f"{fmt(goodput):>8} {fmt(ttft, pct=True):>8} "
+                f"{fmt(goodput):>8} {(role or '-'):>8} "
+                f"{fmt(queue):>6} {occupancy:>7} "
+                f"{fmt(ttft, pct=True):>8} "
                 f"{fmt(tpot, pct=True):>8}")
         if emas:
             med = statistics.median(emas.values())

@@ -70,7 +70,7 @@ from typing import Dict, List, Optional
 __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
            "MfuDriftRule", "CompileStormRule", "StragglerRule",
-           "GoodputFloorRule",
+           "GoodputFloorRule", "SloAttainmentRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -421,6 +421,48 @@ class GoodputFloorRule(Rule):
                    if len(breaching) > 1 else ""))
 
 
+class SloAttainmentRule(Rule):
+    """Serving SLO attainment (the ``paddle_tpu_slo_attainment{kind}``
+    gauge the goodput monitor publishes, host-labeled on a fleet
+    aggregator's merged registry) below ``floor`` on any host — the
+    fleet-level "users are feeling it" signal that should add serving
+    capacity, not just page someone.  ``kind`` selects ttft or tpot;
+    the serving-fleet router's ``SloAutoscaleRule`` subclasses this to
+    spawn a replica on breach."""
+
+    def __init__(self, metric: str = "paddle_tpu_slo_attainment",
+                 kind: str = "ttft", floor: float = 0.9,
+                 name: str = "slo_attainment"):
+        self.name = name
+        self.metric = metric
+        self.kind = str(kind)
+        self.floor = float(floor)
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None or "kind" not in m.labelnames:
+            return None
+        names = m.labelnames
+        breaching: List[tuple] = []
+        for values, child in m.series():
+            labels = dict(zip(names, values))
+            if labels.get("kind") != self.kind:
+                continue
+            v = child.value()
+            if v != v:
+                continue           # NaN: no verdicts yet
+            if v < self.floor:
+                breaching.append((labels.get("host", ""), v))
+        if not breaching:
+            return None
+        host, worst = min(breaching, key=lambda kv: kv[1])
+        who = f"host {host}" if host else "this process"
+        return (f"{self.kind} SLO attainment {worst:.3f} on {who} < "
+                f"floor {self.floor:g}"
+                + (f" ({len(breaching)} hosts below floor)"
+                   if len(breaching) > 1 else ""))
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
@@ -431,6 +473,7 @@ RULE_TYPES = {
     "compile_storm": CompileStormRule,
     "straggler": StragglerRule,
     "goodput_floor": GoodputFloorRule,
+    "slo_attainment": SloAttainmentRule,
 }
 
 

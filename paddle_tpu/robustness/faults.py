@@ -37,6 +37,19 @@ point                                 site
                                       the engine must shed load through
                                       the bounded-admission path — defer,
                                       never crash)
+``router.dispatch``                   raises as the serving router hands a
+                                      request to a replica (network/RPC
+                                      failure analog; bounded retry, then
+                                      status "error")
+``router.kv_transfer``                raises inside the prefill→decode
+                                      paged-KV handoff (lost transfer;
+                                      the router must fall back to a
+                                      fresh prefill elsewhere)
+``serving.replica_kill``              declares a serving replica dead at
+                                      its next scheduling turn
+                                      (bool-style process-death analog;
+                                      the router re-queues its in-flight
+                                      requests)
 ``train.straggler_delay``             sleeps inside the timed train-step
                                       region (bool-style;
                                       ``PADDLE_TPU_STRAGGLER_DELAY_S``,

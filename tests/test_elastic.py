@@ -86,6 +86,7 @@ class TestElasticAgentHeartbeat:
 
 
 class TestElasticRestart:
+    @pytest.mark.slow  # worker-process drill; CI chaos gate runs it
     def test_kill_and_resume(self, tmp_path):
         """Killed worker -> generation relaunch -> resume from checkpoint."""
         ckpt_dir = str(tmp_path / "ckpt")
@@ -117,6 +118,7 @@ class TestElasticRestart:
         np.testing.assert_allclose(np.asarray(final["w"]),
                                    np.full((4,), 6.0, np.float32))
 
+    @pytest.mark.slow  # worker-process drill; CI chaos gate runs it
     def test_restarts_exhausted(self, tmp_path):
         script = tmp_path / "always_dies.py"
         script.write_text(textwrap.dedent("""
@@ -138,6 +140,7 @@ class TestElasticRestart:
         assert rc == 1
         assert mgr.restarts == 2  # initial + 1 retry, both failed
 
+    @pytest.mark.slow  # worker-process drill; CI chaos gate runs it
     def test_hang_detected_by_heartbeat(self, tmp_path):
         """A worker that stops heartbeating (hang) fails the generation."""
         script = tmp_path / "hangs.py"

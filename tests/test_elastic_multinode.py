@@ -55,6 +55,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # multi-node worker processes; CI gate runs it
 @pytest.mark.timeout(120)
 def test_two_nodes_one_dies_job_resumes(tmp_path):
     from paddle_tpu.distributed.elastic import free_port
@@ -127,6 +128,7 @@ def test_two_nodes_one_dies_job_resumes(tmp_path):
     assert dones and dones[-1]["world"] == 1
 
 
+@pytest.mark.slow  # multi-node worker processes; CI gate runs it
 @pytest.mark.timeout(60)
 def test_two_nodes_clean_completion(tmp_path):
     """Both nodes run to completion: agents exit 0, one generation."""

@@ -18,6 +18,11 @@ import sys
 import numpy as np
 import pytest
 
+# 2-process launch drills: wall time balloons on loaded CI
+# cores (observed 5s..100s+). Tier-2: @slow, run unfiltered
+# by the CI multi-process drill gate.
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       "mc_train_worker.py")

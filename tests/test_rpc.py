@@ -68,6 +68,7 @@ class TestRpcSingleWorld:
             rpc.rpc_sync("solo", _double, args=(1,))
 
 
+@pytest.mark.slow  # 2-process drill; CI multi-process gate runs it
 def test_two_process_rpc(tmp_path):
     """Real 2-process RPC through the launch CLI: cross-process sync,
     async fan-out, and remote-exception propagation."""
