@@ -129,6 +129,24 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
             regressions.append(
                 f"slo_attainment.{kind} {float(ca):.3f} < prev "
                 f"{float(pa):.3f} - {tolerance:.0%} tolerance")
+    # quantized serving (guarded once both artifacts ran the same
+    # quant modes): the capacity ratio must not shrink and the parity
+    # gate's token-match rate is better-higher — quantization can
+    # never silently rot quality between rounds
+    pq, cq = pd.get("quant") or {}, cd.get("quant") or {}
+    if pq and cq and pq.get("weights") == cq.get("weights") and \
+            pq.get("kv") == cq.get("kv"):
+        pr, cr = pq.get("kv_blocks_ratio"), cq.get("kv_blocks_ratio")
+        if pr and cr is not None and float(cr) < float(pr):
+            regressions.append(
+                f"quant.kv_blocks_ratio {float(cr):.2f} < prev "
+                f"{float(pr):.2f}")
+        pm, cm = pq.get("token_match_rate"), cq.get("token_match_rate")
+        if pm and cm is not None and \
+                float(cm) < float(pm) * (1.0 - tolerance):
+            regressions.append(
+                f"quant.token_match_rate {float(cm):.4f} < prev "
+                f"{float(pm):.4f} - {tolerance:.0%} tolerance")
     # fleet serving (router speedup over the in-process single-engine
     # baseline is better-higher; guarded once both artifacts ran
     # --fleet with the same replica count)

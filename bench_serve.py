@@ -53,7 +53,7 @@ def _next_serve_round(here):
     return max(rounds, default=0) + 1
 
 
-def _build_engine(model, args, paged):
+def _build_engine(model, args, paged, quant_weights="0", quant_kv="0"):
     from paddle_tpu.inference.serving import ContinuousBatchingEngine
     return ContinuousBatchingEngine(
         model, slots=args.slots, max_len=args.max_len,
@@ -62,27 +62,33 @@ def _build_engine(model, args, paged):
         paged_kv=paged,
         kv_block_size=args.block_size,
         prefill_chunk=args.chunk,
-        spec_decode=args.spec if paged else 0)
+        spec_decode=args.spec if paged else 0,
+        quant_weights=quant_weights, quant_kv=quant_kv)
 
 
-def _build_router(model, args):
+def _build_router(model, args, quant_weights="0", quant_kv="0"):
     """The fleet under test: dedicated prefill replica(s) feeding a
     decode tier that runs DEEP step fusion (--decode-sync) — legal only
     because disaggregation means prefill never interleaves there.  The
     host-dispatch amortization is the measured fleet win; --fleet-mixed
-    builds a homogeneous fleet instead (routing/spill only)."""
+    builds a homogeneous fleet instead (routing/spill only).
+    --decode-slots sizes the decode tier's slot pool independently of
+    the prefill tier (decode holds sequences for their whole decode
+    phase; prefill slots turn over per prompt)."""
     from paddle_tpu.inference.router import ServingRouter
     ek = dict(slots=args.slots, max_len=args.max_len,
               prefill_buckets=(args.max_len // 2,),
               steps_per_sync=1, paged_kv=True,
-              kv_block_size=args.block_size, prefill_chunk=args.chunk)
+              kv_block_size=args.block_size, prefill_chunk=args.chunk,
+              quant_weights=quant_weights, quant_kv=quant_kv)
+    dk = dict(steps_per_sync=args.decode_sync if not args.spec else 1,
+              spec_decode=args.spec)
+    if args.decode_slots:
+        dk["slots"] = args.decode_slots
     prefill = 0 if args.fleet_mixed else max(1, args.prefill_replicas)
     return ServingRouter(
         model, replicas=args.fleet, prefill_replicas=prefill,
-        engine_kwargs=ek,
-        decode_kwargs=dict(
-            steps_per_sync=args.decode_sync if not args.spec else 1,
-            spec_decode=args.spec),
+        engine_kwargs=ek, decode_kwargs=dk,
         warm_on_spawn=False)   # bench warms explicitly, outside timing
 
 
@@ -121,15 +127,30 @@ def _run_stats(eng, prompts, arrivals, args):
 
 
 def _workload(args, vocab):
-    """(prompts, max_new, arrival_offsets): shared system prefix + unique
-    suffixes, Poisson inter-arrival gaps at --rps."""
+    """(prompts, arrival_offsets): shared system prefix + per-request
+    tails, Poisson inter-arrival gaps at --rps.
+
+    ``--workload random`` (default): uniform-random unique suffixes —
+    the adversarial case for speculative decoding (history n-grams
+    predict nothing; accept rate ~0 at short horizons).
+    ``--workload text``: repeated-phrase tails modeling natural-language
+    redundancy (boilerplate, extraction, code) — the n-gram proposer's
+    home turf, so ``--spec`` shows a non-zero accept rate the artifact
+    records."""
     rng = np.random.default_rng(args.seed)
     shared = rng.integers(0, vocab, (args.shared_prefix,))
     prompts = []
     for _ in range(args.requests):
-        sfx = rng.integers(0, vocab,
-                           (int(rng.integers(2, args.suffix_max + 1)),))
-        prompts.append(np.concatenate([shared, sfx]).astype(np.int32))
+        if args.workload == "text":
+            phrase = rng.integers(0, vocab,
+                                  (int(rng.integers(4, 9)),))
+            reps = max(2, -(-args.suffix_max // len(phrase)))
+            tail = np.tile(phrase, reps)[:max(args.suffix_max, 8)]
+        else:
+            tail = rng.integers(0, vocab,
+                                (int(rng.integers(2,
+                                                  args.suffix_max + 1)),))
+        prompts.append(np.concatenate([shared, tail]).astype(np.int32))
     gaps = rng.exponential(1.0 / args.rps, size=args.requests)
     arrivals = np.cumsum(gaps)
     arrivals[0] = 0.0
@@ -182,6 +203,25 @@ def main(argv=None):
                     help="n-gram speculative draft length (paged only)")
     ap.add_argument("--steps-per-sync", type=int, default=1)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workload", choices=("random", "text"),
+                    default="random",
+                    help="suffix style: 'text' = repeated-phrase tails "
+                         "(speculative decoding shows real accept "
+                         "rates there)")
+    ap.add_argument("--quant-weights", default=None,
+                    choices=("int8", "fp8"),
+                    help="weight-only quantized engine (default: "
+                         "PADDLE_TPU_QUANT_WEIGHTS)")
+    ap.add_argument("--quant-kv", default=None, choices=("int8",),
+                    help="int8 paged-KV pools (default: "
+                         "PADDLE_TPU_QUANT_KV; forces --paged)")
+    ap.add_argument("--parity-floor", type=float, default=0.98,
+                    help="--check-equivalence under quantization: "
+                         "minimum greedy token-match rate vs the bf16 "
+                         "engine (hard gate)")
+    ap.add_argument("--logit-tol", type=float, default=0.10,
+                    help="max relative logit error vs bf16 the parity "
+                         "gate tolerates")
     ap.add_argument("--paged", dest="paged", action="store_true",
                     default=None, help="force paged KV on "
                     "(default: PADDLE_TPU_PAGED_KV)")
@@ -211,6 +251,11 @@ def main(argv=None):
     ap.add_argument("--decode-sync", type=int, default=4,
                     help="decode-tier steps_per_sync under "
                          "disaggregation")
+    ap.add_argument("--decode-slots", type=int, default=0,
+                    help="decode-tier slot pool size (0 = same as "
+                         "--slots; decode holds sequences far longer "
+                         "than prefill, so an asymmetric fleet sizes "
+                         "them independently)")
     args = ap.parse_args(argv)
     if args.fleet and args.fleet < 2:
         ap.error("--fleet needs >= 2 replicas")
@@ -221,7 +266,15 @@ def main(argv=None):
     from paddle_tpu.inference.kv_cache import paged_kv_enabled
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
+    # quant knobs resolve ONCE here, then ride explicitly into every
+    # engine build — the bf16 equivalence baseline must not re-read env
+    from paddle_tpu.inference.kv_cache import quant_kv_mode
+    from paddle_tpu.quantization.serving import quant_weights_mode
+    qw_mode = quant_weights_mode(args.quant_weights)
+    qkv_mode = quant_kv_mode(args.quant_kv)
     paged = paged_kv_enabled() if args.paged is None else args.paged
+    if qkv_mode:
+        paged = True            # int8 pools are a paged-engine feature
     dev = jax.devices()[0]
     pp.seed(args.seed)
     if dev.platform == "tpu":
@@ -240,7 +293,9 @@ def main(argv=None):
     prompts, arrivals = _workload(args, cfg.vocab_size)
     if args.fleet:
         paged = True            # the fleet handoff rides paged blocks
-    eng = _build_engine(model, args, paged)
+    eng = _build_engine(model, args, paged,
+                        quant_weights=qw_mode or "0",
+                        quant_kv=qkv_mode or "0")
     # explicit AOT warmup outside the timed window: compiles (or, with
     # PADDLE_TPU_COMPILE_CACHE=1, deserialize-and-loads) every serving
     # executable up front — the replica cold-start cost is a measured
@@ -266,7 +321,9 @@ def main(argv=None):
         # the fleet under test: same workload, fresh arrival clock; the
         # run above is the in-process single-engine baseline the
         # speedup/TTFT-ratio acceptance numbers divide by
-        router = _build_router(model, args)
+        router = _build_router(model, args,
+                               quant_weights=qw_mode or "0",
+                               quant_kv=qkv_mode or "0")
         for rep in router._replicas.values():
             stats = rep.engine.aot_warmup()
             warm_stats.update(stats)
@@ -343,6 +400,7 @@ def main(argv=None):
         "paged": bool(paged),
         "spec_decode": args.spec,
         "steps_per_sync": args.steps_per_sync,
+        "workload": args.workload,
         "shared_prefix": args.shared_prefix,
         "device": getattr(dev, "device_kind", dev.platform),
         "prefix_hit_tokens": reused_tokens,
@@ -391,6 +449,25 @@ def main(argv=None):
             "alloc_failures": _series(
                 "paddle_tpu_serving_kv_alloc_failures_total"),
         }
+    if qw_mode or qkv_mode:
+        # the quantized-serving capacity/accuracy ledger: blocks ratio
+        # is the tentpole's measured capacity claim (int8 pools hold
+        # itemsize-ratio more blocks at the SAME payload HBM bytes);
+        # token_match_rate / max_logit_err land here when
+        # --check-equivalence runs the parity gate below
+        base_blocks = args.slots * (-(-args.max_len // args.block_size))
+        detail["quant"] = {
+            "weights": qw_mode,
+            "kv": qkv_mode,
+            "kv_blocks_ratio": (round((eng._num_blocks - 1)
+                                      / base_blocks, 4)
+                                if paged else None),
+            "kv_pool_bytes": eng._pool.nbytes if paged else None,
+            "quant_paths": _series(
+                "paddle_tpu_quant_kernel_path_total"),
+            "token_match_rate": None,
+            "max_logit_err": None,
+        }
     result = {
         "metric": "serving_tokens_per_s",
         "value": round(tok_s, 2),
@@ -399,34 +476,88 @@ def main(argv=None):
     }
 
     if args.check_equivalence:
-        # replay sequentially through the slot-contiguous engine: paged
-        # (and routed/disaggregated) greedy decode must be
-        # token-for-token identical
+        # replay sequentially through the slot-contiguous bf16 engine.
+        # Unquantized: paged/routed greedy decode must be token-for-
+        # token IDENTICAL.  Quantized: the accuracy-parity gate — the
+        # greedy token-match rate must clear --parity-floor and the
+        # weight-quant logit error must stay under --logit-tol, so
+        # quantization can never silently rot quality.  Engines close
+        # first: the weight conversion is refcounted on the model and
+        # the baseline must see the original bf16 weights.
+        serving_eng.close()
+        if serving_eng is not eng:
+            eng.close()
         base_eng = _build_engine(model, argparse.Namespace(
             **{**vars(args), "spec": 0}), paged=False)
+        quant = bool(qw_mode or qkv_mode)
         mismatches = 0
+        matched = total = 0
         for i, rid in enumerate(rids):
             b = base_eng.add_request(prompts[i],
                                      max_new_tokens=args.max_new)
             got = base_eng.run()[b][1]
-            if got != results.get(rid):
+            ours = results.get(rid) or []
+            # greedy token-match counts up to and including the FIRST
+            # divergence per request: past it the two engines decode
+            # different contexts, so positionwise comparison would
+            # charge one flipped argmax as a fully-wrong tail.  This is
+            # P(token survives quantization | identical context) — the
+            # spec-decode-literature greedy-equivalence metric.
+            lcp = 0
+            while lcp < min(len(got), len(ours)) and \
+                    got[lcp] == ours[lcp]:
+                lcp += 1
+            diverged = lcp < max(len(got), len(ours))
+            matched += lcp
+            total += lcp + (1 if diverged else 0)
+            if got != ours:
                 mismatches += 1
-                print(f"EQUIVALENCE MISMATCH req {i}: paged="
-                      f"{results.get(rid)} baseline={got}",
-                      file=sys.stderr)
+                if not quant:
+                    print(f"EQUIVALENCE MISMATCH req {i}: paged="
+                          f"{ours} baseline={got}", file=sys.stderr)
+        match_rate = matched / total if total else 0.0
         result["detail"]["equivalence"] = {
-            "checked": len(rids), "mismatches": mismatches}
+            "checked": len(rids), "mismatches": mismatches,
+            "token_match_rate": round(match_rate, 4)}
         if paged and args.shared_prefix >= 2 * args.block_size and \
                 reused_tokens < 1:
             print("EQUIVALENCE: expected >=1 prefix-cache hit on the "
                   "shared-prompt workload, saw none", file=sys.stderr)
             mismatches += 1
-        if mismatches:
+        if quant:
+            q = result["detail"]["quant"]
+            q["token_match_rate"] = round(match_rate, 4)
+            failed = match_rate < args.parity_floor
+            if qw_mode:
+                from paddle_tpu.quantization.serving import \
+                    parity_report
+                rep = parity_report(model, qw_mode,
+                                    prompts[0][None, :])
+                q["max_logit_err"] = round(rep["max_logit_err"], 6)
+                q["rel_logit_err"] = round(rep["rel_logit_err"], 6)
+                if rep["rel_logit_err"] > args.logit_tol:
+                    failed = True
+                    print(f"PARITY: rel logit error "
+                          f"{rep['rel_logit_err']:.4f} exceeds "
+                          f"--logit-tol {args.logit_tol}",
+                          file=sys.stderr)
+            if failed or match_rate < args.parity_floor:
+                print(f"PARITY GATE FAILED: token_match_rate="
+                      f"{match_rate:.4f} (floor {args.parity_floor})",
+                      file=sys.stderr)
+                print(json.dumps(result))
+                return 1
+            print(f"parity ok: {len(rids)} requests, token_match_rate="
+                  f"{match_rate:.4f} >= {args.parity_floor}, "
+                  f"logit_err={q.get('rel_logit_err')}",
+                  file=sys.stderr)
+        elif mismatches:
             print(json.dumps(result))
             return 1
-        print(f"equivalence ok: {len(rids)} requests, paged == "
-              f"baseline, prefix_hit_tokens={reused_tokens}",
-              file=sys.stderr)
+        else:
+            print(f"equivalence ok: {len(rids)} requests, paged == "
+                  f"baseline, prefix_hit_tokens={reused_tokens}",
+                  file=sys.stderr)
 
     print(json.dumps(result))
 

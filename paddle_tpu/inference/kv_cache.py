@@ -54,7 +54,8 @@ import jax.numpy as jnp
 
 __all__ = ["BlockAllocator", "SequenceBlocks", "PrefixCache",
            "PagedKVPool", "PagedCache", "paged_cache_attention",
-           "paged_kv_enabled", "serialize_handoff", "deserialize_handoff"]
+           "paged_kv_enabled", "quant_kv_mode", "serialize_handoff",
+           "deserialize_handoff"]
 
 
 def paged_kv_enabled(default: bool = False) -> bool:
@@ -65,6 +66,40 @@ def paged_kv_enabled(default: bool = False) -> bool:
     if raw is None:
         return default
     return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def quant_kv_mode(explicit: Optional[str] = None) -> Optional[str]:
+    """The ``PADDLE_TPU_QUANT_KV`` knob (explicit ctor value wins):
+    ``"int8"`` stores the paged K/V pools as int8 with per-block fp32
+    scale arrays — at fixed pool HBM bytes that is 2x the blocks of a
+    bf16 pool (4x vs fp32), directly raising ``kv_blocks_total`` and
+    concurrent sessions.  None/unset/0 keeps the fp pools exactly as
+    before."""
+    raw = explicit if explicit is not None \
+        else os.environ.get("PADDLE_TPU_QUANT_KV")
+    if raw is None:
+        return None
+    raw = str(raw).strip().lower()
+    if raw in ("", "0", "off", "none", "false"):
+        return None
+    if raw != "int8":
+        raise ValueError(
+            f"PADDLE_TPU_QUANT_KV={raw!r}: only int8 is supported "
+            "(or unset/0 for fp pools)")
+    return raw
+
+
+def _quantize_kv(x):
+    """Symmetric int8 quantization of a K/V tensor along head_dim: one
+    fp32 scale per (token, kv-head) row.  The scales live in
+    block-shaped ``[num_blocks, block_size, kv_heads]`` arrays so they
+    scatter/gather/export by the SAME physical block ids as the data —
+    'per-block scales' that ride every handoff."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127,
+                 127).astype(jnp.int8)
+    return q, scale
 
 
 # -- host-side block bookkeeping ---------------------------------------------
@@ -328,22 +363,44 @@ class PrefixCache:
 class PagedKVPool:
     """Per-layer ``[num_blocks, block_size, kv_heads, head_dim]`` k/v
     pools.  One physical block id addresses the same slice in every
-    layer, so host bookkeeping is per-token-block, not per-layer."""
+    layer, so host bookkeeping is per-token-block, not per-layer.
+
+    ``quant="int8"`` stores the pools as int8 plus per-layer
+    ``[num_blocks, block_size, kv_heads]`` fp32 scale arrays (one scale
+    per token row per kv head, block-shaped so scales follow the same
+    block ids through COW copies, exports and handoffs).  Quantization
+    is fused into the block scatter and dequantization into the
+    attention read (``paged_cache_attention`` / the Pallas paged-decode
+    kernel's block loads) — the fp K/V never exist pool-shaped."""
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
-                 kv_heads: int, head_dim: int, dtype):
+                 kv_heads: int, head_dim: int, dtype,
+                 quant: Optional[str] = None):
+        if quant not in (None, "int8"):
+            raise ValueError(f"PagedKVPool quant={quant!r}: only int8")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.quant = quant
+        self.compute_dtype = dtype
+        store = jnp.int8 if quant == "int8" else dtype
         shape = (num_blocks, block_size, kv_heads, head_dim)
-        self.kpools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.vpools = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.kpools = [jnp.zeros(shape, store) for _ in range(num_layers)]
+        self.vpools = [jnp.zeros(shape, store) for _ in range(num_layers)]
+        if quant:
+            sshape = (num_blocks, block_size, kv_heads)
+            self.kscales = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+            self.vscales = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(num_layers)]
+        else:
+            self.kscales, self.vscales = [], []
         self._copy = jax.jit(
             lambda pool, src, dst: pool.at[dst].set(pool[src]),
             donate_argnums=(0,))
         # block export/import (cross-replica KV handoff): one compiled
-        # gather / scatter covers every layer's k AND v pool, so a
-        # prefill->decode transfer costs two device dispatches, not
-        # 4 * num_layers
+        # gather / scatter covers every layer's k AND v pool (and the
+        # scale arrays, when quantized), so a prefill->decode transfer
+        # costs two device dispatches, not 4 * num_layers
         self._gather = jax.jit(lambda pools, idx: [p[idx] for p in pools])
         self._scatter = jax.jit(
             lambda pools, idx, vals: [p.at[idx].set(v.astype(p.dtype))
@@ -351,13 +408,27 @@ class PagedKVPool:
             donate_argnums=(0,))
         self.cow_copies = 0
 
+    @property
+    def nbytes(self) -> int:
+        """Device bytes held by the pools: K/V payload + scale arrays
+        (the ``paddle_tpu_serving_kv_pool_bytes`` gauge)."""
+        return sum(int(p.nbytes) for p in
+                   self.kpools + self.vpools + self.kscales + self.vscales)
+
+    def _all_pools(self):
+        return self.kpools + self.vpools + self.kscales + self.vscales
+
     def copy_block(self, src: int, dst: int):
         """Device-side COW body: duplicate physical block `src` into
-        `dst` across every layer's k and v pool."""
+        `dst` across every layer's k and v pool (scales included when
+        quantized — a copied block keeps its dequant factors)."""
         s = jnp.asarray(src, jnp.int32)
         d = jnp.asarray(dst, jnp.int32)
         self.kpools = [self._copy(p, s, d) for p in self.kpools]
         self.vpools = [self._copy(p, s, d) for p in self.vpools]
+        if self.quant:
+            self.kscales = [self._copy(p, s, d) for p in self.kscales]
+            self.vscales = [self._copy(p, s, d) for p in self.vscales]
         self.cow_copies += 1
 
     def reset(self):
@@ -366,6 +437,12 @@ class PagedKVPool:
         n = len(self.kpools)
         self.kpools = [jnp.zeros(shape, dtype) for _ in range(n)]
         self.vpools = [jnp.zeros(shape, dtype) for _ in range(n)]
+        if self.quant:
+            sshape = self.kscales[0].shape
+            self.kscales = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(n)]
+            self.vscales = [jnp.zeros(sshape, jnp.float32)
+                            for _ in range(n)]
 
     # -- cross-replica block transfer (prefill/decode disaggregation) --------
     @staticmethod
@@ -379,20 +456,30 @@ class PagedKVPool:
     def export_blocks(self, bids: Sequence[int]) -> dict:
         """Read physical blocks `bids` out of every layer's k/v pool as
         host arrays — the payload side of a prefill→decode KV handoff.
-        Layout: ``{"block_size", "k": [L x [n, bs, kvh, hd]], "v": [...]}``
-        with blocks ordered as `bids` (logical order for a sequence's
-        prompt).  Pure read: the pools are untouched.  The device
-        gather runs at the padded bucket size (pad ids = scratch block
-        0), but the returned arrays are trimmed to the real count so
-        the wire payload carries no padding."""
+        Layout: ``{"block_size", "dtype", "k": [L x [n, bs, kvh, hd]],
+        "v": [...]}`` plus ``"k_scale"/"v_scale"`` (``[n, bs, kvh]``
+        fp32 per layer) when the pool is quantized — a quantized
+        handoff ships the int8 payload + scales on the wire (half the
+        bf16 bytes).  Blocks are ordered as `bids` (logical order for a
+        sequence's prompt).  Pure read: the pools are untouched.  The
+        device gather runs at the padded bucket size (pad ids = scratch
+        block 0), but the returned arrays are trimmed to the real count
+        so the wire payload carries no padding."""
         bids = list(bids)
         n = len(bids)
         idx = jnp.asarray(bids + [0] * (self._bucket(n) - n), jnp.int32)
-        outs = self._gather(self.kpools + self.vpools, idx)
+        outs = self._gather(self._all_pools(), idx)
         L = len(self.kpools)
-        return {"block_size": int(self.block_size),
-                "k": [np.asarray(o)[:n] for o in outs[:L]],
-                "v": [np.asarray(o)[:n] for o in outs[L:]]}
+        payload = {"block_size": int(self.block_size),
+                   "dtype": str(jnp.dtype(self.kpools[0].dtype)),
+                   "k": [np.asarray(o)[:n] for o in outs[:L]],
+                   "v": [np.asarray(o)[:n] for o in outs[L:2 * L]]}
+        if self.quant:
+            payload["k_scale"] = [np.asarray(o)[:n]
+                                  for o in outs[2 * L:3 * L]]
+            payload["v_scale"] = [np.asarray(o)[:n]
+                                  for o in outs[3 * L:]]
+        return payload
 
     def import_blocks(self, payload: dict, dst_bids: Sequence[int],
                       src_start: int = 0):
@@ -402,7 +489,14 @@ class PagedKVPool:
         prefix cache already holds the leading blocks imports only the
         tail.  Pad writes land in the scratch block (never observable).
         Raises on geometry mismatch (block size / kv heads / head dim /
-        layer count must agree across the fleet)."""
+        layer count must agree across the fleet).
+
+        Mixed-precision fleets convert at the boundary: an fp payload
+        into a quantized pool is quantized on import (same rowwise
+        scheme as the write path), a quantized payload into an fp pool
+        is dequantized via its shipped scales.  A quantized payload
+        WITHOUT scales is rejected loudly — a wire format that lost its
+        scales can only produce garbage KV."""
         dst_bids = list(dst_bids)
         if not dst_bids:
             return
@@ -422,6 +516,12 @@ class PagedKVPool:
                 f"import of {len(dst_bids)} blocks from offset "
                 f"{src_start} exceeds payload of "
                 f"{payload['k'][0].shape[0]} blocks")
+        src_quant = payload["k"][0].dtype == np.int8
+        if src_quant and ("k_scale" not in payload
+                          or "v_scale" not in payload):
+            raise ValueError(
+                "quantized handoff payload carries no k_scale/v_scale "
+                "— refusing to import scaleless int8 KV")
         n = len(dst_bids)
         pad = self._bucket(n) - n
         sel = slice(src_start, src_start + n)
@@ -434,9 +534,47 @@ class PagedKVPool:
                     [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
             return jnp.asarray(a)
 
-        vals = [prep(a) for a in list(payload["k"]) + list(payload["v"])]
-        pools = self._scatter(self.kpools + self.vpools, idx, vals)
-        self.kpools, self.vpools = pools[:L], pools[L:]
+        ks = [payload[f] for f in ("k", "v")]
+        kdata, vdata = ks
+        kscale = payload.get("k_scale")
+        vscale = payload.get("v_scale")
+        if src_quant and not self.quant:
+            # dequantize at the boundary: fp pool receives fp values
+            kdata = [np.asarray(d, np.float32)
+                     * np.asarray(s, np.float32)[..., None]
+                     for d, s in zip(kdata, kscale)]
+            vdata = [np.asarray(d, np.float32)
+                     * np.asarray(s, np.float32)[..., None]
+                     for d, s in zip(vdata, vscale)]
+            kscale = vscale = None
+        elif not src_quant and self.quant:
+            # quantize at the boundary: same rowwise scheme as the
+            # fused write-path quantization
+            def q(arrs):
+                outs, scales = [], []
+                for a in arrs:
+                    qa, sa = _quantize_kv(jnp.asarray(
+                        np.asarray(a, np.float32)))
+                    outs.append(np.asarray(qa))
+                    scales.append(np.asarray(sa))
+                return outs, scales
+            kdata, kscale = q(kdata)
+            vdata, vscale = q(vdata)
+        vals = [prep(a) for a in list(kdata) + list(vdata)]
+        pools = list(self.kpools) + list(self.vpools)
+        if self.quant:
+            sw = self.kscales[0].shape[1:]
+            sg = tuple(np.asarray(kscale[0]).shape[1:])
+            if sg != sw:
+                raise ValueError(
+                    f"handoff scale geometry {sg} != pool {sw}")
+            vals += [prep(a) for a in list(kscale) + list(vscale)]
+            pools += list(self.kscales) + list(self.vscales)
+        out = self._scatter(pools, idx, vals)
+        self.kpools, self.vpools = out[:L], out[L:2 * L]
+        if self.quant:
+            self.kscales = out[2 * L:3 * L]
+            self.vscales = out[3 * L:]
 
     def warm_transfer(self, max_blocks: int):
         """Compile the export/import executables for every pow-2 bucket
@@ -465,9 +603,15 @@ def serialize_handoff(payload: dict) -> bytes:
     ``kv`` block export) into one length-prefixed bytes blob that rides
     any byte transport — the TCPStore for a multi-process fleet, shared
     memory in-process.  Arrays are raw little-endian buffers with dtype
-    recorded by name (bfloat16 survives; no pickle anywhere)."""
+    recorded by name (bfloat16 survives; no pickle anywhere).
+
+    Wire format v2: quantized KV exports additionally carry per-layer
+    ``kv.ks<i>/kv.vs<i>`` scale arrays and a ``kv_dtype`` scalar, so a
+    fleet prefill→decode handoff stays int8 on the wire (half the bf16
+    payload bytes).  v1 readers never see these keys on fp payloads;
+    this reader accepts both."""
     import json as _json
-    meta: dict = {"scalars": {}, "arrays": []}
+    meta: dict = {"version": 2, "scalars": {}, "arrays": []}
     chunks: List[bytes] = []
 
     def add_array(name, a):
@@ -480,10 +624,16 @@ def serialize_handoff(payload: dict) -> bytes:
         if key == "kv":
             meta["scalars"]["kv_block_size"] = int(val["block_size"])
             meta["kv_layers"] = len(val["k"])
+            if "dtype" in val:
+                meta["scalars"]["kv_dtype"] = str(val["dtype"])
             for i, a in enumerate(val["k"]):
                 add_array(f"kv.k{i}", a)
             for i, a in enumerate(val["v"]):
                 add_array(f"kv.v{i}", a)
+            for i, a in enumerate(val.get("k_scale") or ()):
+                add_array(f"kv.ks{i}", a)
+            for i, a in enumerate(val.get("v_scale") or ()):
+                add_array(f"kv.vs{i}", a)
         elif isinstance(val, np.ndarray):
             add_array(key, val)
         else:
@@ -493,7 +643,7 @@ def serialize_handoff(payload: dict) -> bytes:
 
 
 def deserialize_handoff(data: bytes) -> dict:
-    """Inverse of :func:`serialize_handoff`."""
+    """Inverse of :func:`serialize_handoff` (v1 and v2 payloads)."""
     import json as _json
     hlen = int.from_bytes(data[:8], "big")
     meta = _json.loads(data[8:8 + hlen].decode())
@@ -506,7 +656,7 @@ def deserialize_handoff(data: bytes) -> dict:
             data[off:off + n], dtype=dt).reshape(ent["shape"])
         off += n
     out: dict = {k: v for k, v in meta["scalars"].items()
-                 if k != "kv_block_size"}
+                 if k not in ("kv_block_size", "kv_dtype")}
     for name, a in arrays.items():
         if not name.startswith("kv."):
             out[name] = a
@@ -517,6 +667,13 @@ def deserialize_handoff(data: bytes) -> dict:
             "k": [arrays[f"kv.k{i}"] for i in range(L)],
             "v": [arrays[f"kv.v{i}"] for i in range(L)],
         }
+        if "kv_dtype" in meta["scalars"]:
+            out["kv"]["dtype"] = meta["scalars"]["kv_dtype"]
+        if f"kv.ks{0}" in arrays:
+            out["kv"]["k_scale"] = [arrays[f"kv.ks{i}"]
+                                    for i in range(L)]
+            out["kv"]["v_scale"] = [arrays[f"kv.vs{i}"]
+                                    for i in range(L)]
     return out
 
 
@@ -540,10 +697,15 @@ def fetch_handoff(store, key: str) -> Optional[dict]:
 class PagedCache(NamedTuple):
     """One layer's paged KV view: the physical pools plus this batch's
     block table ``[B, max_blocks]`` (logical block index → physical
-    block id; unallocated entries point at scratch block 0)."""
+    block id; unallocated entries point at scratch block 0).  Quantized
+    pools (int8) additionally carry the per-block scale arrays; fp
+    pools leave them None (the default keeps every existing
+    3-argument constructor working)."""
     k: object                   # [num_blocks, block_size, kv_heads, hd]
     v: object
     block_table: object         # [B, max_blocks] int32
+    k_scale: object = None      # [num_blocks, block_size, kv_heads] f32
+    v_scale: object = None
 
 
 def paged_cache_attention(q, k, v, cache: PagedCache, position_offset,
@@ -589,10 +751,25 @@ def paged_cache_attention(q, k, v, cache: PagedCache, position_offset,
                                axis=1)                            # [B, S]
     bids = jnp.where(lb < mb, bids, 0)
     slot = qpos % bs
-    kp = kp.at[bids, slot].set(unwrap(k).astype(kp.dtype))
-    vp = vp.at[bids, slot].set(unwrap(v).astype(vp.dtype))
-    new_cache = PagedCache(wrap_like(kp), wrap_like(vp),
-                           cache.block_table)
+    quant = cache.k_scale is not None
+    if quant:
+        # quantization fused into the block scatter: the step's fp K/V
+        # become int8 rows + per-(token, kv-head) scales in one shot;
+        # the fp values never exist pool-shaped
+        kq, ks_new = _quantize_kv(unwrap(k))
+        vq, vs_new = _quantize_kv(unwrap(v))
+        ksc = unwrap(cache.k_scale).at[bids, slot].set(ks_new)
+        vsc = unwrap(cache.v_scale).at[bids, slot].set(vs_new)
+        kp = kp.at[bids, slot].set(kq)
+        vp = vp.at[bids, slot].set(vq)
+        new_cache = PagedCache(wrap_like(kp), wrap_like(vp),
+                               cache.block_table, wrap_like(ksc),
+                               wrap_like(vsc))
+    else:
+        kp = kp.at[bids, slot].set(unwrap(k).astype(kp.dtype))
+        vp = vp.at[bids, slot].set(unwrap(v).astype(vp.dtype))
+        new_cache = PagedCache(wrap_like(kp), wrap_like(vp),
+                               cache.block_table)
 
     from paddle_tpu.ops.pallas import paged_attention as PA
     uq = unwrap(q)
@@ -600,13 +777,28 @@ def paged_cache_attention(q, k, v, cache: PagedCache, position_offset,
             PA.paged_decode_eligible(kp.shape[-1], bs, uq.dtype):
         PA.record_path("pallas")
         lengths = qpos[:, 0] + 1
-        out = PA.paged_decode_attention(uq[:, 0], kp, vp, bt, lengths)
+        if quant:
+            out = PA.paged_decode_attention(uq[:, 0], kp, vp, bt,
+                                            lengths, k_scale=ksc,
+                                            v_scale=vsc)
+        else:
+            out = PA.paged_decode_attention(uq[:, 0], kp, vp, bt,
+                                            lengths)
         return wrap_like(out[:, None]), new_cache
     PA.record_path("fallback")
 
     # gather the block table back into logical order: [B, mb*bs, kvh, hd]
-    kb = jnp.reshape(kp[bt], (B, mb * bs) + kp.shape[2:])
-    vb = jnp.reshape(vp[bt], (B, mb * bs) + vp.shape[2:])
+    if quant:
+        # dequantization fused into the gather read: int8 blocks widen
+        # through their scales straight into the compute dtype
+        kb = (kp[bt].astype(jnp.float32)
+              * ksc[bt][..., None]).astype(uq.dtype)
+        vb = (vp[bt].astype(jnp.float32)
+              * vsc[bt][..., None]).astype(uq.dtype)
+    else:
+        kb, vb = kp[bt], vp[bt]
+    kb = jnp.reshape(kb, (B, mb * bs) + kp.shape[2:])
+    vb = jnp.reshape(vb, (B, mb * bs) + vp.shape[2:])
     kpos = jnp.arange(mb * bs)
     mask = kpos[None, None, None, :] <= qpos[:, None, :, None]  # [B,1,S,T]
     if attn_mask is not None:

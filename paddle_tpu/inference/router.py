@@ -80,7 +80,8 @@ import numpy as np
 from paddle_tpu.observability.watchdog import SloAttainmentRule
 
 __all__ = ["ServingRouter", "SloAutoscaler", "SloAutoscaleRule",
-           "fleet_serve_replicas"]
+           "fleet_serve_replicas", "ReplicaWorker", "submit_request",
+           "fetch_result", "main"]
 
 
 def fleet_serve_replicas(default: int = 0) -> int:
@@ -969,3 +970,192 @@ class SloAutoscaleRule(SloAttainmentRule):
         rid = self._router.scale_up()
         self._last_scale = now
         return detail + f" -> spawned replica {rid}"
+
+
+# -- multi-process worker loop ------------------------------------------------
+#
+# The in-process ServingRouter above IS the scheduler; what a multi-
+# process fleet additionally needs is a driveable replica: one engine
+# per process, bound to a TCPStore-contract store, consuming requests
+# and publishing results/handoffs as serialize_handoff bytes.  This is
+# that minimal worker loop — `python -m paddle_tpu.inference.router
+# --store host:port --role decode|prefill` runs it against a real
+# TCPStore; the unit tests drive the same class over an in-process
+# LocalStore (observability.fleet), so the protocol is exercised
+# without sockets.
+#
+# Store key protocol (all values are serialize_handoff blobs except the
+# plain-int counters):
+#   serve/worker/<id>             announce: json {role, pid, slots}
+#   serve/<id>/seq                add()-counter a client bumps per request
+#   serve/<id>/req/<seq>          request payload {prompt, max_new_tokens}
+#                                 (+ a full handoff payload for resume)
+#   serve/<id>/out/<seq>          result {tokens, status}, or the parked
+#                                 prompt-KV handoff from a prefill worker
+#   serve/<id>/stop               any value: drain and exit
+
+class ReplicaWorker:
+    """One serving engine bound to a store — the multi-process fleet's
+    replica side.  ``poll()`` is one scheduling pass (drain inbox,
+    one engine step, publish retirements); ``serve_forever()`` loops it
+    until the stop key appears."""
+
+    def __init__(self, store, engine, role: str = "mixed",
+                 worker_id: Optional[str] = None):
+        import json as _json
+        self.store = store
+        self.engine = engine
+        self.role = role
+        self.worker_id = worker_id or f"{role}{os.getpid()}"
+        self._next_seq = 1
+        self._seq_of: Dict[int, int] = {}
+        self.served = 0
+        store.set(f"serve/worker/{self.worker_id}", _json.dumps(
+            {"role": role, "pid": os.getpid(),
+             "slots": getattr(engine, "slots", 0)}))
+
+    def _drain_inbox(self):
+        from paddle_tpu.inference.kv_cache import fetch_handoff
+        while True:
+            key = f"serve/{self.worker_id}/req/{self._next_seq}"
+            payload = fetch_handoff(self.store, key)
+            if payload is None:
+                return
+            prompt = np.asarray(payload["prompt"], np.int32)
+            kwargs = {}
+            if self.role == "prefill":
+                kwargs["prefill_only"] = True
+            elif "kv" in payload:
+                kwargs["handoff"] = payload     # resume a prefilled req
+            rid = self.engine.add_request(
+                prompt, max_new_tokens=int(payload["max_new_tokens"]),
+                **kwargs)
+            self._seq_of[rid] = self._next_seq
+            self._next_seq += 1
+
+    def poll(self) -> bool:
+        """One pass; True while the engine still has work."""
+        from paddle_tpu.inference.kv_cache import publish_handoff
+        self._drain_inbox()
+        if self.engine.pending:
+            self.engine.step()
+        for rid, _prompt, out in self.engine.finished():
+            seq = self._seq_of.pop(rid, None)
+            if seq is None:
+                continue
+            st = self.engine.request_status(rid)
+            okey = f"serve/{self.worker_id}/out/{seq}"
+            if str(st) == "prefilled":
+                # the parked prompt KV goes on the wire; a decode
+                # worker (or the router) resumes from it
+                payload = self.engine.export_handoff(rid)
+                payload["max_new_tokens"] = 0
+                publish_handoff(self.store, okey, payload)
+            else:
+                publish_handoff(self.store, okey, {
+                    "tokens": np.asarray(out, np.int32),
+                    "status": str(st) if st is not None else "ok"})
+            self.served += 1
+        return self.engine.pending > 0
+
+    def should_stop(self) -> bool:
+        return self.store.check(f"serve/{self.worker_id}/stop")
+
+    def serve_forever(self, poll_interval_s: float = 0.005,
+                      max_steps: Optional[int] = None) -> int:
+        """Loop until the stop key (drains in-flight first).  Returns
+        requests served.  ``max_steps`` bounds the loop for tests."""
+        steps = 0
+        while max_steps is None or steps < max_steps:
+            steps += 1
+            busy = self.poll()
+            if self.should_stop() and not self.engine.pending:
+                break
+            if not busy:
+                time.sleep(poll_interval_s)
+        return self.served
+
+
+def submit_request(store, worker_id: str, prompt, max_new_tokens: int,
+                   handoff: Optional[dict] = None) -> int:
+    """Client side: enqueue one request to a worker; returns the seq to
+    pass to :func:`fetch_result`.  ``handoff`` resumes a prefill
+    worker's exported payload on a decode worker."""
+    from paddle_tpu.inference.kv_cache import publish_handoff
+    seq = int(store.add(f"serve/{worker_id}/seq", 1))
+    payload = dict(handoff) if handoff is not None else {}
+    payload["prompt"] = np.asarray(prompt, np.int32)
+    payload["max_new_tokens"] = int(max_new_tokens)
+    publish_handoff(store, f"serve/{worker_id}/req/{seq}", payload)
+    return seq
+
+
+def fetch_result(store, worker_id: str, seq: int) -> Optional[dict]:
+    """Result of :func:`submit_request` (None while pending): ``{tokens,
+    status}``, or a prompt-KV handoff payload from a prefill worker."""
+    from paddle_tpu.inference.kv_cache import fetch_handoff
+    return fetch_handoff(store, f"serve/{worker_id}/out/{seq}")
+
+
+def _build_worker_engine(args):
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    import paddle_tpu as pp
+    pp.seed(args.seed)
+    if args.model != "tiny":
+        raise SystemExit(f"--model {args.model!r}: only the built-in "
+                         "'tiny' config is wired (load real weights via "
+                         "the compile_cache bundle path)")
+    cfg = LlamaConfig.tiny(
+        max_position_embeddings=max(2 * args.max_len, 128))
+    model = LlamaForCausalLM(cfg)
+    return ContinuousBatchingEngine(
+        model, slots=args.slots, max_len=args.max_len,
+        prefill_buckets=(args.max_len // 2,), paged_kv=True,
+        kv_block_size=args.block_size, prefill_chunk=args.chunk,
+        role=args.role if args.role in ("prefill", "decode") else "mixed")
+
+
+def main(argv=None) -> int:
+    """``python -m paddle_tpu.inference.router --store host:port --role
+    decode|prefill`` — bind one replica worker to a fleet store."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.inference.router",
+        description="Serving replica worker: one ContinuousBatching"
+                    "Engine consuming requests from (and publishing "
+                    "results/KV handoffs to) a TCPStore.")
+    ap.add_argument("--store", required=True,
+                    help="TCPStore address host:port (the master is "
+                         "started elsewhere, e.g. by the router host)")
+    ap.add_argument("--role", default="decode",
+                    choices=("decode", "prefill", "mixed"))
+    ap.add_argument("--worker-id", default=None)
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-steps", type=int, default=None,
+                    help="bound the worker loop (smoke tests)")
+    args = ap.parse_args(argv)
+
+    import sys
+    host, _, port = args.store.rpartition(":")
+    from paddle_tpu.distributed.tcp_store import TCPStore
+    store = TCPStore(host or "127.0.0.1", int(port), is_master=False)
+    engine = _build_worker_engine(args)
+    worker = ReplicaWorker(store, engine, role=args.role,
+                           worker_id=args.worker_id)
+    print(f"replica worker {worker.worker_id} ({args.role}) bound to "
+          f"{args.store}", file=sys.stderr)
+    served = worker.serve_forever(max_steps=args.max_steps)
+    print(f"worker {worker.worker_id} exiting after {served} requests",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

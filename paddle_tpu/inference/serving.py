@@ -303,7 +303,9 @@ class ContinuousBatchingEngine:
                  prefix_cache: bool = True,
                  spec_decode: int = 0,
                  spec_ngram: int = 3,
-                 role: str = "mixed"):
+                 role: str = "mixed",
+                 quant_weights: Optional[str] = None,
+                 quant_kv: Optional[str] = None):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -322,9 +324,19 @@ class ContinuousBatchingEngine:
         # chunked prefill + optional n-gram speculative decoding.  The
         # knob default is OFF: =0 (or unset) keeps the exact previous
         # slot-contiguous engine.
-        from paddle_tpu.inference.kv_cache import paged_kv_enabled
+        from paddle_tpu.inference.kv_cache import (paged_kv_enabled,
+                                                   quant_kv_mode)
         self.paged = paged_kv_enabled() if paged_kv is None \
             else bool(paged_kv)
+        # quantized paged-KV (PADDLE_TPU_QUANT_KV=int8 / quant_kv=):
+        # int8 pools + per-block scales — the pool holds itemsize-ratio
+        # MORE blocks at the same payload HBM bytes (2x for bf16, 4x
+        # for fp32), which is the capacity claim BENCH_serve records
+        self.kv_quant = quant_kv_mode(quant_kv)
+        if self.kv_quant and not self.paged:
+            raise ValueError(
+                "PADDLE_TPU_QUANT_KV / quant_kv= requires the paged KV "
+                "engine (PADDLE_TPU_PAGED_KV=1 or paged_kv=True)")
         self.spec_tokens = max(0, int(spec_decode))
         self._spec_ngram = max(1, int(spec_ngram))
         if self.spec_tokens:
@@ -358,8 +370,29 @@ class ContinuousBatchingEngine:
                 f"largest prefill bucket {self.buckets[-1]} must be < "
                 f"max_len {max_len} (prefill writes bucket rows into the "
                 "per-slot cache)")
+        # weight-only quantized serving (quantization.serving tentpole):
+        # PADDLE_TPU_QUANT_WEIGHTS=int8|fp8 (or quant_weights=) converts
+        # the model's large Linears to QuantedLinear IN PLACE (refcounted
+        # — a fleet shares one conversion; close() restores).  Unset
+        # keeps the exact previous engine, jaxpr-identical.
+        from paddle_tpu.quantization.serving import quant_weights_mode
+        self.quant_mode = quant_weights_mode(quant_weights)
+        self._quant_converted = False
+        if self.quant_mode:
+            if int8_weights:
+                raise ValueError(
+                    "int8_weights (the legacy param-dict path) and "
+                    "quant_weights= are mutually exclusive")
+            from paddle_tpu.quantization.serving import \
+                quantize_for_serving
+            info = quantize_for_serving(model, self.quant_mode)
+            self._quant_converted = True
+            self._quant_layers = info["layers"]
         params = params_of(model)
-        self._dtype = next(iter(params.values())).dtype
+        self._dtype = next(
+            (a.dtype for a in params.values()
+             if jnp.issubdtype(a.dtype, jnp.floating)),
+            next(iter(params.values())).dtype)
         if int8_weights:
             self._keep, self._quant = quantize_weights_int8(params)
         else:
@@ -385,16 +418,24 @@ class ContinuousBatchingEngine:
             self._max_blocks = -(-max_len // self._block_size)
             # default pool: every slot can hold a worst-case sequence,
             # plus the reserved scratch block; prefix sharing then turns
-            # the saved blocks into prefix-cache headroom
-            self._num_blocks = int(num_kv_blocks) if num_kv_blocks \
-                else 1 + slots * self._max_blocks
+            # the saved blocks into prefix-cache headroom.  An int8-
+            # quantized pool multiplies the block count by the compute
+            # dtype's itemsize — SAME payload HBM bytes, itemsize-ratio
+            # more blocks (the extra blocks become prefix-cache and
+            # concurrency headroom)
+            if num_kv_blocks:
+                self._num_blocks = int(num_kv_blocks)
+            else:
+                ratio = jnp.dtype(self._dtype).itemsize \
+                    if self.kv_quant else 1
+                self._num_blocks = 1 + ratio * slots * self._max_blocks
             self._allocator = BlockAllocator(self._num_blocks)
             self._prefix = PrefixCache(self._block_size, self._allocator) \
                 if prefix_cache else None
             self._pool = PagedKVPool(
                 cfgm.num_hidden_layers, self._num_blocks,
                 self._block_size, cfgm.num_key_value_heads,
-                cfgm.head_dim, self._dtype)
+                cfgm.head_dim, self._dtype, quant=self.kv_quant)
             # per-slot block table rows; 0 = reserved scratch block
             self._bt = np.zeros((slots, self._max_blocks), np.int32)
             self._seq: List[Optional[object]] = [None] * slots
@@ -485,6 +526,10 @@ class ContinuousBatchingEngine:
                       ).set_function(
                 lambda e=self: len(e._prefix)
                 if e._prefix is not None else 0)
+            reg.gauge("paddle_tpu_serving_kv_pool_bytes",
+                      "device bytes held by the paged KV pools "
+                      "(K/V payload + quant scale arrays)"
+                      ).set_function(lambda e=self: e._pool.nbytes)
 
         # serving traces must see eval-mode (dropout off); remembered so
         # close() / context exit can hand the model back for training
@@ -560,14 +605,27 @@ class ContinuousBatchingEngine:
         else:
             from paddle_tpu.inference.kv_cache import PagedCache
 
-            def fwd_paged(ps, ids, kpools, vpools, bt, pos):
-                cc = [PagedCache(kk, vv, bt)
-                      for kk, vv in zip(kpools, vpools)]
+            # kscales/vscales are EMPTY lists on an unquantized pool:
+            # they contribute no jaxpr inputs, so the knob-off programs
+            # are identical to the pre-quantization engine
+            def fwd_paged(ps, ids, kpools, vpools, kscales, vscales,
+                          bt, pos):
+                if kscales:
+                    cc = [PagedCache(kk, vv, bt, ks, vs)
+                          for kk, vv, ks, vs in zip(kpools, vpools,
+                                                    kscales, vscales)]
+                else:
+                    cc = [PagedCache(kk, vv, bt)
+                          for kk, vv in zip(kpools, vpools)]
                 logits, new_caches = functional_call(model, ps, ids,
                                                      None, cc, pos)
                 raw = unwrap(logits).astype(jnp.float32)
                 return raw, ([unwrap(c.k) for c in new_caches],
-                             [unwrap(c.v) for c in new_caches])
+                             [unwrap(c.v) for c in new_caches],
+                             [unwrap(c.k_scale) for c in new_caches]
+                             if kscales else [],
+                             [unwrap(c.v_scale) for c in new_caches]
+                             if kscales else [])
 
             # chunked prefill: ONE executable serves every chunk of
             # every prompt (B=1, fixed width C, per-row [1] position
@@ -575,24 +633,27 @@ class ContinuousBatchingEngine:
             # Non-final chunks ignore the sampled token; the final
             # chunk's sample at the true last prompt position is the
             # request's first generated token.
-            @_ft.partial(jax.jit, donate_argnums=(3, 4))
-            def prefill_chunk(keep, quant, ids, kpools, vpools, bt_row,
-                              start, last_idx, key):
+            @_ft.partial(jax.jit, donate_argnums=(3, 4, 5, 6))
+            def prefill_chunk(keep, quant, ids, kpools, vpools, kscales,
+                              vscales, bt_row, start, last_idx, key):
                 ps = _dequant(keep, quant, dtype)
                 logits, pools = fwd_paged(ps, ids, kpools, vpools,
-                                          bt_row, start)
+                                          kscales, vscales, bt_row,
+                                          start)
                 first = _sample(logits[0, last_idx][None], gen_cfg,
                                 key)[0]
                 return first.astype(jnp.int32), pools
 
-            def decode_paged(keep, quant, kpools, vpools, bt, toks, pos,
-                             active, key):
+            def decode_paged(keep, quant, kpools, vpools, kscales,
+                             vscales, bt, toks, pos, active, key):
                 ps = _dequant(keep, quant, dtype)
 
                 def one(carry, _):
-                    kpools, vpools, toks, pos, key = carry
-                    logits, (kpools, vpools) = fwd_paged(
-                        ps, toks[:, None], kpools, vpools, bt, pos)
+                    kpools, vpools, kscales, vscales, toks, pos, key = \
+                        carry
+                    logits, (kpools, vpools, kscales, vscales) = \
+                        fwd_paged(ps, toks[:, None], kpools, vpools,
+                                  kscales, vscales, bt, pos)
                     key, sub = jax.random.split(key)
                     nxt = _sample(logits[:, -1], gen_cfg,
                                   sub).astype(jnp.int32)
@@ -601,33 +662,36 @@ class ContinuousBatchingEngine:
                     # reserved scratch block
                     nxt = jnp.where(active, nxt, toks)
                     pos = jnp.where(active, pos + 1, pos)
-                    return (kpools, vpools, nxt, pos, key), nxt
+                    return (kpools, vpools, kscales, vscales, nxt, pos,
+                            key), nxt
 
-                (kpools, vpools, _, _, _), seq = jax.lax.scan(
-                    one, (kpools, vpools, toks, pos, key), None,
-                    length=K)
-                return jnp.swapaxes(seq, 0, 1), kpools, vpools
+                (kpools, vpools, kscales, vscales, _, _, _), seq = \
+                    jax.lax.scan(
+                        one, (kpools, vpools, kscales, vscales, toks,
+                              pos, key), None, length=K)
+                return (jnp.swapaxes(seq, 0, 1), kpools, vpools,
+                        kscales, vscales)
 
             # speculative verify: ONE batched forward over
             # [last_token, draft_1..draft_k] per row; argmax at every
             # position is exactly what step-by-step greedy would emit,
             # so the host can accept the longest matching draft prefix
             # plus one bonus token with zero output drift
-            def spec_verify(keep, quant, kpools, vpools, bt, toks, pos,
-                            active):
+            def spec_verify(keep, quant, kpools, vpools, kscales,
+                            vscales, bt, toks, pos, active):
                 ps = _dequant(keep, quant, dtype)
-                logits, (kpools, vpools) = fwd_paged(
-                    ps, toks, kpools, vpools, bt, pos)
+                logits, (kpools, vpools, kscales, vscales) = fwd_paged(
+                    ps, toks, kpools, vpools, kscales, vscales, bt, pos)
                 return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
-                        kpools, vpools)
+                        kpools, vpools, kscales, vscales)
 
             self._prefill_chunk_fn = prefill_chunk
             # raw (unjitted) decode kept for program analysis
             self._decode_paged_raw = decode_paged
             self._decode_paged = jax.jit(decode_paged,
-                                         donate_argnums=(2, 3))
+                                         donate_argnums=(2, 3, 4, 5))
             self._spec_verify = jax.jit(spec_verify,
-                                        donate_argnums=(2, 3))
+                                        donate_argnums=(2, 3, 4, 5))
             self._prefill_chunk_compiled = None
             self._spec_verify_compiled = None
         # AOT executables from aot_warmup(): decode + prefill
@@ -656,7 +720,9 @@ class ContinuousBatchingEngine:
                 f"|gc={gc.do_sample}:{gc.temperature}:{gc.top_k}"
                 f":{gc.top_p}|K={self.steps_per_sync}"
                 f"|int8={int(self.int8)}|paged={int(self.paged)}"
-                f"|spec={self.spec_tokens}")
+                f"|spec={self.spec_tokens}"
+                f"|qw={self.quant_mode or '-'}"
+                f"|qkv={self.kv_quant or '-'}")
 
     def aot_warmup(self, buckets: Optional[Sequence[int]] = None,
                    cache_only: bool = False):
@@ -726,30 +792,33 @@ class ContinuousBatchingEngine:
         """Zero-filled pool/table/state avals for AOT compile + lint."""
         kpools = [jnp.zeros_like(p) for p in self._pool.kpools]
         vpools = [jnp.zeros_like(p) for p in self._pool.vpools]
+        kscales = [jnp.zeros_like(p) for p in self._pool.kscales]
+        vscales = [jnp.zeros_like(p) for p in self._pool.vscales]
         bt = jnp.zeros((self.slots, self._max_blocks), jnp.int32)
-        return kpools, vpools, bt
+        return kpools, vpools, kscales, vscales, bt
 
     def _aot_warmup_paged(self, warm, toks, pos, active):
-        kpools, vpools, bt = self._paged_dummies()
+        kpools, vpools, kscales, vscales, bt = self._paged_dummies()
         c = warm(self._decode_paged, self._keep, self._quant, kpools,
-                 vpools, bt, toks, pos, active, self._key,
-                 target="serving.decode")
+                 vpools, kscales, vscales, bt, toks, pos, active,
+                 self._key, target="serving.decode")
         if c is not None:
             self._decode_compiled = c
-        kpools, vpools, bt = self._paged_dummies()
+        kpools, vpools, kscales, vscales, bt = self._paged_dummies()
         ids = jnp.zeros((1, self._chunk), jnp.int32)
         target = f"serving.prefill_chunk[{self._chunk}]"
         c = warm(self._prefill_chunk_fn, self._keep, self._quant, ids,
-                 kpools, vpools, bt[:1], jnp.zeros((1,), jnp.int32),
+                 kpools, vpools, kscales, vscales, bt[:1],
+                 jnp.zeros((1,), jnp.int32),
                  jnp.asarray(0, jnp.int32), self._key, target=target)
         if c is not None:
             self._prefill_chunk_compiled = c
         if self.spec_tokens:
-            kpools, vpools, bt = self._paged_dummies()
+            kpools, vpools, kscales, vscales, bt = self._paged_dummies()
             toksS = jnp.zeros((self.slots, self.spec_tokens + 1),
                               jnp.int32)
             c = warm(self._spec_verify, self._keep, self._quant, kpools,
-                     vpools, bt, toksS, pos, active,
+                     vpools, kscales, vscales, bt, toksS, pos, active,
                      target="serving.spec_verify")
             if c is not None:
                 self._spec_verify_compiled = c
@@ -768,11 +837,11 @@ class ContinuousBatchingEngine:
         pos = jnp.zeros((self.slots,), jnp.int32)
         active = jnp.ones((self.slots,), jnp.bool_)
         if self.paged:
-            kpools, vpools, bt = self._paged_dummies()
+            kpools, vpools, kscales, vscales, bt = self._paged_dummies()
             return _analysis.check(
                 self._decode_paged_raw, self._keep, self._quant, kpools,
-                vpools, bt, toks, pos, active, self._key, strict=strict,
-                passes=passes, options=options)
+                vpools, kscales, vscales, bt, toks, pos, active,
+                self._key, strict=strict, passes=passes, options=options)
         report = _analysis.check(
             self._decode_raw, self._keep, self._quant, self._caches,
             toks, pos, active, self._key, strict=strict, passes=passes,
@@ -1191,11 +1260,13 @@ class ContinuousBatchingEngine:
         sub = self._next_key()
         prefill = self._prefill_chunk_compiled or self._prefill_chunk_fn
         m = self._metrics
+        pool = self._pool
         with self._tracer.span("serving.prefill", parent=req.span,
                                rid=req.rid, chunk_start=start, tokens=n):
-            first, (self._pool.kpools, self._pool.vpools) = prefill(
+            first, (pool.kpools, pool.vpools, pool.kscales,
+                    pool.vscales) = prefill(
                 self._keep, self._quant, jnp.asarray(ids),
-                self._pool.kpools, self._pool.vpools,
+                pool.kpools, pool.vpools, pool.kscales, pool.vscales,
                 jnp.asarray(self._bt[slot:slot + 1]),
                 jnp.asarray([start], jnp.int32),
                 jnp.asarray(last_idx, jnp.int32), sub)
@@ -1268,10 +1339,12 @@ class ContinuousBatchingEngine:
         sub = self._next_key()
         t0 = time.perf_counter()
         decode = self._decode_compiled or self._decode_paged
+        pool = self._pool
         with self._recorder.instrumented("serving.decode"):
-            toks, self._pool.kpools, self._pool.vpools = decode(
-                self._keep, self._quant, self._pool.kpools,
-                self._pool.vpools, jnp.asarray(bt),
+            (toks, pool.kpools, pool.vpools, pool.kscales,
+             pool.vscales) = decode(
+                self._keep, self._quant, pool.kpools, pool.vpools,
+                pool.kscales, pool.vscales, jnp.asarray(bt),
                 jnp.asarray(self._last_tok), jnp.asarray(pos),
                 jnp.asarray(active), sub)
             toks = np.asarray(toks)                     # [B, K]
@@ -1330,11 +1403,14 @@ class ContinuousBatchingEngine:
         bt = np.where(active[:, None], self._bt, 0)
         t0 = time.perf_counter()
         verify = self._spec_verify_compiled or self._spec_verify
+        pool = self._pool
         with self._recorder.instrumented("serving.decode"):
-            greedy, self._pool.kpools, self._pool.vpools = verify(
-                self._keep, self._quant, self._pool.kpools,
-                self._pool.vpools, jnp.asarray(bt), jnp.asarray(toks),
-                jnp.asarray(pos), jnp.asarray(active))
+            (greedy, pool.kpools, pool.vpools, pool.kscales,
+             pool.vscales) = verify(
+                self._keep, self._quant, pool.kpools, pool.vpools,
+                pool.kscales, pool.vscales, jnp.asarray(bt),
+                jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(active))
             greedy = np.asarray(greedy)                 # [B, S]
         chunk_dt = time.perf_counter() - t0
         m = self._metrics
@@ -1657,7 +1733,14 @@ class ContinuousBatchingEngine:
 
     def close(self):
         """Hand the model back: restores train mode if the engine
-        flipped it at construction."""
+        flipped it at construction, and drops this engine's weight-
+        quantization reference (the original Linears come back when the
+        last engine holding the conversion closes)."""
+        if self._quant_converted:
+            from paddle_tpu.quantization.serving import \
+                restore_from_serving
+            restore_from_serving(self.model)
+            self._quant_converted = False
         if self._was_training:
             self.model.train()
             self._was_training = False

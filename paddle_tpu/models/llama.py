@@ -170,7 +170,11 @@ def _fused_norm_qkv(layer, x):
     d = int(x.shape[-1])
     dq = attn.num_heads * attn.head_dim
     dkv = attn.num_kv_heads * attn.head_dim
-    fused = FB.fused_block_enabled() and \
+    # weight-only quantized projections (quantization.serving) have no
+    # fp .weight — the quant matmul kernel owns that path
+    quanted = any(getattr(p, "quantized", False)
+                  for p in (attn.q_proj, attn.k_proj, attn.v_proj))
+    fused = not quanted and FB.fused_block_enabled() and \
         FB.fused_qkv_eligible(_rows(x.shape), d, dq, dkv, dkv, x.dtype)
     FB.record_path("rmsnorm_qkv", fused)
     if not fused:
@@ -199,8 +203,12 @@ class LlamaMLP(Layer):
     def forward(self, x):
         from paddle_tpu.ops.pallas import fused_block as FB
         d = int(x.shape[-1])
-        f = int(self.gate_proj.weight.shape[-1])
-        fused = FB.fused_block_enabled() and \
+        quanted = any(getattr(p, "quantized", False)
+                      for p in (self.gate_proj, self.up_proj,
+                                self.down_proj))
+        f = int(self.gate_proj.qweight.shape[-1]) if quanted \
+            else int(self.gate_proj.weight.shape[-1])
+        fused = not quanted and FB.fused_block_enabled() and \
             FB.fused_mlp_eligible(_rows(x.shape), d, f, x.dtype)
         FB.record_path("mlp", fused)
         if fused:

@@ -52,8 +52,8 @@ import time
 from typing import Callable, Dict, Sequence, Tuple
 
 __all__ = ["autotune", "flash_block_sizes", "ce_block_sizes",
-           "qkv_block_sizes", "mlp_block_sizes", "cache_path",
-           "seed_path", "backend_tag", "cached_entries",
+           "qkv_block_sizes", "mlp_block_sizes", "quant_block_sizes",
+           "cache_path", "seed_path", "backend_tag", "cached_entries",
            "clear_cache", "reload", "CACHE_VERSION", "main"]
 
 CACHE_VERSION = 2
@@ -550,6 +550,92 @@ def mlp_block_sizes(t: int, d: int, f: int, dtype: str) -> Tuple[int, int]:
     return tuple(autotune("fused_mlp", key, cands, bench, default))
 
 
+# -- weight-only quantized matmul --------------------------------------------
+
+def _quant_candidates(t, k, n, wdtype, xdtype) -> list:
+    """(block_t, block_n) candidates for the weight-only quant matmul:
+    K is unblocked, so VMEM holds the x tile, the quantized [k, bn]
+    weight tile (1 byte/elem for int8 AND fp8), its up-converted copy,
+    the fp32 accumulator tile, and the [1, bn] scale row."""
+    x_item = 2 if ("bfloat16" in xdtype or "float16" in xdtype) else 4
+    out = []
+    for bn in (128, 256, 512):
+        if n % bn:
+            continue
+        for bt in (32, 64, 128, 256, 512):
+            if t % bt or bt > t:
+                continue
+            vmem = (2 * bt * k * x_item          # double-buffered x io
+                    + k * bn * (1 + x_item)      # quant block + upcast
+                    + bt * bn * (4 + x_item)     # fp32 acc + out tile
+                    + bn * 4)
+            if vmem < 10 * (1 << 20):
+                out.append((bt, bn))
+    if not out:
+        from paddle_tpu.ops.pallas.quant_matmul import \
+            _default_quant_blocks
+        out = [_default_quant_blocks(t, n)]
+    return out
+
+
+def quant_key(t, k, n, wdtype, xdtype, backend=None, interpret=None):
+    return (f"t{t}k{k}n{n}w{wdtype}x{xdtype}"
+            f"@{backend or backend_tag(interpret)}")
+
+
+def quant_block_sizes(t: int, k: int, n: int, wdtype: str,
+                      xdtype: str) -> Tuple[int, int]:
+    """Measured (block_t, block_n) for the weight-only quantized matmul
+    at this [t, k] x [k, n] shape — forward only (serving decode never
+    differentiates through it)."""
+    from paddle_tpu.ops.pallas.quant_matmul import _default_quant_blocks
+    default = _default_quant_blocks(t, n)
+    cands = _quant_candidates(t, k, n, wdtype, xdtype)
+    if len(cands) == 1:
+        return tuple(cands[0])
+    key = quant_key(t, k, n, wdtype, xdtype)
+
+    def bench(blocks):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul_pallas
+
+        bt, bn = blocks
+        iters = 8
+        rng = np.random.default_rng(0)
+        xdt = jnp.dtype(xdtype)
+        wdt = jnp.dtype(wdtype) if "int8" in wdtype else None
+        w = rng.standard_normal((k, n)).astype(np.float32)
+        scale = jnp.asarray(np.abs(w).max(axis=0) / 127.0, jnp.float32)
+        if wdt is not None:
+            qw = jnp.asarray(np.clip(np.round(w / np.asarray(scale)),
+                                     -127, 127).astype(np.int8))
+        else:
+            import ml_dtypes
+            qw = jnp.asarray((w / np.asarray(scale))
+                             .astype(ml_dtypes.float8_e4m3fn))
+        x = jnp.asarray(rng.standard_normal((t, k)), xdt)
+
+        @jax.jit
+        def run(x_, qw_, s_):
+            def body(i, carry):
+                o = quant_matmul_pallas(
+                    x_ * (1 + carry * 1e-12).astype(xdt), qw_, s_,
+                    block_t=bt, block_n=bn, autotune=False)
+                return carry + jnp.sum(jnp.abs(o).astype(jnp.float32))
+            return lax.fori_loop(0, iters, body, 0.0)
+
+        np.asarray(run(x, qw, scale))                 # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(run(x, qw, scale))
+        return (time.perf_counter() - t0) / iters
+
+    return tuple(autotune("quant_matmul", key, cands, bench, default))
+
+
 # -- offline sweep -----------------------------------------------------------
 
 # the bench llama (bench.py on-TPU config: 810M-param Llama-3 proportions,
@@ -569,6 +655,15 @@ SWEEP_SHAPES = {
     "fused_mlp": [
         (8192, 2048, 7168, "bfloat16"),
         (8192, 4096, 14336, "bfloat16"),
+    ],
+    # weight-only quantized GEMM (serving): the bench_serve llama's
+    # prefill-chunk and batched-decode token counts over its projection
+    # shapes, int8 and fp8 weight storage
+    "quant_matmul": [
+        (256, 1024, 3584, "int8", "bfloat16"),
+        (256, 1024, 1024, "int8", "bfloat16"),
+        (256, 1024, 3584, "float8_e4m3fn", "bfloat16"),
+        (8, 1024, 1024, "int8", "bfloat16"),
     ],
 }
 
@@ -609,6 +704,16 @@ def _sweep_one(op, shape, dry_run, backend):
         key = mlp_key(t, d, f, dtype, backend=backend)
         if not dry_run:
             return key, mlp_block_sizes(t, d, f, dtype), len(cands)
+    elif op == "quant_matmul":
+        t, k, n, wdtype, xdtype = shape
+        from paddle_tpu.ops.pallas.quant_matmul import \
+            _default_quant_blocks
+        cands = _quant_candidates(t, k, n, wdtype, xdtype)
+        default = _default_quant_blocks(t, n)
+        key = quant_key(t, k, n, wdtype, xdtype, backend=backend)
+        if not dry_run:
+            return key, quant_block_sizes(t, k, n, wdtype, xdtype), \
+                len(cands)
     else:
         raise ValueError(f"unknown sweep op {op!r}")
     # dry run: the heuristic default stands in for the measured winner —

@@ -85,9 +85,12 @@ def record_path(path: str):
 
 def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                    acc_ref, m_ref, l_ref, *, block_size, kv_heads, group,
-                   head_dim, scale):
+                   head_dim, scale, ks_ref=None, vs_ref=None):
     """Grid (batch, max_blocks); the block axis is innermost/sequential so
-    VMEM scratch carries the online-softmax state across a row's blocks."""
+    VMEM scratch carries the online-softmax state across a row's blocks.
+    Quantized pools (``ks_ref/vs_ref`` given) dequantize AT THE BLOCK
+    LOAD: the int8 tile and its ``[bs, kvh]`` scales widen in VMEM
+    registers — the fp16/bf16 KV never exists in HBM."""
     b = pl.program_id(0)
     j = pl.program_id(1)
     nb = pl.num_programs(1)
@@ -103,8 +106,16 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * block_size < plen)
     def _compute():
         q = q_ref[0].reshape(kv_heads, group, head_dim)
-        k = jnp.swapaxes(k_ref[0], 0, 1)               # [kvh, bs, hd]
-        v = jnp.swapaxes(v_ref[0], 0, 1)               # [kvh, bs, hd]
+        if ks_ref is not None:
+            ks = jnp.swapaxes(ks_ref[0], 0, 1)[..., None]  # [kvh, bs, 1]
+            vs = jnp.swapaxes(vs_ref[0], 0, 1)[..., None]
+            k = (jnp.swapaxes(k_ref[0], 0, 1).astype(jnp.float32)
+                 * ks).astype(q.dtype)                 # [kvh, bs, hd]
+            v = (jnp.swapaxes(v_ref[0], 0, 1).astype(jnp.float32)
+                 * vs).astype(q.dtype)
+        else:
+            k = jnp.swapaxes(k_ref[0], 0, 1)           # [kvh, bs, hd]
+            v = jnp.swapaxes(v_ref[0], 0, 1)           # [kvh, bs, hd]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale  # [kvh, g, bs]
@@ -131,8 +142,18 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = out.astype(o_ref.dtype)
 
 
+def _decode_kernel_quant(bt_ref, len_ref, q_ref, k_ref, v_ref, ks_ref,
+                         vs_ref, o_ref, acc_ref, m_ref, l_ref, **kw):
+    """Positional adapter: the quantized variant's extra scale inputs
+    sit between the pools and the output in pallas_call order."""
+    _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, ks_ref=ks_ref, vs_ref=vs_ref,
+                   **kw)
+
+
 def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
-                           scale=None, interpret=None):
+                           scale=None, interpret=None,
+                           k_scale=None, v_scale=None):
     """Single-token paged attention.
 
     q: ``[B, heads, head_dim]`` (the step's one query row per sequence,
@@ -141,7 +162,9 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
     ``[B, max_blocks]`` int32 (scratch block 0 beyond a row's
     allocation); lengths: ``[B]`` int32 — row b attends positions
     ``< lengths[b]`` (the current token's KV must already be written).
-    Returns ``[B, heads, head_dim]``."""
+    ``k_scale/v_scale`` (``[num_blocks, block_size, kv_heads]`` fp32)
+    mark an int8-quantized pool: blocks dequantize at the load, chased
+    by the same block-table index maps.  Returns ``[B, heads, hd]``."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     B, h, hd = q.shape
@@ -150,21 +173,35 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
     group = h // kvh
     if scale is None:
         scale = 1.0 / (hd ** 0.5)
+    quant = k_scale is not None
 
+    kw = dict(block_size=bs, kv_heads=kvh, group=group, head_dim=hd,
+              scale=scale)
     kernel = functools.partial(
-        _decode_kernel, block_size=bs, kv_heads=kvh, group=group,
-        head_dim=hd, scale=scale)
+        _decode_kernel_quant if quant else _decode_kernel, **kw)
+
+    in_specs = [
+        pl.BlockSpec((1, h, hd), lambda b, j, bt, ln: (b, 0, 0)),
+        pl.BlockSpec((1, bs, kvh, hd),
+                     lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
+        pl.BlockSpec((1, bs, kvh, hd),
+                     lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, bs, kvh),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0)),
+            pl.BlockSpec((1, bs, kvh),
+                         lambda b, j, bt, ln: (bt[b, j], 0, 0)),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, mb),
-        in_specs=[
-            pl.BlockSpec((1, h, hd), lambda b, j, bt, ln: (b, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, hd),
-                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
-            pl.BlockSpec((1, bs, kvh, hd),
-                         lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, hd), lambda b, j, bt, ln: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((kvh, group, hd), jnp.float32),
@@ -185,4 +222,4 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
         interpret=interpret,
         **params,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pool, v_pool)
+      *operands)

@@ -1,14 +1,29 @@
-"""paddle_tpu.quantization — PTQ / QAT.
+"""paddle_tpu.quantization — PTQ / QAT / quantized serving.
 
 Reference parity: ``paddle.quantization`` (python/paddle/quantization/:
 QuantConfig + PTQ/QAT entries (quantize.py, ptq.py, qat.py), observers
 (observers/abs_max.py …), quanters (quanters/act_lsq.py …)).
 
-TPU-native notes: int8 matmuls hit the MXU natively, so the payoff layer is
-weight-only / weight+act symmetric int8 GEMM.  Fake-quant in QAT uses the
-straight-through estimator; conversion produces ``QuantedLinear`` whose
-forward runs the int8 kernel shape (dequant folded into the output scale —
-XLA fuses it).
+Two halves:
+
+* **Calibration-time** (this module) — observers (abs-max, moving
+  average, histogram, KL/entropy), fake-quant with STE gradients
+  (``FakeQuantLinear``), and the ``PTQ``/``QAT`` calibrate→convert
+  drivers, all producing :class:`QuantedLinear` inference layers.
+* **Serving-time** (``quantization.serving``) — the TPU subsystem
+  behind ``PADDLE_TPU_QUANT_WEIGHTS=int8|fp8`` and
+  ``PADDLE_TPU_QUANT_KV=int8``: :func:`quantize_for_serving` converts
+  a model's large Linears to weight-only :class:`QuantedLinear`
+  (int8 or ``float8_e4m3fn`` at rest, per-output-channel fp32 scales)
+  whose matmuls run the Pallas quant kernel
+  (``ops/pallas/quant_matmul.py`` — dequant fused into the fp32 MXU
+  accumulator, tile sizes one more autotune axis); the serving engine
+  adopts the conversion at construction and
+  :func:`restore_from_serving` undoes it.  Quantized paged-KV block
+  pools live in ``inference/kv_cache.py``; the accuracy-parity gate
+  (:func:`quantization.serving.parity_report` + ``bench_serve
+  --check-equivalence``) bounds logit error and greedy token drift vs
+  the bf16 engine so quantization can never silently rot quality.
 """
 
 from __future__ import annotations
@@ -25,7 +40,8 @@ from paddle_tpu.core.dispatch import eager_op, unwrap, wrap_like
 __all__ = ["AbsMaxObserver", "MovingAverageAbsMaxObserver",
            "HistogramObserver", "KLObserver", "QuantConfig",
            "PTQ", "QAT", "FakeQuantLinear", "QuantedLinear",
-           "quant_dequant", "quantize_weight"]
+           "quant_dequant", "quantize_weight", "quantize_for_serving",
+           "restore_from_serving", "quant_weights_mode"]
 
 
 # -- quant math --------------------------------------------------------------
@@ -253,20 +269,41 @@ class FakeQuantLinear(Layer):
 
 
 class QuantedLinear(Layer):
-    """Converted inference layer: int8 weights at rest; the int8×int8→int32
-    GEMM shape XLA maps onto the MXU, output rescaled by (x_scale*w_scale)."""
+    """Converted inference layer: quantized weights at rest.
+
+    Two flavours share the class:
+
+    * **weight + activation int8** (``act_scale`` given, the PTQ/QAT
+      convert target): the int8×int8→int32 GEMM shape XLA maps onto the
+      MXU, output rescaled by ``x_scale * w_scale``.
+    * **weight-only** (``act_scale=None`` — the serving path,
+      ``quantization.serving.quantize_for_serving``): int8 or fp8
+      (``float8_e4m3fn``) weights with a per-output-channel fp32 scale,
+      routed through the Pallas quant matmul
+      (``ops/pallas/quant_matmul.py`` — dequant fused into the fp32 MXU
+      accumulator; jnp scale-multiply fallback off-TPU).
+    """
 
     def __init__(self, linear, act_scale: Optional[float] = None,
-                 bits: int = 8):
+                 bits: int = 8, mode: Optional[str] = None):
         super().__init__()
-        q, scale = quantize_weight(linear.weight, bits=bits, axis=1)
+        if mode is None:
+            q, scale = quantize_weight(linear.weight, bits=bits, axis=1)
+            scale = scale.reshape(-1)
+        else:
+            from paddle_tpu.quantization.serving import \
+                quantize_linear_weight
+            q, scale = quantize_linear_weight(unwrap(linear.weight), mode)
         self.register_buffer("qweight", wrap_like(q))
-        self.register_buffer("w_scale", wrap_like(scale.reshape(-1)))
+        self.register_buffer("w_scale", wrap_like(scale))
         self.bias = linear.bias
         self.act_scale = act_scale
         self.bits = bits
+        self.mode = mode or "int8"
+        self.quantized = True   # routing marker (fused-block fallback)
 
     def forward(self, x):
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul
         xr = unwrap(x)
         qw = unwrap(self.qweight)
         ws = unwrap(self.w_scale)
@@ -278,8 +315,8 @@ class QuantedLinear(Layer):
                 xq, qw, (((xr.ndim - 1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)
             out = acc.astype(jnp.float32) * (self.act_scale * ws)
-        else:  # weight-only
-            out = xr @ (qw.astype(xr.dtype) * ws.astype(xr.dtype))
+        else:  # weight-only: fused-dequant kernel (fallback off-TPU)
+            out = quant_matmul(xr, qw, ws, mode=self.mode)
         if self.bias is not None:
             out = out + unwrap(self.bias)
         return wrap_like(out.astype(xr.dtype))
@@ -361,3 +398,9 @@ class QAT:
                     conv(child)
         conv(model)
         return model
+
+
+# serving-time subsystem (lazy-importable as paddle_tpu.quantization.serving;
+# re-exported here for the documented public surface)
+from paddle_tpu.quantization.serving import (  # noqa: E402
+    quant_weights_mode, quantize_for_serving, restore_from_serving)

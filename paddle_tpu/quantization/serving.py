@@ -1,0 +1,207 @@
+"""Quantized serving — weight-only int8/fp8 conversion + parity gate.
+
+The serving tentpole behind ``PADDLE_TPU_QUANT_WEIGHTS=int8|fp8``
+(ROADMAP item 4): replica HBM is dominated by bf16 weights and the
+paged-KV pool, so weight-only quantization roughly doubles the model
+capacity a chip can hold — and decode, a bandwidth-bound workload,
+reads half the weight bytes per step.
+
+* :func:`quantize_for_serving` — walk a model, replace every large
+  ``Linear`` with a weight-only :class:`~paddle_tpu.quantization.
+  QuantedLinear` (int8 or ``float8_e4m3fn`` values at rest, one fp32
+  scale per output channel).  The converted layers' matmuls route
+  through the Pallas quant kernel (``ops/pallas/quant_matmul.py`` —
+  dequant fused into the fp32 MXU accumulator) on TPU and its
+  numerically-identical jnp fallback elsewhere.  Conversion is
+  refcounted: N serving engines can adopt the same model and the last
+  :func:`restore_from_serving` puts the original Linears back.
+* :func:`parity_report` — the accuracy gate's logit half: one forward
+  of the same ids through the original and the converted model,
+  reporting max absolute / relative logit error.  ``bench_serve
+  --check-equivalence`` combines it with the greedy token-match rate
+  into the hard CI threshold.
+
+The serving engine (``inference/serving.py``) reads the knob at
+construction: unset reproduces the exact previous engine (knob-off
+jaxpr regression-tested, like ``PADDLE_TPU_FUSED_BLOCK``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["quant_weights_mode", "quantize_linear_weight",
+           "quantize_for_serving", "restore_from_serving",
+           "parity_report", "QUANT_MODES"]
+
+QUANT_MODES = ("int8", "fp8")
+
+# fp8 e4m3fn: largest finite magnitude (no inf encoding — that's the
+# "fn"); symmetric absmax scaling maps the channel max onto it
+_FP8_MAX = 448.0
+
+
+def quant_weights_mode(explicit: Optional[str] = None) -> Optional[str]:
+    """Resolve the weight-quant mode: an explicit ctor value wins, else
+    the ``PADDLE_TPU_QUANT_WEIGHTS`` env knob.  Returns ``"int8"``,
+    ``"fp8"`` or None (off — the exact previous behavior)."""
+    raw = explicit if explicit is not None \
+        else os.environ.get("PADDLE_TPU_QUANT_WEIGHTS")
+    if raw is None:
+        return None
+    raw = str(raw).strip().lower()
+    if raw in ("", "0", "off", "none", "false"):
+        return None
+    if raw not in QUANT_MODES:
+        raise ValueError(
+            f"PADDLE_TPU_QUANT_WEIGHTS={raw!r}: expected int8|fp8 "
+            "(or unset/0 for the bf16 engine)")
+    return raw
+
+
+def quantize_linear_weight(w, mode: str):
+    """Symmetric per-output-channel quantization of a ``[in, out]``
+    linear weight.  Returns ``(qw, scale)``: ``qw`` in the mode's
+    storage dtype, ``scale`` ``[out]`` fp32 such that
+    ``dequant = qw * scale``."""
+    from paddle_tpu.ops.pallas.quant_matmul import weight_dtype
+    wf = jnp.asarray(w).astype(jnp.float32)
+    qmax = 127.0 if mode == "int8" else _FP8_MAX
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=0), 1e-12) / qmax
+    scaled = wf / scale[None, :]
+    if mode == "int8":
+        q = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+    else:
+        q = scaled.astype(weight_dtype("fp8"))
+    return q, scale.astype(jnp.float32)
+
+
+def _eligible(linear, min_size: int) -> bool:
+    w = getattr(linear, "weight", None)
+    if w is None:
+        return False
+    shape = tuple(w.shape)
+    if len(shape) != 2:
+        return False
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n >= min_size
+
+
+def quantize_for_serving(model, mode: Optional[str] = None,
+                         min_size: int = 4096) -> Dict[str, int]:
+    """Convert every eligible ``Linear`` (2-D weight with >= `min_size`
+    elements) into a weight-only :class:`QuantedLinear` IN PLACE.
+
+    Refcounted: converting an already-converted model only bumps the
+    refcount, so a fleet of engines can share one model;
+    :func:`restore_from_serving` restores the original Linears when the
+    last holder lets go (each QuantedLinear keeps its source layer on
+    ``_orig`` — serving keeps the fp weights host-side for restore; a
+    deployment that wants them gone converts once and never restores).
+
+    Returns ``{"layers": n_converted, "refs": current_refcount}``.
+    """
+    mode = quant_weights_mode(mode)
+    if mode is None:
+        raise ValueError("quantize_for_serving needs mode=int8|fp8 "
+                         "(or PADDLE_TPU_QUANT_WEIGHTS set)")
+    refs = getattr(model, "_serving_quant_refs", 0)
+    if refs > 0:
+        if getattr(model, "_serving_quant_mode", None) != mode:
+            raise ValueError(
+                f"model already quantized for serving as "
+                f"{model._serving_quant_mode!r}; cannot re-quantize as "
+                f"{mode!r} while {refs} engine(s) hold it")
+        model._serving_quant_refs = refs + 1
+        return {"layers": model._serving_quant_layers, "refs": refs + 1}
+
+    from paddle_tpu.nn.common_layers import Linear
+    from paddle_tpu.quantization import QuantedLinear
+
+    converted = [0]
+
+    def walk(root):
+        for name, child in list(root.named_children()):
+            if isinstance(child, Linear) and _eligible(child, min_size):
+                q = QuantedLinear(child, act_scale=None, mode=mode)
+                q._orig = child
+                setattr(root, name, q)
+                converted[0] += 1
+            else:
+                walk(child)
+
+    walk(model)
+    model._serving_quant_refs = 1
+    model._serving_quant_mode = mode
+    model._serving_quant_layers = converted[0]
+    return {"layers": converted[0], "refs": 1}
+
+
+def restore_from_serving(model) -> bool:
+    """Drop one conversion reference; when it is the last, swap every
+    QuantedLinear back to its original Linear.  Returns True when the
+    model is back in its original form."""
+    refs = getattr(model, "_serving_quant_refs", 0)
+    if refs == 0:
+        return True
+    if refs > 1:
+        model._serving_quant_refs = refs - 1
+        return False
+
+    from paddle_tpu.quantization import QuantedLinear
+
+    def walk(root):
+        for name, child in list(root.named_children()):
+            if isinstance(child, QuantedLinear) and \
+                    getattr(child, "_orig", None) is not None:
+                setattr(root, name, child._orig)
+            else:
+                walk(child)
+
+    walk(model)
+    model._serving_quant_refs = 0
+    model._serving_quant_mode = None
+    return True
+
+
+def parity_report(model, mode: str, sample_ids,
+                  min_size: int = 4096) -> Dict[str, float]:
+    """Logit half of the accuracy-parity gate: forward `sample_ids`
+    (``[B, S]`` int32) through the model before and after weight-only
+    conversion and report the divergence.  The model is restored before
+    returning, whatever happens.
+
+    Returns ``{max_logit_err, ref_logit_absmax, rel_logit_err,
+    layers}`` — ``rel_logit_err`` (max abs error over the reference's
+    absmax) is the number the CI threshold bounds."""
+    from paddle_tpu.core.dispatch import unwrap
+
+    ids = np.asarray(sample_ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None]
+    was_training = getattr(model, "training", False)
+    if was_training:
+        model.eval()
+    try:
+        ref = np.asarray(unwrap(model(ids)), np.float32)
+        info = quantize_for_serving(model, mode, min_size=min_size)
+        try:
+            got = np.asarray(unwrap(model(ids)), np.float32)
+        finally:
+            restore_from_serving(model)
+    finally:
+        if was_training:
+            model.train()
+    err = float(np.abs(got - ref).max())
+    absmax = float(np.abs(ref).max())
+    return {"max_logit_err": err,
+            "ref_logit_absmax": absmax,
+            "rel_logit_err": err / max(absmax, 1e-12),
+            "layers": info["layers"]}
