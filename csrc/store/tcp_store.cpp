@@ -10,10 +10,17 @@
 // Protocol (all little-endian):
 //   request:  u8 cmd | u32 keylen | key | (SET: u32 vallen | val)
 //                                        (ADD: i64 delta)
+//                                        (ADDTOK: i64 delta |
+//                                         u32 toklen | token)
 //                                        (GET/CHECK: nothing)
 //   response: SET -> u8 ok
 //             GET -> u32 vallen | val   (vallen == 0xFFFFFFFF => not found)
 //             ADD -> i64 new_value
+//             ADDTOK -> i64 new_value (dedup: a token the server has
+//                       already applied returns the RECORDED result
+//                       without re-adding — retry-safe counters: a
+//                       client whose response was lost on the wire can
+//                       resend the same op id and never double-count)
 //             CHECK -> u8 present
 //
 // Exposed as extern "C" for ctypes (no pybind11 in this image).
@@ -29,15 +36,23 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <map>
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
 
-enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kCheck = 4 };
+enum Cmd : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kCheck = 4,
+                     kAddTok = 5 };
+
+// Bounded op-id dedup ledger for kAddTok: retries land within seconds,
+// so FIFO-evicting old entries never forgets a token that could still
+// be legitimately resent, while a long-lived store stays O(cap) memory.
+constexpr size_t kTokenCap = 65536;
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -172,21 +187,46 @@ class Server {
         if (!send_all(fd, &vallen, 4)) break;
         if (found && !val.empty() && !send_all(fd, val.data(), val.size()))
           break;
-      } else if (cmd == kAdd) {
+      } else if (cmd == kAdd || cmd == kAddTok) {
         int64_t delta;
         if (!recv_all(fd, &delta, 8)) break;
+        std::string token;
+        if (cmd == kAddTok) {
+          uint32_t toklen;
+          if (!recv_all(fd, &toklen, 4)) break;
+          token.resize(toklen);
+          if (toklen && !recv_all(fd, token.data(), toklen)) break;
+        }
         int64_t result;
         {
           std::lock_guard<std::mutex> lk(mu_);
-          int64_t cur = 0;
-          auto it = data_.find(key);
-          if (it != data_.end() && it->second.size() == 8)
-            std::memcpy(&cur, it->second.data(), 8);
-          cur += delta;
-          std::string val(8, '\0');
-          std::memcpy(val.data(), &cur, 8);
-          data_[key] = std::move(val);
-          result = cur;
+          bool replay = false;
+          if (!token.empty()) {
+            auto seen = applied_tokens_.find(token);
+            if (seen != applied_tokens_.end()) {
+              result = seen->second;  // duplicate op id: replay the
+              replay = true;          // recorded result, apply nothing
+            }
+          }
+          if (!replay) {
+            int64_t cur = 0;
+            auto it = data_.find(key);
+            if (it != data_.end() && it->second.size() == 8)
+              std::memcpy(&cur, it->second.data(), 8);
+            cur += delta;
+            std::string val(8, '\0');
+            std::memcpy(val.data(), &cur, 8);
+            data_[key] = std::move(val);
+            result = cur;
+            if (!token.empty()) {
+              applied_tokens_.emplace(token, result);
+              token_fifo_.push_back(token);
+              while (token_fifo_.size() > kTokenCap) {
+                applied_tokens_.erase(token_fifo_.front());
+                token_fifo_.pop_front();
+              }
+            }
+          }
         }
         cv_.notify_all();
         if (!send_all(fd, &result, 8)) break;
@@ -226,6 +266,8 @@ class Server {
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, std::string> data_;
+  std::unordered_map<std::string, int64_t> applied_tokens_;
+  std::deque<std::string> token_fifo_;
 };
 
 }  // namespace
@@ -317,6 +359,23 @@ int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
   uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
   if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
       !send_all(fd, key, keylen) || !send_all(fd, &delta, 8))
+    return INT64_MIN;
+  int64_t result;
+  if (!recv_all(fd, &result, 8)) return INT64_MIN;
+  return result;
+}
+
+// Idempotent add: `token` is a caller-unique op id; resending the same
+// token replays the first application's result instead of re-adding.
+int64_t tcpstore_add_tok(int fd, const char* key, int64_t delta,
+                         const char* token) {
+  uint8_t cmd = kAddTok;
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  uint32_t toklen = static_cast<uint32_t>(std::strlen(token));
+  if (!send_all(fd, &cmd, 1) || !send_all(fd, &keylen, 4) ||
+      !send_all(fd, key, keylen) || !send_all(fd, &delta, 8) ||
+      !send_all(fd, &toklen, 4) ||
+      (toklen && !send_all(fd, token, toklen)))
     return INT64_MIN;
   int64_t result;
   if (!recv_all(fd, &result, 8)) return INT64_MIN;

@@ -201,6 +201,18 @@ class ElasticManager:
     jitter; ``circuit_fast_failures`` consecutive sub-
     ``circuit_min_uptime`` generations open a circuit breaker instead
     of burning the whole restart budget on a hopeless loop.
+
+    Fast recovery (``recovery="peer"``): the manager publishes the
+    ring-wise buddy map on its store and arms workers
+    (``PADDLE_TPU_RECOVERY=peer`` / ``PADDLE_TPU_SNAPSHOT_INTERVAL``)
+    to mirror their state to their buddy every
+    ``snapshot_interval_steps`` steps
+    (:class:`paddle_tpu.robustness.recovery.PeerSnapshotter`) and to
+    resume via :func:`~paddle_tpu.robustness.recovery.
+    resume_train_state` — a RAM fetch instead of a disk walk, so the
+    restart gap the goodput ledger debits
+    (``paddle_tpu_elastic_downtime_seconds_total``) shrinks to the
+    relaunch itself.
     """
 
     def __init__(self, cmd: Sequence[str], nproc: int = 1,
@@ -211,7 +223,9 @@ class ElasticManager:
                  drain_timeout: float = 30.0,
                  backoff_base: float = 0.5, backoff_max: float = 30.0,
                  circuit_fast_failures: int = 5,
-                 circuit_min_uptime: float = 5.0):
+                 circuit_min_uptime: float = 5.0,
+                 recovery: str = "disk",
+                 snapshot_interval_steps: int = 10):
         self.cmd = list(cmd)
         self.nproc = nproc
         self.max_restarts = max_restarts
@@ -235,8 +249,24 @@ class ElasticManager:
         self.backoff_max = backoff_max
         self.circuit_fast_failures = circuit_fast_failures
         self.circuit_min_uptime = circuit_min_uptime
+        # fast-recovery mode (robustness.recovery): recovery="peer"
+        # tells workers to mirror their param/opt shard to a ring buddy
+        # through this manager's store every `snapshot_interval_steps`
+        # steps, and to resume from the buddy's RAM copy (disk fallback
+        # only when no peer holds a fresh snapshot) — the store outlives
+        # generations, so the snapshots survive the crash they recover
+        if recovery not in ("disk", "peer"):
+            raise ValueError(f"recovery must be 'disk' or 'peer', got "
+                             f"{recovery!r}")
+        self.recovery = recovery
+        self.snapshot_interval_steps = int(snapshot_interval_steps)
         self._port = free_port()
         self._store = TCPStore("127.0.0.1", self._port, is_master=True)
+        if recovery == "peer":
+            import json as _json
+            from paddle_tpu.robustness.recovery import buddy_map
+            self._store.set("recovery/buddies", _json.dumps(
+                {str(r): b for r, b in buddy_map(nproc).items()}))
 
     # -- generation lifecycle ------------------------------------------------
     def _spawn(self) -> List[subprocess.Popen]:
@@ -261,6 +291,12 @@ class ElasticManager:
                 "PADDLE_ELASTIC_GEN": str(self.generation),
                 "PADDLE_ELASTIC_RESTARTS": str(self.restarts),
             })
+            if self.recovery == "peer":
+                env.update({
+                    "PADDLE_TPU_RECOVERY": "peer",
+                    "PADDLE_TPU_SNAPSHOT_INTERVAL":
+                        str(self.snapshot_interval_steps),
+                })
             stdout = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
@@ -368,7 +404,8 @@ class ElasticManager:
                 metrics["generation"].set(self.generation)
                 recorder.record("elastic.spawn",
                                 generation=self.generation,
-                                nproc=self.nproc, restarts=self.restarts)
+                                nproc=self.nproc, restarts=self.restarts,
+                                recovery=self.recovery)
                 # generation-lifetime span; its context is published on
                 # the store BEFORE workers spawn so their ElasticAgents
                 # adopt it and the whole generation stitches into one
@@ -510,6 +547,11 @@ class MultiNodeElasticAgent:
     Workers resume from :class:`~paddle_tpu.distributed.checkpoint.
     AutoCheckpoint` — its per-shard format restores under a different
     process count, so scale-down resumes are exact, not best-effort.
+
+    A node on the SDC quarantine roster
+    (:func:`paddle_tpu.robustness.recovery.is_quarantined`) refuses to
+    re-register: ``run()`` returns 3 and the surviving fleet
+    re-rendezvouses without the blamed hardware.
     """
 
     _RESTART = object()
@@ -524,7 +566,14 @@ class MultiNodeElasticAgent:
                  poll_interval: float = 0.2,
                  env: Optional[Dict[str, str]] = None,
                  log_dir: Optional[str] = None,
-                 node_id: Optional[str] = None):
+                 node_id: Optional[str] = None,
+                 recovery: str = "disk",
+                 snapshot_interval_steps: int = 10):
+        if recovery not in ("disk", "peer"):
+            raise ValueError(f"recovery must be 'disk' or 'peer', got "
+                             f"{recovery!r}")
+        self.recovery = recovery
+        self.snapshot_interval_steps = int(snapshot_interval_steps)
         self.cmd = list(cmd)
         self.nproc = nproc
         self.min_nodes = min_nodes
@@ -661,6 +710,15 @@ class MultiNodeElasticAgent:
                 "PADDLE_ELASTIC_STORE": self.store_addr,
                 "PADDLE_ELASTIC_GEN": str(g),
             })
+            if self.recovery == "peer":
+                # workers derive the ring buddy map from their rank /
+                # world size (both above), which tracks rescales — the
+                # buddy of rank r is always (r + 1) % world
+                env.update({
+                    "PADDLE_TPU_RECOVERY": "peer",
+                    "PADDLE_TPU_SNAPSHOT_INTERVAL":
+                        str(self.snapshot_interval_steps),
+                })
             stdout = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)
@@ -804,6 +862,20 @@ class MultiNodeElasticAgent:
 
     def _run_inner(self, metrics, recorder, failures, infra, barren) -> int:
         while True:
+            # SDC quarantine (robustness.recovery): a host the sentinels
+            # blamed for silent corruption must sit out — the surviving
+            # peers re-rendezvous without it (the per-shard checkpoint
+            # format re-shards across the smaller world), and this agent
+            # leaves with a distinctive code instead of re-registering
+            # bad hardware into every future generation
+            try:
+                from paddle_tpu.robustness.recovery import is_quarantined
+                if is_quarantined(self._store, self.node_id):
+                    recorder.record("elastic.quarantined",
+                                    node=self.node_id)
+                    return 3
+            except Exception:
+                pass  # roster unreadable: run (quarantine is advisory)
             g = self._gen_now()
             metrics["generation"].set(g)
             if self._drain_signal is not None:

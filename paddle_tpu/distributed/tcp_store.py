@@ -11,11 +11,20 @@ sync (barriers, small blobs) the reference exposes on its store.
 from __future__ import annotations
 
 import ctypes
+import os
 import random
+import threading
 import time
+import uuid
 from typing import Optional
 
 __all__ = ["TCPStore"]
+
+# per-process op-id namespace for retry-safe adds: nonce makes tokens
+# unique across unrelated processes, the sequence across calls
+_ADD_NONCE = uuid.uuid4().hex[:12]
+_ADD_SEQ = 0
+_ADD_SEQ_LOCK = threading.Lock()
 
 
 def _store_metrics():
@@ -38,8 +47,9 @@ def _store_metrics():
 
 def _lib():
     from paddle_tpu.utils.cpp_extension import load_native
-    lib = load_native("store",
-                      required_symbol="tcpstore_server_wait_clients")
+    # required_symbol names the NEWEST C entry point so a stale .so
+    # (built before the idempotent-add protocol) triggers a rebuild
+    lib = load_native("store", required_symbol="tcpstore_add_tok")
     lib.tcpstore_server_start.restype = ctypes.c_void_p
     lib.tcpstore_server_start.argtypes = [ctypes.c_int]
     lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
@@ -55,6 +65,9 @@ def _lib():
     lib.tcpstore_add.restype = ctypes.c_int64
     lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                  ctypes.c_int64]
+    lib.tcpstore_add_tok.restype = ctypes.c_int64
+    lib.tcpstore_add_tok.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_char_p]
     lib.tcpstore_check.restype = ctypes.c_int
     lib.tcpstore_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.tcpstore_server_wait_clients.restype = ctypes.c_int
@@ -75,9 +88,17 @@ class TCPStore:
                  connect_timeout: Optional[float] = None):
         self._lib = _lib()
         self._server = None
+        self.host = host
+        self.port = port
         self.world_size = world_size
         self.timeout = timeout
         self._metrics = _store_metrics()
+        # lazily-connected extra client sockets for get_many: parallel
+        # bulk reads (peer state snapshots) pipeline the per-get round
+        # trip; ctypes releases the GIL during the blocking C recv, so
+        # python threads on separate fds genuinely overlap
+        self._bulk_fds = []
+        self._bulk_lock = threading.Lock()
         from paddle_tpu.observability.tracing import tracer
         # store ops get spans (root_eligible=False: a bare heartbeat
         # set() outside any trace must not crowd the slow-trace table)
@@ -115,10 +136,12 @@ class TCPStore:
             delay = min(delay * 2, 2.0)
 
     def _retry_op(self, op: str, attempt, attempts: int = 3):
-        """Bounded retry with backoff for IDEMPOTENT ops (set/check/get).
-        ``add`` is deliberately excluded: a retried add whose first
-        round-trip succeeded server-side but lost its response would
-        double-count — counters must fail loudly instead."""
+        """Bounded retry with backoff for IDEMPOTENT ops.  ``add`` was
+        historically excluded (a retried add whose first round-trip
+        succeeded server-side but lost its response would double-count);
+        it now rides an op-id idempotency token — the server dedups a
+        resent token and replays the recorded result — so the same
+        bounded retry covers it."""
         from paddle_tpu.robustness import fault_point
         delay = 0.02
         for i in range(attempts):
@@ -133,6 +156,8 @@ class TCPStore:
                 delay *= 2
 
     def set(self, key: str, value):
+        if isinstance(value, (bytearray, memoryview)):
+            value = bytes(value)
         data = value if isinstance(value, bytes) else str(value).encode()
 
         def attempt():
@@ -144,11 +169,14 @@ class TCPStore:
                                root_eligible=False):
             self._retry_op("set", attempt)
 
-    def get(self, key: str, wait: bool = True) -> bytes:
+    def get(self, key: str, wait: bool = True,
+            max_bytes: int = 1 << 20) -> bytes:
         """Blocking get (reference semantics: waits for the key).  The
         span covers the whole wait — a control-plane stall shows up as
-        one long ``store.get`` in the trace, not as unexplained gap."""
-        buf = ctypes.create_string_buffer(1 << 20)
+        one long ``store.get`` in the trace, not as unexplained gap.
+        ``max_bytes`` sizes the receive buffer (bulk consumers — peer
+        state snapshots — raise it to cut round trips)."""
+        buf = ctypes.create_string_buffer(max_bytes)
         deadline = time.monotonic() + self.timeout
         with self._tracer.span("store.get", key=key, wait=wait,
                                root_eligible=False):
@@ -165,13 +193,126 @@ class TCPStore:
                     raise TimeoutError(f"TCPStore.get({key}) timed out")
                 time.sleep(0.01)
 
+    def _add_once(self, key: str, amount: int, token: str) -> int:
+        """One token-carrying add round-trip.  Resending the SAME token
+        is safe: the server's dedup ledger replays the first
+        application's result without re-adding (the double-count hazard
+        a bare retried ``add`` had)."""
+        v = self._lib.tcpstore_add_tok(self._fd, key.encode(), amount,
+                                       token.encode())
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(v)
+
+    def _get_on_fd(self, fd: int, key: str, max_bytes: int) -> bytes:
+        """One non-waiting get on a specific client fd (bulk path)."""
+        buf = ctypes.create_string_buffer(max_bytes)
+        n = self._lib.tcpstore_get(fd, key.encode(), buf, len(buf))
+        if n == -2:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key}) failed")
+        return buf.raw[:n]
+
+    def _bulk_pool(self, n: int):
+        with self._bulk_lock:
+            while len(self._bulk_fds) < n:
+                fd = self._lib.tcpstore_connect(
+                    self.host.encode(), self.port,
+                    int(min(self.timeout, 10.0) * 1000))
+                if fd < 0:
+                    break
+                self._bulk_fds.append(fd)
+            return list(self._bulk_fds)
+
+    def get_many(self, keys, max_bytes: int = 1 << 20,
+                 parallel: int = 4):
+        """Fetch several present keys, overlapping round trips across a
+        small pool of dedicated connections (the bulk-restore path for
+        peer snapshots).  Returns values in key order; falls back to
+        sequential gets when the pool can't be built."""
+        keys = list(keys)
+        if len(keys) < 2:
+            return [self.get(k, wait=False, max_bytes=max_bytes)
+                    for k in keys]
+        fds = self._bulk_pool(min(parallel, len(keys)))
+        if not fds:
+            return [self.get(k, wait=False, max_bytes=max_bytes)
+                    for k in keys]
+        out = [None] * len(keys)
+
+        def fetch(fd, i):
+            out[i] = self._get_on_fd(fd, keys[i], max_bytes)
+        self._bulk_run(fds, keys, fetch)
+        return out
+
+    def _bulk_run(self, fds, keys, fetch):
+        errs = []
+
+        def worker(slot: int):
+            fd = fds[slot]
+            for i in range(slot, len(keys), len(fds)):
+                try:
+                    fetch(fd, i)
+                except Exception as e:  # noqa: BLE001 — re-raised below
+                    errs.append(e)
+                    return
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(len(fds))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def _get_into_fd(self, fd: int, key: str, view) -> int:
+        """Non-waiting get received DIRECTLY into a writable buffer
+        slice — no intermediate ctypes buffer, no copy."""
+        buf = (ctypes.c_char * len(view)).from_buffer(view)
+        n = self._lib.tcpstore_get(fd, key.encode(), buf, len(view))
+        if n == -2:
+            raise KeyError(key)
+        if n < 0:
+            raise RuntimeError(f"TCPStore.get({key}) failed")
+        return n
+
+    def get_many_into(self, keys, views, parallel: int = 4):
+        """Zero-copy bulk fetch: each present key's value lands in its
+        (exactly-sized) writable view, round trips overlapped across
+        the bulk connection pool.  The peer-snapshot restore path:
+        parts land at their final offsets in one preallocated buffer.
+        Returns the per-key byte counts."""
+        keys, views = list(keys), list(views)
+        counts = [0] * len(keys)
+        fds = self._bulk_pool(min(parallel, len(keys))) or [self._fd]
+
+        def fetch(fd, i):
+            counts[i] = self._get_into_fd(fd, keys[i], views[i])
+        self._bulk_run(fds, keys, fetch)
+        return counts
+
     def add(self, key: str, amount: int = 1) -> int:
+        """Atomic counter add, retry-safe: each call mints one op-id
+        token reused across its bounded retries, so a lost response
+        retried never double-counts.  ``amount=0`` (a pure read) skips
+        the token — naturally idempotent, no ledger churn."""
         with self._tracer.span("store.add", key=key,
                                root_eligible=False):
-            v = self._lib.tcpstore_add(self._fd, key.encode(), amount)
-            if v == -(2 ** 63):
-                raise RuntimeError("TCPStore.add failed")
-            return int(v)
+            if amount == 0:
+                def attempt_read():
+                    v = self._lib.tcpstore_add(self._fd, key.encode(), 0)
+                    if v == -(2 ** 63):
+                        raise RuntimeError("TCPStore.add failed")
+                    return int(v)
+                return self._retry_op("add", attempt_read)
+            global _ADD_SEQ
+            with _ADD_SEQ_LOCK:
+                _ADD_SEQ += 1
+                seq = _ADD_SEQ
+            token = f"{_ADD_NONCE}-{os.getpid()}-{seq}"
+            return self._retry_op(
+                "add", lambda: self._add_once(key, amount, token))
 
     def check(self, key: str) -> bool:
         def attempt():
@@ -210,6 +351,10 @@ class TCPStore:
                 time.sleep(0.01)
 
     def close(self):
+        with self._bulk_lock:
+            for fd in self._bulk_fds:
+                self._lib.tcpstore_close(fd)
+            self._bulk_fds.clear()
         if self._fd is not None and self._fd >= 0:
             self._lib.tcpstore_close(self._fd)
             self._fd = -1

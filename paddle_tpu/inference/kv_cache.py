@@ -642,18 +642,22 @@ def serialize_handoff(payload: dict) -> bytes:
     return len(head).to_bytes(8, "big") + head + b"".join(chunks)
 
 
-def deserialize_handoff(data: bytes) -> dict:
-    """Inverse of :func:`serialize_handoff` (v1 and v2 payloads)."""
+def deserialize_handoff(data) -> dict:
+    """Inverse of :func:`serialize_handoff` (v1 and v2 payloads).
+    Accepts any bytes-like (bytes, bytearray, memoryview): arrays are
+    zero-copy views into the buffer — a bulk consumer (peer-snapshot
+    restore) decodes tens of MB without re-copying it."""
     import json as _json
-    hlen = int.from_bytes(data[:8], "big")
-    meta = _json.loads(data[8:8 + hlen].decode())
+    mv = memoryview(data)
+    hlen = int.from_bytes(mv[:8], "big")
+    meta = _json.loads(bytes(mv[8:8 + hlen]).decode())
     off = 8 + hlen
     arrays: Dict[str, np.ndarray] = {}
     for ent in meta["arrays"]:
         dt = _dtype_of(ent["dtype"])
         n = int(np.prod(ent["shape"], dtype=np.int64)) * dt.itemsize
         arrays[ent["name"]] = np.frombuffer(
-            data[off:off + n], dtype=dt).reshape(ent["shape"])
+            mv[off:off + n], dtype=dt).reshape(ent["shape"])
         off += n
     out: dict = {k: v for k, v in meta["scalars"].items()
                  if k not in ("kv_block_size", "kv_dtype")}

@@ -172,6 +172,13 @@ class CompiledStepBase:
         out = {"params": jax.tree.map(np.asarray, self.params),
                "opt_state": jax.tree.map(np.asarray, self.opt_state),
                "step": int(self.step_count)}
+        # the dropout RNG chain rides along (when the subclass keeps
+        # one) so a restored run's loss trajectory is bitwise identical
+        # to the uninterrupted run — the property the peer-recovery
+        # MTTR drill (bench --recovery-drill) asserts
+        key = getattr(self, "_key", None)
+        if key is not None:
+            out["rng_key"] = np.asarray(key)
         if self.optimizer._lr_scheduler is not None:
             out["lr_scheduler"] = self.optimizer._lr_scheduler.state_dict()
         return out
@@ -193,6 +200,9 @@ class CompiledStepBase:
         self.opt_state = {n: put_st(n, st)
                           for n, st in state["opt_state"].items()}
         self.step_count = jnp.asarray(state["step"], jnp.int32)
+        if "rng_key" in state and hasattr(self, "_key"):
+            self._key = jnp.asarray(np.asarray(state["rng_key"]),
+                                    jnp.uint32)
         if "lr_scheduler" in state and \
                 self.optimizer._lr_scheduler is not None:
             self.optimizer._lr_scheduler.set_state_dict(state["lr_scheduler"])

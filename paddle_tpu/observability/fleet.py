@@ -655,6 +655,17 @@ class FleetAggregator:
         attainment, staleness), plus the straggler footer — hosts whose
         step-time EMA sits above the fleet median."""
         roster = self.hosts()
+        # SDC quarantine roster (robustness.recovery): a blamed host's
+        # row renders QUAR instead of up/STALE — the operator sees the
+        # exclusion in the same glance as the fleet it protects
+        quarantined = set()
+        if self.store is not None:
+            try:
+                from paddle_tpu.robustness.recovery import \
+                    quarantined_hosts
+                quarantined = set(quarantined_hosts(self.store))
+            except Exception:
+                pass
         header = (f"{'host':<14} {'up':<6} {'age_s':>6} {'gen':>4} "
                   f"{'restarts':>8} {'steps':>7} {'step_ms':>8} "
                   f"{'goodput':>8} {'role':>8} {'queue':>6} "
@@ -688,9 +699,11 @@ class FleetAggregator:
                 if v is None:
                     return "-"
                 return f"{v * 100:.1f}%" if pct else f"{v * scale:.2f}"
+            status = ("QUAR" if host in quarantined
+                      else "STALE" if info["stale"] else "up")
             lines.append(
                 f"{host:<14} "
-                f"{('STALE' if info['stale'] else 'up'):<6} "
+                f"{status:<6} "
                 f"{info['age_s']:>6.1f} "
                 f"{str(info.get('generation') or '-'):>4} "
                 f"{str(info.get('restarts') or '0'):>8} "

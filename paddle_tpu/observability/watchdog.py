@@ -39,10 +39,18 @@ Built-in rule types (see ``default_rules()``):
                       registry carries; silent under ``min_hosts``)
 ``goodput_floor``     ``paddle_tpu_goodput`` below ``floor`` on any
                       host whose wall clock has run ``min_wall_s``
+``restart_storm``     elastic restarts rising faster than ``max_delta``
+                      per interval (per host after federation) —
+                      generations churning instead of training
+``mttr``              mean recovery gap per restart over the last
+                      interval (elastic downtime delta / restart
+                      delta) above ``target_s`` — recovery slower
+                      than the MTTR budget (stale peer snapshots, or
+                      fell back to the disk-restore path)
 =================  =======================================================
 
-The two fleet rules are registered in ``RULE_TYPES`` (spec-string /
-env constructible) but NOT in ``default_rules()`` — they only make
+The fleet-flavored rules are registered in ``RULE_TYPES`` (spec-string
+/ env constructible) but NOT in ``default_rules()`` — they only make
 sense against a registry carrying fleet gauges (a single process, or
 an aggregator's ``merged_registry()`` where gauges are host-labeled).
 
@@ -70,7 +78,8 @@ from typing import Dict, List, Optional
 __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
            "MfuDriftRule", "CompileStormRule", "StragglerRule",
-           "GoodputFloorRule", "SloAttainmentRule",
+           "GoodputFloorRule", "SloAttainmentRule", "RestartStormRule",
+           "MttrRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -463,6 +472,110 @@ class SloAttainmentRule(Rule):
                    if len(breaching) > 1 else ""))
 
 
+def _sums_by_host(metric) -> Dict[str, float]:
+    """Per-host SUM over a metric's series (a counter with extra labels
+    — reason, cause — collapses to one progress number per host; no
+    ``host`` label yields one entry keyed ``""``)."""
+    out: Dict[str, float] = {}
+    names = metric.labelnames
+    for values, child in metric.series():
+        labels = dict(zip(names, values))
+        v = child.value()
+        if v != v:
+            continue
+        h = labels.get("host", "")
+        out[h] = out.get(h, 0.0) + v
+    return out
+
+
+class RestartStormRule(Rule):
+    """Elastic restarts (``paddle_tpu_elastic_restarts_total``, summed
+    over reasons) rising faster than ``max_delta`` per interval on any
+    host — the job is churning generations instead of training.  Works
+    on a single process and, host-labeled on a fleet aggregator's
+    merged registry, names the flapping host."""
+
+    def __init__(self, metric: str = "paddle_tpu_elastic_restarts_total",
+                 max_delta: float = 3, name: str = "restart_storm"):
+        self.name = name
+        self.metric = metric
+        self.max_delta = float(max_delta)
+        self._last: Dict[str, float] = {}
+
+    def evaluate(self, registry, now):
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        per_host = _sums_by_host(m)
+        worst: Optional[tuple] = None
+        for host, value in per_host.items():
+            last = self._last.get(host)
+            self._last[host] = value
+            if last is None:
+                continue
+            delta = value - last
+            if delta > self.max_delta and \
+                    (worst is None or delta > worst[1]):
+                worst = (host, delta)
+        if worst is None:
+            return None
+        host, delta = worst
+        who = f"host {host}" if host else "this job"
+        return (f"{int(delta)} elastic restarts in one interval on "
+                f"{who} (> {self.max_delta:g}) — generations are "
+                "churning, not training")
+
+
+class MttrRule(Rule):
+    """Mean recovery gap per restart over the last interval —
+    ``paddle_tpu_elastic_downtime_seconds_total`` delta divided by the
+    restart-count delta — above ``target_s`` on any host: recovery is
+    slower than the MTTR budget (peer snapshots stale/missing, or the
+    job fell back to the disk-restore path).  Silent in intervals with
+    no fresh restarts; host-aware like :class:`StragglerRule`."""
+
+    def __init__(self,
+                 gap_metric: str =
+                 "paddle_tpu_elastic_downtime_seconds_total",
+                 restarts_metric: str =
+                 "paddle_tpu_elastic_restarts_total",
+                 target_s: float = 30.0, name: str = "mttr"):
+        self.name = name
+        self.gap_metric = gap_metric
+        self.restarts_metric = restarts_metric
+        self.target_s = float(target_s)
+        self._last_gap: Dict[str, float] = {}
+        self._last_restarts: Dict[str, float] = {}
+
+    def evaluate(self, registry, now):
+        gm = registry.get(self.gap_metric)
+        rm = registry.get(self.restarts_metric)
+        if gm is None or rm is None:
+            return None
+        gaps, restarts = _sums_by_host(gm), _sums_by_host(rm)
+        worst: Optional[tuple] = None
+        for host in set(gaps) | set(restarts):
+            g, r = gaps.get(host, 0.0), restarts.get(host, 0.0)
+            lg = self._last_gap.get(host)
+            lr = self._last_restarts.get(host)
+            self._last_gap[host], self._last_restarts[host] = g, r
+            if lg is None or lr is None:
+                continue           # first sight of this host: seed only
+            dr = r - lr
+            if dr <= 0:
+                continue           # no fresh restarts to judge
+            mttr = (g - lg) / dr
+            if mttr > self.target_s and \
+                    (worst is None or mttr > worst[1]):
+                worst = (host, mttr, dr)
+        if worst is None:
+            return None
+        host, mttr, dr = worst
+        who = f"host {host}" if host else "this job"
+        return (f"mean recovery gap {mttr:.1f}s over {int(dr)} "
+                f"restart(s) on {who} > MTTR target {self.target_s:g}s")
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
@@ -474,6 +587,8 @@ RULE_TYPES = {
     "straggler": StragglerRule,
     "goodput_floor": GoodputFloorRule,
     "slo_attainment": SloAttainmentRule,
+    "restart_storm": RestartStormRule,
+    "mttr": MttrRule,
 }
 
 

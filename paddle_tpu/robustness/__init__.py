@@ -13,8 +13,16 @@ The subsystem has two halves (ISSUE 4 tentpole; see README.md here):
   backoff and a circuit breaker, serving deadlines/admission
   bounds/engine-step recovery, dataloader worker-crash surfacing.
 
-Chaos tests (tests/test_robustness.py) inject each catalogued fault
-through the registry and assert the system recovers.
+A third half grew out of ISSUE 14: **fast recovery**
+(:mod:`paddle_tpu.robustness.recovery`) — peer-replicated in-memory
+snapshots (restore = a RAM fetch from a ring buddy, not a disk walk),
+SDC sentinels (cross-replica digest checks with deterministic-replay
+blame attribution + host quarantine), and the MTTR benchmark drill
+(``bench.py --recovery-drill``).
+
+Chaos tests (tests/test_robustness.py, tests/test_recovery.py) inject
+each catalogued fault through the registry and assert the system
+recovers.
 """
 
 from __future__ import annotations
@@ -23,9 +31,18 @@ from paddle_tpu.robustness.faults import (  # noqa: F401
     FaultRegistry, FaultSpec, InjectedFault, NonFiniteStepError,
     QueueFullError, clear_faults, fault_fires, fault_point, fault_registry,
     fault_stats, inject, reset_registry)
+from paddle_tpu.robustness import recovery  # noqa: F401
+from paddle_tpu.robustness.recovery import (  # noqa: F401
+    PeerSnapshotter, SDCSentinel, buddy_map, buddy_of,
+    deterministic_replay, is_quarantined, params_digest, quarantine_host,
+    quarantined_hosts, restore_from_peers, resume_train_state)
 
 __all__ = [
     "FaultRegistry", "FaultSpec", "InjectedFault", "NonFiniteStepError",
     "QueueFullError", "clear_faults", "fault_fires", "fault_point",
     "fault_registry", "fault_stats", "inject", "reset_registry",
+    "recovery", "PeerSnapshotter", "SDCSentinel", "buddy_map", "buddy_of",
+    "deterministic_replay", "is_quarantined", "params_digest",
+    "quarantine_host", "quarantined_hosts", "restore_from_peers",
+    "resume_train_state",
 ]

@@ -62,6 +62,22 @@ point                                 site
                                       aggregator must degrade to marking
                                       the host stale while still serving
                                       fleet metrics
+``recovery.snapshot_ship``            fails a peer-snapshot ship to the
+                                      ring buddy (store down / network
+                                      loss); training continues, the
+                                      previous snapshot stays serveable
+``recovery.peer_fetch``               fails the peer-RAM state fetch at
+                                      resume; recovery must fall back to
+                                      the disk checkpoint
+``train.sdc_flip``                    flips one bit of the params the
+                                      SDC sentinel digests (bool-style:
+                                      the silently-corrupting host the
+                                      cross-replica check must catch,
+                                      blame, and quarantine)
+``recovery.rank_kill``                declares a training rank dead
+                                      mid-run (bool-style; the trigger
+                                      ``bench.py --recovery-drill`` arms
+                                      to measure MTTR)
 ====================================  =====================================
 
 Env syntax (comma-separated specs, colon-separated options)::

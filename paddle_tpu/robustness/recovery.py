@@ -1,0 +1,774 @@
+"""Fast-recovery training: peer-replicated in-memory checkpoints + SDC
+sentinels (ISSUE 14 tentpole).
+
+The fleet observability plane measures lost goodput
+(``paddle_tpu_elastic_downtime_seconds_total``); this module *shrinks*
+it, and adds the detector TPU fleets fear most being without: silent
+data corruption.
+
+Three pieces:
+
+* **Peer-replicated snapshots** — :class:`PeerSnapshotter` serializes a
+  rank's param/optimizer shard every ``interval_steps`` steps using the
+  PR-12 handoff wire format (raw little-endian buffers + JSON head, no
+  pickle anywhere) and ships it to a **buddy rank** chosen ring-wise
+  (``buddy = (rank + 1) % world``) through the TCPStore — the store
+  outlives worker generations exactly like the elastic manager does, so
+  a relaunched rank finds its predecessor's shard still resident in
+  fleet RAM.  :func:`restore_from_peers` turns recovery into a RAM
+  fetch + buffer decode instead of a disk walk; callers fall back to
+  :meth:`AutoCheckpoint.restore_latest` only when no peer holds a fresh
+  snapshot (:func:`resume_train_state` does the whole dance).
+
+* **SDC sentinels** — :class:`SDCSentinel` publishes a jitted bitwise
+  checksum of the params (plus any extra arrays, e.g. the grad norm)
+  and compares it across DP peers through the store.  Under pure data
+  parallelism every replica holds bitwise-identical state, so ANY
+  digest divergence is silent corruption on some host.  A mismatch
+  increments ``paddle_tpu_sdc_detected_total{host}``, dumps the flight
+  recorder, and attributes blame: majority vote across >= 3 peers, or a
+  **deterministic replay** (re-run the divergent step from the last
+  peer snapshot — the replayed digest is ground truth because SDC is
+  transient) when the vote ties or confirmation is requested.  The
+  blamed host is quarantined via the shared roster
+  (:func:`quarantine_host`); a quarantined
+  :class:`~paddle_tpu.distributed.elastic.MultiNodeElasticAgent` sits
+  out the next rendezvous, so training continues on the
+  quarantined-host-excluded fleet.
+
+Fault points (chaos-tested in tests/test_recovery.py):
+
+* ``recovery.snapshot_ship`` — the ship to the buddy fails; the
+  snapshotter counts the error and keeps training (the previous
+  snapshot stays serveable, staleness grows).
+* ``recovery.peer_fetch`` — the peer fetch fails; restore falls back
+  to the disk checkpoint.
+* ``train.sdc_flip`` — flips one mantissa bit of the digested params
+  (the injectable silently-corrupting host).
+* ``recovery.rank_kill`` — bool-style mid-run rank death, the trigger
+  ``bench.py --recovery-drill`` arms.
+
+Wire format: a snapshot is the nested state_dict flattened to indexed
+arrays plus a JSON ``tree`` scalar that records where each array goes
+back, serialized by :func:`paddle_tpu.inference.kv_cache.
+serialize_handoff` and split into <= ``chunk_bytes`` store values (the
+store's get path reads into a bounded buffer).  A crc32 over the whole
+blob rides in the metadata key; a failed check is treated exactly like
+an absent snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "pack_state", "unpack_state", "flatten_for_checkpoint",
+    "unflatten_from_checkpoint", "buddy_of", "buddy_map",
+    "PeerSnapshotter", "restore_from_peers", "resume_train_state",
+    "params_digest", "deterministic_replay", "SDCSentinel",
+    "quarantine_host", "quarantined_hosts", "is_quarantined",
+    "clear_quarantine", "snapshotter_from_env",
+]
+
+_SNAP_PREFIX = "recovery"
+_QUAR_ROSTER = "recovery/quarantined"
+# snapshots are bulk payloads: 8 MiB chunks sit at the store's
+# throughput sweet spot, and the fetch path overlaps them across the
+# client's bulk connection pool (TCPStore.get_many); LocalStore and
+# other dict stores are unaffected by chunk size
+DEFAULT_CHUNK_BYTES = 8 * 1024 * 1024
+
+
+def _recovery_metrics():
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "snapshots": reg.counter(
+            "paddle_tpu_recovery_snapshots_total",
+            "peer snapshots shipped (one per rank per cadence tick)"),
+        "snapshot_errors": reg.counter(
+            "paddle_tpu_recovery_snapshot_errors_total",
+            "peer-snapshot ships that failed (store down, fault "
+            "injection) — training continues, staleness grows"),
+        "snapshot_bytes": reg.gauge(
+            "paddle_tpu_recovery_snapshot_bytes",
+            "serialized size of this rank's latest peer snapshot"),
+        "snapshot_s": reg.histogram(
+            "paddle_tpu_recovery_snapshot_seconds",
+            "wall time serializing + shipping one peer snapshot",
+            buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2, 10)),
+        "restores": reg.counter(
+            "paddle_tpu_recovery_restores_total",
+            "post-failure state restores by path (peer RAM fetch vs "
+            "disk checkpoint fallback)", labelnames=("path",)),
+        "restore_s": reg.histogram(
+            "paddle_tpu_recovery_restore_seconds",
+            "wall time of the restore path (fetch + decode, or the "
+            "disk validate + load fallback)",
+            buckets=(0.001, 0.005, 0.02, 0.1, 0.5, 2, 10, 60)),
+        "sdc": reg.counter(
+            "paddle_tpu_sdc_detected_total",
+            "cross-replica digest mismatches — silent data corruption "
+            "detected, labeled by the blamed host ('' while "
+            "unattributed)", labelnames=("host",)),
+        "quarantined": reg.counter(
+            "paddle_tpu_host_quarantined_total",
+            "hosts quarantined after blame attribution",
+            labelnames=("host",)),
+    }
+
+
+# -- state <-> wire ----------------------------------------------------------
+
+def _flatten_state(state) -> Tuple[Any, Dict[str, np.ndarray]]:
+    """Nested dict/list state -> (tree spec, {"t<i>": array}).  Arrays
+    become ``{"__t__": i}`` markers in the spec; JSON-native scalars
+    stay in place."""
+    arrays: Dict[str, np.ndarray] = {}
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            return {str(k): walk(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [walk(v) for v in obj]
+        if obj is None or isinstance(obj, (bool, int, float, str)):
+            return obj
+        a = np.asarray(obj)
+        idx = len(arrays)
+        arrays[f"t{idx}"] = a
+        return {"__t__": idx}
+
+    return walk(state), arrays
+
+
+def _unflatten_state(tree, arrays: Dict[str, np.ndarray]):
+    def walk(obj):
+        if isinstance(obj, dict):
+            if set(obj) == {"__t__"}:
+                return arrays[f"t{obj['__t__']}"]
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [walk(v) for v in obj]
+        return obj
+
+    return walk(tree)
+
+
+def flatten_for_checkpoint(state) -> Dict[str, np.ndarray]:
+    """Nested state_dict -> the flat ``{name: array}`` shape
+    :func:`paddle_tpu.distributed.checkpoint.save_state_dict` expects.
+    Array names are readable slash-joined paths; the authoritative
+    structure (including JSON-native scalars like ``step``) rides a
+    ``__tree__`` uint8 array, so :func:`unflatten_from_checkpoint`
+    rebuilds the exact nesting regardless of separator collisions."""
+    arrays: Dict[str, np.ndarray] = {}
+
+    def walk(obj, path):
+        if isinstance(obj, dict):
+            return {str(k): walk(v, path + [str(k)])
+                    for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [walk(v, path + [str(i)]) for i, v in enumerate(obj)]
+        if obj is None or isinstance(obj, (bool, int, float, str)):
+            return obj
+        name = "/".join(path) or "value"
+        while name in arrays:
+            name += "_"
+        arrays[name] = np.asarray(obj)
+        return {"__t__": name}
+
+    tree = walk(state, [])
+    flat = dict(arrays)
+    flat["__tree__"] = np.frombuffer(
+        json.dumps(tree).encode(), dtype=np.uint8).copy()
+    return flat
+
+
+def unflatten_from_checkpoint(flat: Dict[str, Any]):
+    """Inverse of :func:`flatten_for_checkpoint` (accepts the jnp
+    arrays a checkpoint load returns)."""
+    tree = json.loads(bytes(
+        np.asarray(flat["__tree__"]).tobytes()).decode())
+
+    def walk(obj):
+        if isinstance(obj, dict):
+            if set(obj) == {"__t__"}:
+                return np.asarray(flat[obj["__t__"]])
+            return {k: walk(v) for k, v in obj.items()}
+        if isinstance(obj, list):
+            return [walk(v) for v in obj]
+        return obj
+
+    return walk(tree)
+
+
+def pack_state(state, **scalars) -> bytes:
+    """Serialize a nested state_dict (arrays at the leaves) into one
+    bytes blob on the PR-12 handoff wire format — raw little-endian
+    buffers + a JSON head, bfloat16 via ml_dtypes, no pickle.  Extra
+    ``scalars`` (step, rank, ...) ride the head."""
+    from paddle_tpu.inference.kv_cache import serialize_handoff
+    tree, arrays = _flatten_state(state)
+    payload: Dict[str, Any] = {"tree": json.dumps(tree)}
+    payload.update({k: v for k, v in scalars.items()})
+    payload.update(arrays)
+    return serialize_handoff(payload)
+
+
+def unpack_state(data: bytes) -> Tuple[Any, Dict[str, Any]]:
+    """Inverse of :func:`pack_state`: returns ``(state, scalars)``."""
+    from paddle_tpu.inference.kv_cache import deserialize_handoff
+    payload = deserialize_handoff(data)
+    tree = json.loads(payload.pop("tree"))
+    arrays = {k: v for k, v in payload.items()
+              if isinstance(v, np.ndarray)}
+    scalars = {k: v for k, v in payload.items() if k not in arrays}
+    return _unflatten_state(tree, arrays), scalars
+
+
+# -- buddy topology ----------------------------------------------------------
+
+def buddy_of(rank: int, world_size: int, offset: int = 1) -> int:
+    """Ring-wise buddy: the rank that mirrors `rank`'s shard.  With the
+    default offset every rank holds exactly one peer's state and the
+    ring crosses hosts whenever ranks are laid out host-major — a
+    single host loss never takes a shard AND its mirror."""
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    return (rank + offset) % world_size
+
+
+def buddy_map(world_size: int, offset: int = 1) -> Dict[int, int]:
+    return {r: buddy_of(r, world_size, offset) for r in range(world_size)}
+
+
+# -- peer snapshots ----------------------------------------------------------
+
+class PeerSnapshotter:
+    """Ships this rank's state to its ring buddy through the store
+    every ``interval_steps`` optimizer steps.
+
+    The store plays the role of the buddy's host RAM (it outlives
+    worker generations, exactly like the elastic manager that hosts
+    it); :meth:`fetch_buddy` additionally mirrors the buddy's blob into
+    THIS process's memory, so a surviving rank can re-serve its dead
+    buddy's shard even across a store migration."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 interval_steps: int = 10, prefix: str = _SNAP_PREFIX,
+                 generation: int = 0,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES):
+        if interval_steps < 1:
+            raise ValueError("interval_steps must be >= 1, got "
+                             f"{interval_steps}")
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.buddy = buddy_of(self.rank, self.world_size)
+        self.interval = int(interval_steps)
+        self.prefix = prefix
+        self.generation = int(generation)
+        self.chunk_bytes = int(chunk_bytes)
+        self.last_step: Optional[int] = None
+        self._held: Dict[int, bytes] = {}   # peer rank -> mirrored blob
+        self._metrics = _recovery_metrics()
+
+    # -- ship ---------------------------------------------------------------
+    def maybe_snapshot(self, step: int, state) -> bool:
+        """Cadence gate: ship when ``step`` hits the interval.  Returns
+        True when a snapshot was shipped."""
+        if step % self.interval:
+            return False
+        return self.snapshot(step, state)
+
+    def snapshot(self, step: int, state) -> bool:
+        """Serialize + ship now.  A failed ship (store down, armed
+        ``recovery.snapshot_ship``) is counted and absorbed — the
+        previous snapshot stays serveable and training continues; the
+        cost of the miss is staleness, not a crash."""
+        from paddle_tpu.observability import flight_recorder
+        from paddle_tpu.robustness import fault_point
+        t0 = time.perf_counter()
+        blob = pack_state(state, step=int(step), rank=self.rank,
+                          generation=self.generation)
+        try:
+            fault_point("recovery.snapshot_ship", rank=self.rank,
+                        step=int(step))
+            _ship_blob(self.store, f"{self.prefix}/snap/{self.rank}",
+                       blob, self.chunk_bytes,
+                       meta={"step": int(step), "rank": self.rank,
+                             "generation": self.generation,
+                             "time": time.time()})
+        except RuntimeError as e:
+            self._metrics["snapshot_errors"].inc()
+            flight_recorder().record("recovery.snapshot_failed",
+                                     rank=self.rank, step=int(step),
+                                     error=type(e).__name__)
+            return False
+        self.last_step = int(step)
+        self._metrics["snapshots"].inc()
+        self._metrics["snapshot_bytes"].set(len(blob))
+        self._metrics["snapshot_s"].observe(time.perf_counter() - t0)
+        flight_recorder().record("recovery.snapshot", rank=self.rank,
+                                 step=int(step), bytes=len(blob))
+        return True
+
+    # -- the buddy's mirror -------------------------------------------------
+    def fetch_buddy(self) -> Optional[int]:
+        """Pull the buddy's current snapshot into this process's RAM
+        (the literal peer-replication hop).  Returns the mirrored step,
+        or None when the buddy has not snapshotted yet."""
+        got = _fetch_blob(self.store, f"{self.prefix}/snap/{self.buddy}")
+        if got is None:
+            return None
+        blob, meta = got
+        self._held[self.buddy] = blob
+        return int(meta.get("step", -1))
+
+    def serve_held(self, rank: Optional[int] = None):
+        """Re-publish a mirrored peer blob (store migrated / key lost):
+        the surviving buddy is the source of truth for its dead peer."""
+        rank = self.buddy if rank is None else int(rank)
+        blob = self._held.get(rank)
+        if blob is None:
+            raise KeyError(f"no mirrored snapshot held for rank {rank}")
+        _, scalars = unpack_state(blob)
+        _ship_blob(self.store, f"{self.prefix}/snap/{rank}", blob,
+                   self.chunk_bytes,
+                   meta={"step": int(scalars.get("step", -1)),
+                         "rank": rank,
+                         "generation": int(scalars.get("generation", 0)),
+                         "time": time.time()})
+
+
+def _ship_blob(store, base: str, blob: bytes, chunk_bytes: int,
+               meta: Dict[str, Any]):
+    """Chunked publish: parts first, metadata (part count + per-part
+    adler32 sums + total length) last — a reader that sees the meta key
+    sees complete parts, and a torn/renamed-over publish verifies as
+    absent rather than decoding into a corrupt state dict."""
+    nparts = max(1, -(-len(blob) // chunk_bytes))
+    sums = []
+    for i in range(nparts):
+        part = blob[i * chunk_bytes:(i + 1) * chunk_bytes]
+        sums.append(zlib.adler32(part) & 0xFFFFFFFF)
+        store.set(f"{base}/p{i}", part)
+    meta = dict(meta)
+    meta.update({"nparts": nparts, "bytes": len(blob),
+                 "chunk_bytes": chunk_bytes, "adler32": sums})
+    store.set(f"{base}/meta", json.dumps(meta).encode())
+
+
+def _fetch_blob(store, base: str) -> Optional[Tuple[bytes, dict]]:
+    """None when absent OR integrity-failed (logged) — a corrupt peer
+    snapshot must route the caller to the disk fallback, never into a
+    half-decoded state dict.  Parts ride the store's parallel bulk-read
+    pool when it has one (``get_many``)."""
+    from paddle_tpu.observability import flight_recorder
+    if not store.check(f"{base}/meta"):
+        return None
+    try:
+        meta = json.loads(store.get(f"{base}/meta", wait=False).decode())
+        chunk = int(meta.get("chunk_bytes", DEFAULT_CHUNK_BYTES))
+        nparts, total = int(meta["nparts"]), int(meta["bytes"])
+        keys = [f"{base}/p{i}" for i in range(nparts)]
+        if hasattr(store, "get_many_into") and total > 0:
+            # zero-copy path: every part recv'd straight into its final
+            # offset of one preallocated buffer (no per-part buffers,
+            # no join)
+            blob = bytearray(total)
+            views = [memoryview(blob)[i * chunk:
+                                      min((i + 1) * chunk, total)]
+                     for i in range(nparts)]
+            counts = store.get_many_into(keys, views)
+            parts = [v[:c] for v, c in zip(views, counts)]
+        else:
+            parts = [store.get(k, wait=False) for k in keys]
+            blob = parts[0] if len(parts) == 1 else b"".join(parts)
+    except Exception as e:  # noqa: BLE001 — absent part == absent snapshot
+        flight_recorder().record("recovery.fetch_failed", key=base,
+                                 error=type(e).__name__)
+        return None
+    sums = meta.get("adler32") or []
+    ok = len(parts) == len(sums) and \
+        sum(len(p) for p in parts) == total and \
+        all((zlib.adler32(p) & 0xFFFFFFFF) == int(s)
+            for p, s in zip(parts, sums))
+    if not ok:
+        flight_recorder().record("recovery.fetch_corrupt", key=base,
+                                 bytes=sum(len(p) for p in parts))
+        return None
+    return blob, meta
+
+
+def restore_from_peers(store, rank: int, prefix: str = _SNAP_PREFIX
+                       ) -> Optional[Tuple[int, Any, dict]]:
+    """Fetch rank's latest peer-replicated snapshot: ``(step, state,
+    meta)``, or None when no peer holds a fresh, intact one (absent,
+    torn, or an armed ``recovery.peer_fetch`` fault) — the caller falls
+    back to the disk checkpoint."""
+    from paddle_tpu.observability import flight_recorder
+    from paddle_tpu.robustness import fault_point
+    try:
+        fault_point("recovery.peer_fetch", rank=int(rank))
+        got = _fetch_blob(store, f"{prefix}/snap/{rank}")
+    except RuntimeError as e:
+        flight_recorder().record("recovery.peer_fetch_failed",
+                                 rank=int(rank), error=type(e).__name__)
+        return None
+    if got is None:
+        return None
+    blob, meta = got
+    state, scalars = unpack_state(blob)
+    return int(scalars.get("step", meta.get("step", -1))), state, meta
+
+
+def resume_train_state(store, rank: int, auto_ckpt=None,
+                       prefix: str = _SNAP_PREFIX, mesh=None, specs=None
+                       ) -> Tuple[Optional[int], Any, str]:
+    """The one-stop post-failure resume: peer RAM first, disk second.
+
+    Returns ``(step, state, restore_path)`` with ``restore_path`` in
+    ``{"peer", "disk", "none"}``; records the path + wall time to the
+    restore metrics and the flight recorder, so the goodput ledger's
+    (already-debited) elastic gap can be attributed to the path that
+    ended it."""
+    from paddle_tpu.observability import flight_recorder
+    m = _recovery_metrics()
+    t0 = time.perf_counter()
+    if store is not None:
+        peer = restore_from_peers(store, rank, prefix=prefix)
+        if peer is not None:
+            step, state, _meta = peer
+            dt = time.perf_counter() - t0
+            m["restores"].labels(path="peer").inc()
+            m["restore_s"].observe(dt)
+            flight_recorder().record("recovery.restore", rank=int(rank),
+                                     path="peer", step=step,
+                                     seconds=round(dt, 4))
+            return step, state, "peer"
+    if auto_ckpt is not None:
+        step, state = auto_ckpt.restore_latest(mesh=mesh, specs=specs)
+        if isinstance(state, dict) and "__tree__" in state:
+            state = unflatten_from_checkpoint(state)
+        if step is not None:
+            dt = time.perf_counter() - t0
+            m["restores"].labels(path="disk").inc()
+            m["restore_s"].observe(dt)
+            flight_recorder().record("recovery.restore", rank=int(rank),
+                                     path="disk", step=step,
+                                     seconds=round(dt, 4))
+            return step, state, "disk"
+    flight_recorder().record("recovery.restore", rank=int(rank),
+                             path="none")
+    return None, None, "none"
+
+
+def snapshotter_from_env(store=None, interval_steps: Optional[int] = None
+                         ) -> Optional[PeerSnapshotter]:
+    """Build the worker-side snapshotter from the env the elastic
+    manager sets (``PADDLE_TPU_RECOVERY=peer`` + the elastic store /
+    rank / world vars).  None when peer recovery is not enabled."""
+    if os.environ.get("PADDLE_TPU_RECOVERY") != "peer":
+        return None
+    if store is None:
+        addr = os.environ.get("PADDLE_ELASTIC_STORE")
+        if not addr:
+            return None
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        host, port = addr.rsplit(":", 1)
+        store = TCPStore(host, int(port), is_master=False)
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if interval_steps is None:
+        interval_steps = int(os.environ.get(
+            "PADDLE_TPU_SNAPSHOT_INTERVAL", "10"))
+    gen = int(os.environ.get("PADDLE_ELASTIC_GEN", "0"))
+    return PeerSnapshotter(store, rank, world,
+                           interval_steps=interval_steps,
+                           generation=gen)
+
+
+# -- SDC sentinels -----------------------------------------------------------
+
+_DIGEST_CACHE: Dict[Any, Any] = {}
+
+
+def _digest_impl(leaves):
+    import jax
+    import jax.numpy as jnp
+    acc = jnp.uint32(2166136261)           # FNV offset basis
+    for x in leaves:
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.complexfloating):
+            x = jnp.stack([x.real, x.imag])
+        if x.dtype == jnp.bool_:
+            x = x.astype(jnp.uint8)
+        nbits = x.dtype.itemsize * 8
+        u = jax.lax.bitcast_convert_type(
+            x, jnp.dtype(f"uint{nbits}")).astype(jnp.uint32)
+        # modular uint32 sum detects any single-bit flip in the leaf;
+        # folding leaf sums with the FNV prime makes the digest
+        # sensitive to which leaf diverged (structure-aware)
+        acc = acc * jnp.uint32(16777619) + jnp.sum(u)
+    return acc
+
+
+def params_digest(tree) -> int:
+    """Jitted bitwise checksum of a pytree of arrays.  Under data
+    parallelism every replica's params are bitwise identical, so equal
+    digests are expected and ANY divergence is silent corruption.  The
+    digest is exact over the stored bits (bitcast, never float math),
+    deterministic across processes, and cached per tree structure."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    key = (treedef, tuple((l.shape, str(np.asarray(l).dtype) if not
+                           hasattr(l, "dtype") else str(l.dtype))
+                          for l in leaves))
+    fn = _DIGEST_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda ls: _digest_impl(ls))
+        _DIGEST_CACHE[key] = fn
+    return int(fn(leaves))
+
+
+def _flip_one_bit(tree):
+    """The injectable SDC: flip one mantissa bit of the first float
+    leaf (a copy — the corruption models the HOST's view of the state,
+    which is exactly what the digest hashes)."""
+    import jax
+    import jax.numpy as jnp
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    flipped = False
+    for x in leaves:
+        x = jnp.asarray(x)
+        if not flipped and x.size and \
+                jnp.issubdtype(x.dtype, jnp.floating):
+            nbits = x.dtype.itemsize * 8
+            u = jax.lax.bitcast_convert_type(
+                x, jnp.dtype(f"uint{nbits}"))
+            flat = u.reshape((-1,))
+            flat = flat.at[0].set(flat[0] ^ jnp.asarray(1, flat.dtype))
+            x = jax.lax.bitcast_convert_type(
+                flat.reshape(u.shape), x.dtype)
+            flipped = True
+        out.append(x)
+    return jax.tree.unflatten(treedef, out)
+
+
+def deterministic_replay(state, run_fn: Callable[[Any], Any]) -> int:
+    """Blame confirmation: re-run the divergent step(s) from the last
+    peer snapshot (``state``) via ``run_fn(state) -> params`` and digest
+    the result.  SDC is transient — the replayed digest is ground
+    truth, so a live peer whose published digest disagrees with it is
+    the corrupting host.  Recorded to the flight recorder either way."""
+    from paddle_tpu.observability import flight_recorder
+    t0 = time.perf_counter()
+    params = run_fn(state)
+    d = params_digest(params)
+    flight_recorder().record("sdc.replay", digest=d,
+                             seconds=round(time.perf_counter() - t0, 4))
+    return d
+
+
+class SDCSentinel:
+    """Periodic cross-replica digest check over the store.
+
+    Two-phase so in-process tests (and lock-step SPMD loops) can drive
+    every rank deterministically: :meth:`publish` ships this rank's
+    digest, :meth:`verify` collects the peers' and judges;
+    :meth:`check` does both with a bounded wait.
+
+    On mismatch: ``paddle_tpu_sdc_detected_total{host}`` increments,
+    the flight recorder dumps, blame is attributed (majority vote; the
+    ``replay`` callable — see :func:`deterministic_replay` — confirms
+    or breaks ties), and the blamed host is quarantined through the
+    shared roster unless ``quarantine=False``."""
+
+    def __init__(self, store, rank: int, dp_peers: Sequence[int],
+                 host: Optional[str] = None, interval_steps: int = 1,
+                 prefix: str = "sdc", timeout: float = 10.0,
+                 quarantine: bool = True):
+        if interval_steps < 1:
+            raise ValueError("interval_steps must be >= 1, got "
+                             f"{interval_steps}")
+        self.store = store
+        self.rank = int(rank)
+        self.dp_peers = sorted(int(r) for r in dp_peers)
+        if self.rank not in self.dp_peers:
+            self.dp_peers.append(self.rank)
+            self.dp_peers.sort()
+        if host is None:
+            from paddle_tpu.observability.fleet import fleet_host_id
+            host = fleet_host_id()
+        self.host = host
+        self.interval = int(interval_steps)
+        self.prefix = prefix
+        self.timeout = float(timeout)
+        self.quarantine = bool(quarantine)
+        self._metrics = _recovery_metrics()
+
+    # -- phase 1: publish ---------------------------------------------------
+    def publish(self, step: int, params, extra=None) -> int:
+        """Digest + publish for ``step``.  An armed ``train.sdc_flip``
+        corrupts the digested view (this host is the silently-bad
+        one).  Returns the published digest."""
+        from paddle_tpu.robustness import fault_fires
+        tree = (params, extra) if extra is not None else params
+        if fault_fires("train.sdc_flip", rank=self.rank, step=int(step)):
+            tree = _flip_one_bit(tree)
+        d = params_digest(tree)
+        self.store.set(f"{self.prefix}/{int(step)}/{self.rank}",
+                       json.dumps({"digest": d, "host": self.host,
+                                   "rank": self.rank}).encode())
+        return d
+
+    # -- phase 2: verify ----------------------------------------------------
+    def verify(self, step: int, replay: Optional[Callable[[], int]] = None,
+               timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Collect every DP peer's digest for ``step`` (bounded wait)
+        and judge.  Returns a verdict dict: ``ok`` (no divergence among
+        reporting peers), ``digests`` (rank -> digest), ``blamed``
+        (ranks), ``blamed_hosts``, ``quarantined`` (hosts), ``missing``
+        (peers that never reported — skipped, not blamed)."""
+        from paddle_tpu.observability import flight_recorder
+        deadline = time.monotonic() + (self.timeout if timeout is None
+                                       else timeout)
+        reports: Dict[int, dict] = {}
+        pending = list(self.dp_peers)
+        while pending:
+            still = []
+            for r in pending:
+                key = f"{self.prefix}/{int(step)}/{r}"
+                if self.store.check(key):
+                    reports[r] = json.loads(
+                        self.store.get(key, wait=False).decode())
+                else:
+                    still.append(r)
+            pending = still
+            if not pending or time.monotonic() > deadline:
+                break
+            time.sleep(0.01)
+        digests = {r: int(rep["digest"]) for r, rep in reports.items()}
+        verdict: Dict[str, Any] = {
+            "checked": True, "step": int(step), "digests": digests,
+            "missing": pending, "blamed": [], "blamed_hosts": [],
+            "quarantined": [], "replayed": False,
+        }
+        if len(digests) < 2 or len(set(digests.values())) == 1:
+            verdict["ok"] = True
+            return verdict
+        verdict["ok"] = False
+        # blame: a deterministic replay is ground truth when offered;
+        # otherwise strict majority — the minority is the corrupt side
+        truth: Optional[int] = None
+        if replay is not None:
+            truth = int(replay())
+            verdict["replayed"] = True
+        else:
+            counts: Dict[int, int] = {}
+            for d in digests.values():
+                counts[d] = counts.get(d, 0) + 1
+            top, n = max(counts.items(), key=lambda kv: kv[1])
+            if n * 2 > len(digests):
+                truth = top
+        if truth is not None:
+            blamed = sorted(r for r, d in digests.items() if d != truth)
+            verdict["blamed"] = blamed
+            verdict["blamed_hosts"] = sorted(
+                {reports[r]["host"] for r in blamed})
+        for h in (verdict["blamed_hosts"] or [""]):
+            self._metrics["sdc"].labels(host=h).inc()
+        flight_recorder().record(
+            "sdc.detected", step=int(step),
+            digests={str(r): d for r, d in digests.items()},
+            blamed=verdict["blamed"],
+            blamed_hosts=verdict["blamed_hosts"],
+            replayed=verdict["replayed"])
+        flight_recorder().dump(
+            reason=f"sdc digest mismatch at step {step} "
+                   f"(blamed: {verdict['blamed_hosts'] or 'unattributed'})")
+        if self.quarantine:
+            for h in verdict["blamed_hosts"]:
+                quarantine_host(self.store, h,
+                                reason=f"sdc@step{int(step)}")
+                verdict["quarantined"].append(h)
+        return verdict
+
+    def check(self, step: int, params, extra=None,
+              replay: Optional[Callable[[], int]] = None
+              ) -> Dict[str, Any]:
+        """Cadence-gated publish + verify (the training-loop hook)."""
+        if step % self.interval:
+            return {"checked": False, "ok": True}
+        self.publish(step, params, extra=extra)
+        return self.verify(step, replay=replay)
+
+
+# -- quarantine roster -------------------------------------------------------
+
+def quarantine_host(store, host: str, reason: str = "sdc"):
+    """Blame-attributed quarantine: record ``host`` on the shared
+    roster.  Elastic agents consult it before re-registering — a
+    quarantined host sits out the next rendezvous, so the fleet
+    continues without it (scale-down resume is exact; the per-shard
+    checkpoint format re-shards)."""
+    from paddle_tpu.observability import flight_recorder
+    store.set(f"{_QUAR_ROSTER}/{host}",
+              json.dumps({"reason": reason, "time": time.time()}).encode())
+    # comma-joined roster (the obs/hosts pattern): re-asserted on every
+    # write so a racing registration can only delay, never lose, it
+    known = set(quarantined_hosts(store))
+    known.add(host)
+    store.set(_QUAR_ROSTER, ",".join(sorted(known)).encode())
+    _recovery_metrics()["quarantined"].labels(host=host).inc()
+    flight_recorder().record("recovery.quarantine", host=host,
+                             reason=reason)
+
+
+def quarantined_hosts(store) -> Dict[str, dict]:
+    """host -> {reason, time} for every quarantined host."""
+    try:
+        if not store.check(_QUAR_ROSTER):
+            return {}
+        names = [h for h in store.get(_QUAR_ROSTER,
+                                      wait=False).decode().split(",") if h]
+    except Exception:
+        return {}
+    out: Dict[str, dict] = {}
+    for h in names:
+        try:
+            out[h] = json.loads(store.get(f"{_QUAR_ROSTER}/{h}",
+                                          wait=False).decode())
+        except Exception:
+            out[h] = {}
+    return out
+
+
+def is_quarantined(store, host: str) -> bool:
+    try:
+        if not store.check(_QUAR_ROSTER):
+            return False
+        return host in store.get(_QUAR_ROSTER,
+                                 wait=False).decode().split(",")
+    except Exception:
+        return False
+
+
+def clear_quarantine(store, host: Optional[str] = None):
+    """Operator override: re-admit ``host`` (or everyone).  The store
+    has no delete, so re-admission rewrites the roster and blanks the
+    per-host record — ``is_quarantined`` keys off the roster."""
+    known = set(quarantined_hosts(store))
+    doomed = set(known) if host is None else ({host} & known)
+    for h in doomed:
+        store.set(f"{_QUAR_ROSTER}/{h}", b"")
+        known.discard(h)
+    store.set(_QUAR_ROSTER, ",".join(sorted(known)).encode())

@@ -65,6 +65,7 @@ _WORKER = textwrap.dedent("""
 
 
 class TestElasticAgentHeartbeat:
+    @pytest.mark.slow  # sleep-paced heartbeat; CI chaos gate runs it
     def test_agent_beats_into_store(self):
         port = free_port()
         master = TCPStore("127.0.0.1", port, is_master=True)

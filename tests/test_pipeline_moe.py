@@ -296,6 +296,7 @@ class TestMoELayer:
                                    atol=2e-4)
         assert np.isfinite(float(aux))
 
+    @pytest.mark.slow  # heavy 8-way a2a trace; CI SPMD suite runs it
     def test_a2a_layer_mode_and_grads(self):
         """MoELayer(dispatch_mode='all_to_all') trains: grads flow through
         router + experts under jit over the ep mesh."""
@@ -320,6 +321,7 @@ class TestMoELayer:
         expert_g = next(v for k, v in g.items() if k.endswith("w1"))
         assert float(jnp.abs(expert_g).sum()) > 0
 
+    @pytest.mark.slow  # heavy 8-way a2a trace; CI SPMD suite runs it
     def test_a2a_index_matches_einsum_body(self):
         """Index-dispatch shard body == one-hot einsum shard body over the
         8-way ep mesh, with AND without capacity drops (both bodies share
@@ -352,6 +354,7 @@ class TestMoELayer:
             if not kw.get("dropless"):
                 assert float(drop_i) > 0  # the capacity bound actually bit
 
+    @pytest.mark.slow  # heavy 8-way a2a trace; CI SPMD suite runs it
     def test_a2a_index_layer_mode_and_grads(self):
         """MoELayer(dispatch_mode='all_to_all_index') trains on the ep
         mesh: grads reach router and experts."""
@@ -523,6 +526,7 @@ class TestMoEPipeline3D:
 
         return sum(one(m) for m in range(self.M)) / self.M
 
+    @pytest.mark.slow  # heavy 3D pp x dp x ep run; CI SPMD suite runs it
     def test_pp_dp_ep_parity_and_training(self):
         S, DP, EP, M = self.S, self.DP, self.EP, self.M
         d = self.d

@@ -237,6 +237,60 @@ class TestCheckpointIntegrity:
         assert validate_checkpoint(os.path.join(str(tmp_path),
                                                 "step_000000000011"))
 
+    @pytest.mark.slow  # subprocess drill; CI recovery gate runs it
+    def test_async_save_racing_a_kill_never_half_indexed(self, tmp_path):
+        """An ``_AsyncSave`` in flight when the generation dies must
+        leave only tmp orphans (purged by the next save) or a complete
+        step — never a half-indexed step that ``restore_latest``
+        accepts.  The kill rides ``checkpoint.shard_write`` with
+        ``action=exit``: the writer thread hard-exits the process
+        mid-save, after some shards published but before the index."""
+        import subprocess
+        import sys as _sys
+        import textwrap as _tw
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "victim.py"
+        script.write_text(_tw.dedent("""
+            import os, sys
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["PADDLE_TPU_FAULTS"] = \\
+                "checkpoint.shard_write:n=3:action=exit"
+            import numpy as np
+            from paddle_tpu.distributed.checkpoint import AutoCheckpoint
+            ck = AutoCheckpoint(sys.argv[1], keep=3,
+                                save_interval_steps=1)
+            state = {f"w{i}": np.full((256,), float(i), np.float32)
+                     for i in range(8)}
+            pending = ck.maybe_save(1, state)
+            pending.wait()   # unreachable: the writer hard-exits first
+            sys.exit(0)
+        """))
+        ckpt_dir = str(tmp_path / "ckpt")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run([_sys.executable, str(script), ckpt_dir],
+                              env=env, capture_output=True, timeout=120)
+        assert proc.returncode == 13, proc.stderr.decode()[-2000:]
+        step_dir = os.path.join(ckpt_dir, "step_000000000001")
+        # some shards were published, so the dir exists and is partial
+        assert os.path.isdir(step_dir)
+        assert not validate_checkpoint(step_dir)
+        ck = AutoCheckpoint(ckpt_dir, keep=3, save_interval_steps=1)
+        assert ck.latest_step() is None
+        assert ck.restore_latest() == (None, None)
+        # a fresh save at the same step purges the wreck (tmp orphans
+        # included) and produces a complete, restorable checkpoint
+        state = {f"w{i}": np.full((256,), float(i), np.float32)
+                 for i in range(8)}
+        ck.save_now(1, state)
+        assert validate_checkpoint(step_dir)
+        import glob as _glob
+        assert not _glob.glob(os.path.join(step_dir, "*.tmp.*"))
+        step, out = ck.restore_latest()
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(out["w3"]),
+                                      np.full((256,), 3.0, np.float32))
+
 
 # ---------------------------------------------------------------------------
 # TrainStep non-finite step-guard
@@ -390,6 +444,54 @@ class TestTcpStoreRetry:
         finally:
             store.close()
 
+    def test_add_token_dedup_applies_once(self):
+        """The double-count hazard the op-id token kills: an add whose
+        response was lost retried with the SAME token must replay the
+        recorded result, never re-apply the delta."""
+        from paddle_tpu.distributed.elastic import free_port
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        store = TCPStore("127.0.0.1", free_port(), is_master=True)
+        try:
+            assert store.add("cnt", 5) == 5
+            # simulate: first round-trip applied server-side, response
+            # lost on the wire, client resends the identical op id
+            assert store._add_once("cnt", 5, "op-abc") == 10
+            assert store._add_once("cnt", 5, "op-abc") == 10
+            assert store.add("cnt", 0) == 10
+            # a DIFFERENT op id is a genuinely new add
+            assert store._add_once("cnt", 5, "op-def") == 15
+        finally:
+            store.close()
+
+    def test_retried_add_counts_once(self):
+        """``add`` now rides the PR-4 bounded retry (previously
+        excluded): an injected failure is retried and the counter moves
+        exactly once."""
+        from paddle_tpu.distributed.elastic import free_port
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        store = TCPStore("127.0.0.1", free_port(), is_master=True)
+        c = default_registry().counter(
+            "paddle_tpu_tcp_store_op_retries_total", labelnames=("op",))
+        before = c.labels(op="add").value()
+        try:
+            inject("tcp_store.op", times=1)
+            assert store.add("cnt2", 7) == 7   # attempt 1 fails, retried
+            assert c.labels(op="add").value() == before + 1
+            assert store.add("cnt2", 0) == 7   # counted exactly once
+        finally:
+            store.close()
+
+    def test_barrier_still_counts_correctly(self):
+        from paddle_tpu.distributed.elastic import free_port
+        from paddle_tpu.distributed.tcp_store import TCPStore
+        store = TCPStore("127.0.0.1", free_port(), is_master=True,
+                         world_size=1)
+        try:
+            store.barrier("b1")
+            assert store.add("__b1_count", 0) == 1
+        finally:
+            store.close()
+
 
 # ---------------------------------------------------------------------------
 # preemption-aware elastic
@@ -433,6 +535,7 @@ _DRAIN_MANAGER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow  # subprocess/sleep drills; CI chaos gate runs them
 class TestGracefulDrain:
     def test_sigterm_drains_with_final_checkpoint_and_exit_0(self,
                                                              tmp_path):
@@ -514,6 +617,7 @@ class TestGracefulDrain:
                 os.environ.pop(k, None)
             master.close()
 
+    @pytest.mark.slow  # spawns generations; CI chaos gate runs it
     def test_circuit_breaker_opens_on_fast_failures(self, tmp_path):
         """Insta-crashing generations trip the breaker before the
         restart budget is exhausted."""
