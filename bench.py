@@ -644,9 +644,11 @@ def main(argv=None):
     # kernel-path attribution: which implementations this run compiled,
     # so BENCH_r*.json trajectories can attribute wins to paths
     from paddle_tpu.observability import default_registry
+    from paddle_tpu.distributed.sharding import overlap_enabled
     from paddle_tpu.ops.pallas.cross_entropy import fused_ce_enabled
     from paddle_tpu.ops.pallas.flash_attention import flash_bwd_env
-    from paddle_tpu.ops.pallas.fused_block import fused_block_enabled
+    from paddle_tpu.ops.pallas.fused_block import (fused_block_enabled,
+                                                   fused_block_tier)
 
     def _series(name):
         m = default_registry().get(name)
@@ -664,8 +666,13 @@ def main(argv=None):
         # whether tuned block sizes came from the persistent cache —
         # BENCH trajectories can attribute wins to the exact code path
         "fused_block_enabled": bool(fused_block_enabled()),
+        "fused_block_tier": fused_block_tier(),
         "fused_block_traces": _series("paddle_tpu_fused_block_path_total"),
         "autotune_cache": _series("paddle_tpu_autotune_cache_total"),
+        # compute/collective overlap (ISSUE 15): whether the knob was on
+        # and which paths actually traced overlap-expressed collectives
+        "collective_overlap": bool(overlap_enabled()),
+        "overlap_traces": _series("paddle_tpu_collective_overlap_total"),
         "accum_steps": accum,
         "device_prefetch": True,
     }

@@ -91,11 +91,14 @@ class Collective:
         return k
 
     def seconds(self, mesh_shape: Dict[str, int],
-                bandwidth: Optional[float] = None) -> float:
+                bandwidth: Optional[float] = None,
+                overlap_fraction: float = 0.0) -> float:
         from paddle_tpu.analysis.passes.cost_model import collective_seconds
         return collective_seconds(self.kind, self.bytes,
                                   self.axis_size(mesh_shape),
-                                  bandwidth=bandwidth) * self.count
+                                  bandwidth=bandwidth,
+                                  overlap_fraction=overlap_fraction) \
+            * self.count
 
     @property
     def total_bytes(self) -> int:

@@ -40,9 +40,42 @@ LINK_BANDWIDTH = {
 }
 DEFAULT_LINK_BW = LINK_BANDWIDTH["ici"]
 
+# Compute/collective overlap (ISSUE 15): per-kind fraction of a
+# collective's ring time that CAN hide under concurrent compute when the
+# program expresses it overlap-friendly (explicit layer-ordered weight
+# all-gather prefetch, ppermute-before-fold ring exchange).  Weight
+# gathers / grad reduce-scatters stream fully under the adjacent layer's
+# compute; an all-reduce only half-hides (its trailing all-gather phase
+# lands after the last compute that could cover it); everything
+# point-to-point pipelines fully.
+OVERLAP_HIDEABLE = {
+    "all_gather": 1.0, "reduce_scatter": 1.0,
+    "all_reduce": 0.5, "psum": 0.5,
+    "all_to_all": 1.0, "a2a": 1.0,
+    "p2p": 1.0, "send": 1.0, "recv": 1.0, "ppermute": 1.0,
+}
+
+# achievable hiding on a v5e-class latency-hiding scheduler — a ranking
+# prior like LINK_BANDWIDTH, not a measurement; override per run with
+# options={'overlap_fraction': ...}
+DEFAULT_OVERLAP_FRACTION = 0.75
+
+
+def default_overlap_fraction() -> float:
+    """The overlap fraction implied by the runtime knob: when
+    PADDLE_TPU_COLLECTIVE_OVERLAP is on, the planner scores layouts the
+    way the overlapped program will actually run; off → 0 (charge every
+    collective in full, the previous behaviour)."""
+    import os
+    if os.environ.get("PADDLE_TPU_COLLECTIVE_OVERLAP", "") \
+            .strip().lower() in ("1", "true", "on", "yes"):
+        return DEFAULT_OVERLAP_FRACTION
+    return 0.0
+
 
 def collective_seconds(op: str, nbytes: int, axis_size: int,
-                       bandwidth: float = None, link: str = "ici") -> float:
+                       bandwidth: float = None, link: str = "ici",
+                       overlap_fraction: float = 0.0) -> float:
     """Ring-algorithm time of one collective over a mesh axis.
 
     ``nbytes`` is the LOGICAL payload (the full gathered/reduced tensor,
@@ -50,9 +83,12 @@ def collective_seconds(op: str, nbytes: int, axis_size: int,
     number of participants.  Standard ring costs: all-gather and
     reduce-scatter move ``(k-1)/k`` of the payload over the slowest
     link; all-reduce is reduce-scatter + all-gather (2x); all-to-all
-    moves ``1/k`` of what an all-gather would.  Reusable by the
-    autoshard scorer, the SLO watchdog and the device profiler —
-    anything that needs "how long should these collective bytes take".
+    moves ``1/k`` of what an all-gather would.  ``overlap_fraction``
+    discounts the charge by how much of the kind's hideable share
+    (``OVERLAP_HIDEABLE``) actually hides under compute — 0 charges in
+    full.  Reusable by the autoshard scorer, the SLO watchdog and the
+    device profiler — anything that needs "how long should these
+    collective bytes take".
     """
     k = max(int(axis_size), 1)
     if k <= 1 or nbytes <= 0:
@@ -60,16 +96,21 @@ def collective_seconds(op: str, nbytes: int, axis_size: int,
     bw = float(bandwidth) if bandwidth else LINK_BANDWIDTH[link]
     frac = (k - 1) / k
     if op in ("all_gather", "reduce_scatter"):
-        return frac * nbytes / bw
-    if op in ("all_reduce", "psum"):
-        return 2.0 * frac * nbytes / bw
-    if op in ("all_to_all", "a2a"):
-        return frac * nbytes / (k * bw)
-    if op in ("p2p", "send", "recv", "ppermute"):
-        return nbytes / bw
-    raise ValueError(
-        f"unknown collective op {op!r}; expected all_gather/"
-        f"reduce_scatter/all_reduce/psum/all_to_all/p2p")
+        t = frac * nbytes / bw
+    elif op in ("all_reduce", "psum"):
+        t = 2.0 * frac * nbytes / bw
+    elif op in ("all_to_all", "a2a"):
+        t = frac * nbytes / (k * bw)
+    elif op in ("p2p", "send", "recv", "ppermute"):
+        t = nbytes / bw
+    else:
+        raise ValueError(
+            f"unknown collective op {op!r}; expected all_gather/"
+            f"reduce_scatter/all_reduce/psum/all_to_all/p2p")
+    of = min(max(float(overlap_fraction), 0.0), 1.0)
+    if of > 0.0:
+        t *= 1.0 - of * OVERLAP_HIDEABLE.get(op, 1.0)
+    return t
 
 _TRANSCENDENTAL = {
     "exp", "log", "log1p", "expm1", "tanh", "erf", "erfc", "erf_inv",

@@ -61,9 +61,25 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
 
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
+    # async ring exchange (ISSUE 15): with PADDLE_TPU_COLLECTIVE_OVERLAP
+    # the rotation is issued BEFORE the fold — the ppermute has no data
+    # dependency on this step's softmax/matmuls, so an async-collective
+    # scheduler streams the next K/V shard in under the current fold's
+    # compute instead of paying the ICI hop at the step boundary.
+    # Trace-time routing: knob off keeps the exact previous program.
+    from paddle_tpu.distributed.sharding import (overlap_enabled,
+                                                 overlap_path_counter)
+    overlap = overlap_enabled()
+    if overlap:
+        overlap_path_counter().labels(path="ring_exchange").inc()
+
     def step(carry, i):
         o, m, l, k_cur, v_cur = carry
         src = (idx - i) % sp                           # owner of current kv
+        if overlap:
+            # issue the rotation first: comm rides under the fold below
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
                             k_cur.astype(jnp.float32)) * scale
         if causal:
@@ -77,9 +93,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
         o_new = o * corr + pv
-        # rotate kv to the next rank (skip after the last fold)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        if not overlap:
+            # rotate kv to the next rank (skip after the last fold)
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
 
     from paddle_tpu.distributed.communication import pvary_like

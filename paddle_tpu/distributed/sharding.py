@@ -22,13 +22,96 @@ all runtime machinery.  Under GSPMD every stage is just a *sharding choice*:
   (ForwardPostHooks, group_sharded_stage3.py:809).
 
 ``shard_plan`` returns the PartitionSpecs that TrainStep consumes.
+
+Collective latency hiding (ISSUE 15): ``PADDLE_TPU_COLLECTIVE_OVERLAP``
+opts the training path into expressing the per-layer FSDP weight
+all-gathers as an explicit, layer-ordered prefetch chain that XLA's
+async-collective scheduler can hide under the previous layer's compute
+(``TrainStep._overlap_prefetch``), and flips the sequence-parallel ring
+exchange to issue its ``ppermute`` before the fold it overlaps with.
+This module owns the knob, the per-layer prefetch schedule
+(:func:`prefetch_groups`), the gathered-layout helper
+(:func:`gathered_spec`) and the shared trace-time path counter — the
+autoshard cost model discounts collectives by the same knob (see
+``analysis.passes.cost_model.default_overlap_fraction``).
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import os
+import re
+from typing import Dict, List, Optional, Sequence
 
-__all__ = ["group_sharded_parallel", "shard_plan", "ShardingPlan"]
+__all__ = ["group_sharded_parallel", "shard_plan", "ShardingPlan",
+           "overlap_enabled", "prefetch_groups", "gathered_spec",
+           "spec_mentions_axis", "overlap_path_counter"]
+
+
+def overlap_enabled() -> bool:
+    """The PADDLE_TPU_COLLECTIVE_OVERLAP knob — default off, and off
+    reproduces the exact previous jaxpr everywhere it is consulted."""
+    return os.environ.get("PADDLE_TPU_COLLECTIVE_OVERLAP", "") \
+        .strip().lower() in ("1", "true", "on", "yes")
+
+
+def overlap_path_counter():
+    """Trace-time telemetry shared by every overlap-expressed path
+    (TrainStep FSDP prefetch, sequence-parallel ring exchange) — the
+    same idiom as the fused-block path counter, surfaced in bench.py's
+    ``detail.paths``."""
+    from paddle_tpu.observability import default_registry
+    return default_registry().counter(
+        "paddle_tpu_collective_overlap_total",
+        "collectives expressed overlap-friendly at trace time",
+        labelnames=("path",))
+
+
+_LAYER_RE = re.compile(r"(?:^|\.)layers?[._](\d+)\.")
+
+
+def prefetch_groups(names: Sequence[str]) -> List[List[str]]:
+    """Order parameter names into the per-layer prefetch schedule:
+    non-layer params first (embeddings / final norm / lm head — wanted
+    hot at the step's edges), then one group per decoder layer in layer
+    order.  The schedule is the issue order of the prefetch chain: group
+    k's gathers are chained after group k-1's, decoupled from their
+    consumers, so layer i+1's gather streams under layer i's compute."""
+    layers: Dict[int, List[str]] = {}
+    rest: List[str] = []
+    for n in names:
+        m = _LAYER_RE.search(n)
+        if m:
+            layers.setdefault(int(m.group(1)), []).append(n)
+        else:
+            rest.append(n)
+    out: List[List[str]] = [rest] if rest else []
+    out.extend(layers[i] for i in sorted(layers))
+    return out
+
+
+def spec_mentions_axis(spec, axis: str) -> bool:
+    for e in spec:
+        if e == axis or (isinstance(e, tuple) and axis in e):
+            return True
+    return False
+
+
+def gathered_spec(spec, axis: str):
+    """``spec`` with ``axis`` removed — the layout of a ZeRO-3 weight
+    AFTER its all-gather (what the forward consumes)."""
+    from jax.sharding import PartitionSpec as P
+
+    def drop(e):
+        if e == axis:
+            return None
+        if isinstance(e, tuple):
+            kept = tuple(a for a in e if a != axis)
+            if not kept:
+                return None
+            return kept[0] if len(kept) == 1 else kept
+        return e
+
+    return P(*(drop(e) for e in spec))
 
 
 class ShardingPlan:

@@ -55,6 +55,27 @@ def _resolve_plan(shardings, mesh, param_specs, batch_spec):
                     f"got {type(shardings).__name__}")
 
 
+@jax.custom_vjp
+def _ordered_after(x, token):
+    """``x`` pinned to issue after ``token`` via optimization_barrier —
+    the link of the collective-overlap prefetch chain.  The barrier is a
+    forward scheduling constraint only; 0.4.x has no differentiation
+    rule for it, so the VJP passes the cotangent straight through (the
+    backward's gather/reduce-scatter schedule is XLA's to pick)."""
+    return jax.lax.optimization_barrier((x, token))[0]
+
+
+def _ordered_after_fwd(x, token):
+    return _ordered_after(x, token), token
+
+
+def _ordered_after_bwd(token, g):
+    return g, jax.tree.map(jnp.zeros_like, token)
+
+
+_ordered_after.defvjp(_ordered_after_fwd, _ordered_after_bwd)
+
+
 def _train_metrics():
     """Lazily created instruments on the default registry (shared by
     every TrainStep in the process — that is what an operator scrapes)."""
@@ -264,7 +285,9 @@ class TrainStep(CompiledStepBase):
                  analyze: Optional[str] = None, accum_steps: int = 1,
                  guard_nonfinite: Optional[bool] = None,
                  max_consecutive_skips: Optional[int] = None,
-                 shardings=None):
+                 shardings=None, collective_overlap: Optional[bool] = None,
+                 overlap_axis: str = "fsdp", sdc_sentinel=None,
+                 sdc_check_interval: Optional[int] = None):
         # shardings=: an autoshard plan (analysis.autoshard.AutoShardPlan
         # — carries mesh shape, per-param specs and the batch spec in one
         # object) expands into the mesh/param_specs/batch_spec triple
@@ -359,6 +382,49 @@ class TrainStep(CompiledStepBase):
         else:
             param_sh = self._batch_sh = None
 
+        # compute/collective overlap (ISSUE 15): express the per-layer
+        # FSDP weight all-gathers as an explicit, layer-ordered prefetch
+        # chain (issue order decoupled from consumers) so XLA's async
+        # scheduler hides them under the previous layer's compute.
+        # Knob-gated (PADDLE_TPU_COLLECTIVE_OVERLAP / collective_overlap=)
+        # and default off = exact previous jaxpr; only arms when a mesh
+        # axis actually shards weights on ``overlap_axis``.
+        from paddle_tpu.distributed.sharding import (gathered_spec,
+                                                     overlap_enabled,
+                                                     prefetch_groups,
+                                                     spec_mentions_axis)
+        if collective_overlap is None:
+            collective_overlap = overlap_enabled()
+        self._overlap_axis = overlap_axis
+        self._collective_overlap = False
+        self._overlap_groups = None
+        self._gathered_sh = None
+        if collective_overlap and mesh is not None and \
+                param_sh is not None and overlap_axis in mesh.axis_names:
+            from jax.sharding import NamedSharding
+            gathered = {
+                n: NamedSharding(mesh, gathered_spec(sh.spec, overlap_axis))
+                for n, sh in param_sh.items()
+                if spec_mentions_axis(sh.spec, overlap_axis)}
+            if gathered:
+                self._gathered_sh = gathered
+                self._overlap_groups = prefetch_groups(sorted(gathered))
+                self._collective_overlap = True
+
+        # optional SDC sentinel hook (robustness.recovery.SDCSentinel):
+        # publish/verify the params digest across DP peers every
+        # ``sdc_check_interval`` applied steps — the TrainStep-driven
+        # form of the PR-14 loop-driven sentinel
+        self._sdc_sentinel = sdc_sentinel
+        if sdc_check_interval is None:
+            sdc_check_interval = getattr(sdc_sentinel, "interval", 1) \
+                if sdc_sentinel is not None else 0
+        if sdc_sentinel is not None and int(sdc_check_interval) < 1:
+            raise ValueError("sdc_check_interval must be >= 1, got "
+                             f"{sdc_check_interval}")
+        self._sdc_interval = int(sdc_check_interval or 0)
+        self.last_sdc_verdict = None
+
         self._init_step_state(optimizer, params, param_sh)
         self._jitted = jax.jit(self._step_impl, donate_argnums=(0, 1, 2))
         # AOT path (device-profiler tentpole): compile(batch) stores the
@@ -396,12 +462,40 @@ class TrainStep(CompiledStepBase):
         self._host_steps = 0
         self._step_ema: Optional[float] = None
 
+    def _overlap_prefetch(self, params):
+        """Issue every ZeRO-3 weight all-gather as an explicit,
+        layer-ordered chain: ``with_sharding_constraint`` to the
+        axis-free layout forces GSPMD to materialize the gather here —
+        decoupled from the layer that consumes it — and the
+        ``optimization_barrier`` chain pins issue order layer i → i+1,
+        so the scheduler streams the gathers as a prefetch queue it can
+        hide under earlier layers' compute instead of paying each one
+        just-in-time at its consumer."""
+        from paddle_tpu.distributed.sharding import overlap_path_counter
+        overlap_path_counter().labels(path="fsdp_prefetch").inc()
+        out = dict(params)
+        token = None
+        for group in self._overlap_groups:
+            nxt = None
+            for n in group:
+                p = jax.lax.with_sharding_constraint(
+                    params[n], self._gathered_sh[n])
+                if token is not None:
+                    p = _ordered_after(p, token)
+                if nxt is None:
+                    nxt = p
+                out[n] = p
+            token = nxt if nxt is not None else token
+        return out
+
     def _step_impl(self, params, opt_state, step_count, batch, key, lr):
         model, opt = self.model, self.optimizer
 
         def loss_of_trainable(train_params, frozen_params, mb, k):
             full = dict(frozen_params)
             full.update(train_params)
+            if self._collective_overlap:
+                full = self._overlap_prefetch(full)
             f = lambda p: _loss_of(model, self.loss_fn, p, mb,
                                    {"dropout": k})
             if self._remat:
@@ -497,7 +591,8 @@ class TrainStep(CompiledStepBase):
                 f"|opt={type(self.optimizer).__name__}"
                 f"|loss={lf}|accum={self._accum_steps}"
                 f"|remat={int(self._remat)}:{self._remat_policy_name}"
-                f"|guard={int(self._guard_nonfinite)}")
+                f"|guard={int(self._guard_nonfinite)}"
+                f"|ovl={int(self._collective_overlap)}")
 
     def compile(self, batch):
         """AOT-compile the step for this batch signature with full
@@ -670,6 +765,15 @@ class TrainStep(CompiledStepBase):
         if self._memmon is not None and \
                 (self._host_steps % self._watermark_every) == 0:
             self._memmon.sample(step=self._host_steps)
+        # SDC sentinel cadence: publish this rank's params digest and
+        # judge it against the DP peers' (bounded wait = the sentinel's
+        # timeout).  Mismatch handling (metrics, flight-recorder dump,
+        # blame, quarantine) lives in the sentinel itself.
+        if self._sdc_sentinel is not None and \
+                self._host_steps % self._sdc_interval == 0:
+            self._sdc_sentinel.publish(self._host_steps, self.params)
+            self.last_sdc_verdict = self._sdc_sentinel.verify(
+                self._host_steps)
         return loss
 
     def _account_skip(self, code: int):

@@ -185,6 +185,38 @@ def _fused_norm_qkv(layer, x):
         epsilon=layer.input_layernorm._epsilon)
 
 
+def _fused_decoder(layer, x, rope_cos, rope_sin):
+    """The whole decoder block through the Pallas megakernel when the
+    PADDLE_TPU_FUSED_BLOCK=decoder tier and the shapes allow; None →
+    caller takes the per-segment/unfused path.  The routing decision
+    happens at trace time, so every other knob value reproduces its
+    previous jaxpr exactly."""
+    from paddle_tpu.ops.pallas import fused_block as FB
+    if not FB.fused_decoder_enabled():
+        return None
+    attn, mlp = layer.self_attn, layer.mlp
+    projs = (attn.q_proj, attn.k_proj, attn.v_proj, attn.o_proj,
+             mlp.gate_proj, mlp.up_proj, mlp.down_proj)
+    quanted = any(getattr(p, "quantized", False) for p in projs)
+    b, s, d = int(x.shape[0]), int(x.shape[1]), int(x.shape[2])
+    dq = attn.num_heads * attn.head_dim
+    dkv = attn.num_kv_heads * attn.head_dim
+    f = None if quanted else int(mlp.gate_proj.weight.shape[-1])
+    fused = (not quanted and int(rope_cos.shape[0]) >= s and
+             FB.fused_decoder_eligible(b, s, d, dq, dkv, attn.head_dim,
+                                       f, x.dtype))
+    FB.record_path("decoder_block", fused)
+    if not fused:
+        return None
+    return F.fused_decoder_block(
+        x, layer.input_layernorm.weight, attn.q_proj.weight,
+        attn.k_proj.weight, attn.v_proj.weight, rope_cos, rope_sin,
+        attn.o_proj.weight, layer.post_attention_layernorm.weight,
+        mlp.gate_proj.weight, mlp.up_proj.weight, mlp.down_proj.weight,
+        num_heads=attn.num_heads, num_kv_heads=attn.num_kv_heads,
+        epsilon=layer.input_layernorm._epsilon)
+
+
 class LlamaMLP(Layer):
     """SwiGLU: down(silu(gate(x)) * up(x)) — routed through the fused
     Pallas MLP kernel (hidden intermediate VMEM-resident) behind
@@ -229,6 +261,14 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, rope_cos, rope_sin, attn_mask=None, cache=None,
                 position_offset=0):
+        # whole-block megakernel tier: the no-cache, offset-0, causal
+        # form (training and full prefill) can run the entire block as
+        # one Pallas pass — eligible shapes only, decided at trace time
+        if cache is None and attn_mask is None and \
+                isinstance(position_offset, int) and position_offset == 0:
+            y = _fused_decoder(self, x, rope_cos, rope_sin)
+            if y is not None:
+                return y
         qkv = _fused_norm_qkv(self, x)
         if qkv is not None:
             h = self.self_attn.attend(*qkv, rope_cos, rope_sin,

@@ -13,7 +13,8 @@ from __future__ import annotations
 from paddle_tpu.core.dispatch import eager_op
 from paddle_tpu.ops.pallas import fused_block as _FB
 
-__all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn"]
+__all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn",
+           "fused_decoder_block"]
 
 
 @eager_op
@@ -31,6 +32,23 @@ def fused_mlp(x, w_gate, w_up, w_down, activation="silu"):
     """SwiGLU ``down(act(gate(x)) * up(x))`` with the hidden
     intermediate VMEM-resident."""
     return _FB.fused_mlp(x, w_gate, w_up, w_down, activation=activation)
+
+
+@eager_op
+def fused_decoder_block(x, norm1_weight, wq, wk, wv, rope_cos, rope_sin,
+                        wo, norm2_weight, wg, wu, wd, num_heads,
+                        num_kv_heads, epsilon=1e-5):
+    """One whole llama decoder block (rmsnorm → QKV → RoPE → causal
+    attention → o-proj+residual → rmsnorm → SwiGLU MLP+residual) as a
+    single Pallas pass — the block-boundary activations never
+    round-trip HBM (``PADDLE_TPU_FUSED_BLOCK=decoder`` routes eligible
+    llama layers here automatically).  Differentiable via
+    block-boundary remat; ineligible shapes take the unfused reference
+    composition."""
+    return _FB.fused_decoder_block(
+        x, norm1_weight, wq, wk, wv, rope_cos, rope_sin, wo,
+        norm2_weight, wg, wu, wd, num_heads=num_heads,
+        num_kv_heads=num_kv_heads, epsilon=epsilon)
 
 
 @eager_op

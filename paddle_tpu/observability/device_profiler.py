@@ -608,6 +608,36 @@ def llama_step_segments(model, batch: Dict[str, Any],
     def block_fn(p, h, c, si):
         return unwrap(functional_call(layer0, p, h, c, si))
 
+    def block_fused_fn(p, h):
+        # the whole-decoder-block fusion boundary (ISSUE 15): routed
+        # exactly like LlamaDecoderLayer.forward — with
+        # PADDLE_TPU_FUSED_BLOCK=decoder and eligible shapes the block
+        # runs as ONE Pallas megakernel, otherwise the per-segment /
+        # unfused layer; flip the knob between profiler runs for the
+        # before/after attribution row
+        from paddle_tpu.ops.pallas import fused_block as FB
+        nh = cfg.num_attention_heads
+        nkvh = cfg.num_key_value_heads
+        hd = cfg.head_dim
+        fcols = int(p["mlp.gate_proj.weight"].shape[-1])
+        rows = 1
+        for dim in h.shape[:-1]:
+            rows *= int(dim)
+        if FB.fused_decoder_enabled() and FB.fused_decoder_eligible(
+                int(h.shape[0]), int(h.shape[1]), int(h.shape[-1]),
+                nh * hd, nkvh * hd, hd, fcols, h.dtype) and \
+                int(cos.shape[0]) >= int(h.shape[1]):
+            return FB.fused_decoder_block(
+                h, p["input_layernorm.weight"],
+                p["self_attn.q_proj.weight"], p["self_attn.k_proj.weight"],
+                p["self_attn.v_proj.weight"], cos, sin,
+                p["self_attn.o_proj.weight"],
+                p["post_attention_layernorm.weight"],
+                p["mlp.gate_proj.weight"], p["mlp.up_proj.weight"],
+                p["mlp.down_proj.weight"], num_heads=nh,
+                num_kv_heads=nkvh, epsilon=cfg.rms_norm_eps)
+        return unwrap(functional_call(layer0, p, h, cos, sin))
+
     def head_fn(p, h, lbl):
         from paddle_tpu.nn import functional as F
         loss = F.fused_linear_cross_entropy(
@@ -623,6 +653,8 @@ def llama_step_segments(model, batch: Dict[str, Any],
         Segment("mlp", mlp_fn, (mlp_p, x), count=L),
         Segment("decoder_block", block_fn, (block_p, x, cos, sin),
                 count=L, group="composite"),
+        Segment("decoder_block_fused", block_fused_fn, (block_p, x),
+                count=L, group="fused_boundary"),
         Segment("lm_head_ce", head_fn, (head_p, x, labels), count=1),
     ]
     if grad:

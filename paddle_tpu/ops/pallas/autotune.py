@@ -53,8 +53,9 @@ from typing import Callable, Dict, Sequence, Tuple
 
 __all__ = ["autotune", "flash_block_sizes", "ce_block_sizes",
            "qkv_block_sizes", "mlp_block_sizes", "quant_block_sizes",
-           "cache_path", "seed_path", "backend_tag", "cached_entries",
-           "clear_cache", "reload", "CACHE_VERSION", "main"]
+           "decoder_block_sizes", "cache_path", "seed_path",
+           "backend_tag", "cached_entries", "clear_cache", "reload",
+           "CACHE_VERSION", "main"]
 
 CACHE_VERSION = 2
 
@@ -550,6 +551,108 @@ def mlp_block_sizes(t: int, d: int, f: int, dtype: str) -> Tuple[int, int]:
     return tuple(autotune("fused_mlp", key, cands, bench, default))
 
 
+# -- whole-decoder-block megakernel ------------------------------------------
+
+def _decoder_candidates(s, d, dq, dkv, hd, f, dtype) -> list:
+    """(block_t, block_o, block_f) candidates for the whole-block
+    kernel, bounded by its VMEM working set (the sequence-wide K/V
+    scratch is a fixed cost every candidate pays)."""
+    from paddle_tpu.ops.pallas.fused_block import (_DECODER_VMEM_BUDGET,
+                                                   decoder_vmem_bytes)
+    itemsize = 2 if "bfloat16" in dtype or "float16" in dtype else 4
+    qmin = 16 if itemsize == 2 else 8
+    out = []
+    for bo in (128, 256, 512):
+        if bo % hd or dq % bo or dkv % bo or d % bo:
+            continue
+        for bf in (128, 256, 512):
+            if f % bf:
+                continue
+            for bt in (qmin, 32, 64, 128, 256):
+                if bt < qmin or s % bt:
+                    continue
+                if decoder_vmem_bytes(s, d, dq, dkv, hd, f, bt, bo, bf,
+                                      dtype) < _DECODER_VMEM_BUDGET:
+                    out.append((bt, bo, bf))
+    if not out:
+        from paddle_tpu.ops.pallas.fused_block import \
+            _default_decoder_blocks
+        fallback = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
+        out = [fallback] if fallback else []
+    return sorted(set(out))
+
+
+def decoder_key(b, s, d, dq, dkv, hd, f, dtype, backend=None,
+                interpret=None):
+    return (f"b{b}s{s}d{d}q{dq}k{dkv}h{hd}f{f}{dtype}"
+            f"@{backend or backend_tag(interpret)}")
+
+
+def decoder_block_sizes(b, s, d, dq, dkv, hd, f,
+                        dtype: str) -> Tuple[int, int, int]:
+    """Measured (block_t, block_o, block_f) for the whole-decoder-block
+    kernel (fwd + bwd timed together — the backward is the reference
+    recompute, so the win being tuned lives in the forward)."""
+    from paddle_tpu.ops.pallas.fused_block import _default_decoder_blocks
+    default = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
+    cands = _decoder_candidates(s, d, dq, dkv, hd, f, dtype)
+    if default is None:
+        raise ValueError(
+            f"no decoder block sizes fit the VMEM budget at s={s} d={d} "
+            f"dkv={dkv} f={f}")
+    if len(cands) <= 1:
+        return tuple(cands[0]) if cands else tuple(default)
+    key = decoder_key(b, s, d, dq, dkv, hd, f, dtype)
+
+    def bench(blocks):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from paddle_tpu.ops.pallas.fused_block import fused_decoder_block
+
+        bt, bo, bf = blocks
+        iters = 4
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(dtype)
+        nh, nkvh = dq // hd, dkv // hd
+        x = jnp.asarray(rng.standard_normal((b, s, d)), dt)
+        wn1 = jnp.ones((d,), dt)
+        wn2 = jnp.ones((d,), dt)
+        wq = jnp.asarray(rng.standard_normal((d, dq)) * 0.02, dt)
+        wk = jnp.asarray(rng.standard_normal((d, dkv)) * 0.02, dt)
+        wv = jnp.asarray(rng.standard_normal((d, dkv)) * 0.02, dt)
+        wo = jnp.asarray(rng.standard_normal((dq, d)) * 0.02, dt)
+        wg = jnp.asarray(rng.standard_normal((d, f)) * 0.02, dt)
+        wu = jnp.asarray(rng.standard_normal((d, f)) * 0.02, dt)
+        wd = jnp.asarray(rng.standard_normal((f, d)) * 0.02, dt)
+        from paddle_tpu.nn.functional.attention import rotary_freqs
+        cos, sin = rotary_freqs(hd, s)
+
+        @jax.jit
+        def run(x_):
+            def loss(a):
+                y = fused_decoder_block(
+                    a, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd,
+                    num_heads=nh, num_kv_heads=nkvh, block_t=bt,
+                    block_o=bo, block_f=bf, autotune=False,
+                    use_pallas=True)
+                return jnp.sum(y.astype(jnp.float32) ** 2)
+
+            def body(i, carry):
+                g = jax.grad(loss)(x_ * (1 + carry * 1e-12).astype(dt))
+                return carry + jnp.sum(jnp.abs(g).astype(jnp.float32))
+            return lax.fori_loop(0, iters, body, 0.0)
+
+        np.asarray(run(x))                            # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(run(x))
+        return (time.perf_counter() - t0) / iters
+
+    return tuple(autotune("fused_decoder", key, cands, bench, default))
+
+
 # -- weight-only quantized matmul --------------------------------------------
 
 def _quant_candidates(t, k, n, wdtype, xdtype) -> list:
@@ -656,6 +759,14 @@ SWEEP_SHAPES = {
         (8192, 2048, 7168, "bfloat16"),
         (8192, 4096, 14336, "bfloat16"),
     ],
+    # whole-decoder-block megakernel: the VMEM budget (sequence-wide K/V
+    # scratch) bounds it to short/medium contexts — sweep the shapes it
+    # actually serves: a short-context training block and a
+    # prefill/verify-sized row batch at bench-llama widths
+    "fused_decoder": [
+        (4, 512, 1024, 1024, 512, 128, 3584, "bfloat16"),
+        (8, 128, 2048, 2048, 1024, 128, 7168, "bfloat16"),
+    ],
     # weight-only quantized GEMM (serving): the bench_serve llama's
     # prefill-chunk and batched-decode token counts over its projection
     # shapes, int8 and fp8 weight storage
@@ -704,6 +815,16 @@ def _sweep_one(op, shape, dry_run, backend):
         key = mlp_key(t, d, f, dtype, backend=backend)
         if not dry_run:
             return key, mlp_block_sizes(t, d, f, dtype), len(cands)
+    elif op == "fused_decoder":
+        b, s, d, dq, dkv, hd, f, dtype = shape
+        from paddle_tpu.ops.pallas.fused_block import \
+            _default_decoder_blocks
+        cands = _decoder_candidates(s, d, dq, dkv, hd, f, dtype)
+        default = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
+        key = decoder_key(b, s, d, dq, dkv, hd, f, dtype, backend=backend)
+        if not dry_run:
+            return key, decoder_block_sizes(b, s, d, dq, dkv, hd, f,
+                                            dtype), len(cands)
     elif op == "quant_matmul":
         t, k, n, wdtype, xdtype = shape
         from paddle_tpu.ops.pallas.quant_matmul import \

@@ -34,9 +34,35 @@ Numerics: norm statistics, activation math and all matmul
 accumulation in fp32 (``preferred_element_type``) regardless of the
 io dtype, mirroring the rest of the Pallas layer.
 
+* ``fused_decoder_block`` — the whole-decoder-block megakernel (ISSUE
+  15, MPK-style): ONE ``pallas_call`` runs rmsnorm → QKV projections →
+  RoPE → causal flash attention (online softmax over VMEM-resident K/V)
+  → output projection → residual add → post-attention rmsnorm → SwiGLU
+  MLP → residual add.  The block-boundary activations (normalized x,
+  q/k/v, attention output, pre-MLP hidden state) never round-trip HBM:
+  a decoder block reads its input activations once and writes its
+  output once.  The grid is (batch, token_blocks, inner) where the
+  inner axis walks phases — projection column blocks, (head, k-block)
+  attention folds, output-projection columns, MLP hidden blocks — and
+  per-token-block state lives in VMEM scratch across the inner walk,
+  with K/V rows for the WHOLE sequence carried in scratch across token
+  blocks (causal attention only ever looks back).  That K/V residency
+  is the VMEM budget: eligibility requires ``2·s·dkv`` io-dtype bytes
+  plus the walked weight blocks to fit (~12 MB), so the kernel serves
+  short/medium contexts and decode-sized rows; longer shapes fall back
+  to the per-segment kernels above.  The custom VJP recomputes the
+  block from its saved INPUTS (reference math + the flash blockwise
+  backward) — block-boundary remat: training saves only x per layer
+  instead of every intermediate.
+
 Env knobs:
-  PADDLE_TPU_FUSED_BLOCK=1|0  force-enable (interpret off-TPU) /
-                              disable; unset = auto (TPU backend only)
+  PADDLE_TPU_FUSED_BLOCK=1|0      force-enable the per-segment kernels
+                                  (interpret off-TPU) / disable;
+                                  unset = auto (TPU backend only)
+  PADDLE_TPU_FUSED_BLOCK=decoder  additionally route eligible llama
+                                  decoder layers through the
+                                  whole-block megakernel (per-segment
+                                  kernels keep ineligible layers)
 """
 
 from __future__ import annotations
@@ -56,8 +82,11 @@ except Exception:  # pragma: no cover
     _HAVE_TPU_PL = False
 
 __all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn",
-           "fused_block_enabled", "fused_qkv_eligible",
-           "fused_mlp_eligible", "record_path", "SUPPORTED_ACTS"]
+           "fused_decoder_block", "fused_block_enabled",
+           "fused_block_tier", "fused_decoder_enabled",
+           "fused_qkv_eligible", "fused_mlp_eligible",
+           "fused_decoder_eligible", "decoder_vmem_bytes", "record_path",
+           "SUPPORTED_ACTS"]
 
 _ACT = {
     "silu": jax.nn.silu,
@@ -68,15 +97,35 @@ _ACT = {
 SUPPORTED_ACTS = tuple(_ACT)
 
 
+def fused_block_tier() -> str:
+    """The PADDLE_TPU_FUSED_BLOCK knob as a tier: ``"off"`` (reference
+    lowering everywhere), ``"fused"`` (the PR-8 per-segment kernels —
+    rmsnorm+QKV and MLP), ``"decoder"`` (additionally route eligible
+    llama decoder layers through the whole-block megakernel).  Unset =
+    auto: ``"fused"`` on a TPU backend, ``"off"`` elsewhere — the
+    decoder tier is opt-in only, so existing knob values reproduce
+    their previous jaxprs exactly."""
+    env = os.environ.get("PADDLE_TPU_FUSED_BLOCK", "").strip().lower()
+    if env in ("0", "false", "off", "no"):
+        return "off"
+    if env == "decoder":
+        return "decoder"
+    if env in ("1", "true", "on", "yes"):
+        return "fused"
+    return "fused" if jax.default_backend() == "tpu" else "off"
+
+
 def fused_block_enabled() -> bool:
     """Routing gate: env wins, else auto = TPU backend only (interpret
     mode off-TPU is for tests, not the hot path)."""
-    env = os.environ.get("PADDLE_TPU_FUSED_BLOCK", "").strip().lower()
-    if env in ("0", "false", "off", "no"):
-        return False
-    if env in ("1", "true", "on", "yes"):
-        return True
-    return jax.default_backend() == "tpu"
+    return fused_block_tier() != "off"
+
+
+def fused_decoder_enabled() -> bool:
+    """True only at the explicit ``PADDLE_TPU_FUSED_BLOCK=decoder``
+    tier — never auto-on, so every pre-existing knob value keeps its
+    exact previous lowering."""
+    return fused_block_tier() == "decoder"
 
 
 def _row_quantum(dtype) -> int:
@@ -622,6 +671,440 @@ def fused_mlp(x, w_gate, w_up, w_down, activation: str = "silu",
                         bool(use_pallas), bool(interpret),
                         int(block_t or 0), int(block_f or 0))
     return y.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# whole-decoder-block megakernel (ISSUE 15)
+# ---------------------------------------------------------------------------
+#
+# Inner-axis phase layout for grid (batch, token_blocks, inner):
+#
+#   [0, nqc)            q-projection column blocks (+ RoPE, into q scratch)
+#   [nqc, nqc+nkc)      k/v-projection column blocks (+ RoPE on k), written
+#                       into the sequence-wide K/V scratch at this token
+#                       block's rows — later token blocks read them back
+#                       for causal attention without any HBM traffic
+#   [B0, B0+nh*nt)      attention: (head, k-block) pairs, online softmax
+#                       in fp32 scratch; k-blocks past the causal frontier
+#                       are pl.when-skipped
+#   [C0, C0+no)         output-projection column blocks + residual add
+#   [D0, D0+nf)         post-attention rmsnorm (at the first step) and the
+#                       SwiGLU MLP hidden blocks folded into an fp32
+#                       down-projection accumulator; the final step adds
+#                       the residual and emits the block's single output
+#
+# Numerics mirror the unfused chain: every intermediate that the unfused
+# lowering materializes in the io dtype is cast to the io dtype at the
+# same point in-register (norm outputs, roped q/k, v, attention output,
+# o-proj output); statistics, softmax and matmul accumulation stay fp32.
+
+_DECODER_VMEM_BUDGET = 12 * (1 << 20)
+
+
+def decoder_vmem_bytes(s, d, dq, dkv, hd, f, bt, bo, bf, dtype) -> int:
+    """Analytic VMEM working set of the whole-block kernel: the
+    sequence-wide K/V scratch dominates; walked weight/io blocks are
+    double-buffered by the grid pipeline."""
+    it = 2 if "bfloat16" in str(dtype) or "float16" in str(dtype) else 4
+    return (2 * bt * d * it            # x block, double-buffered
+            + 2 * bt * d * it          # y block, double-buffered
+            + 2 * s * dkv * it         # K + V scratch (the budget driver)
+            + bt * d * it              # norm scratch (reused for norm2)
+            + 2 * bt * dq * it         # q + attention-out scratch
+            + bt * d * it              # post-attention residual scratch
+            + bt * d * 4               # fp32 MLP down accumulator
+            + bt * hd * 4 + 2 * bt * 4  # per-head softmax acc + m/l
+            + 2 * 3 * d * bo * it      # wq/wk/wv blocks, double-buffered
+            + 2 * dq * bo * it         # wo block
+            + 2 * (2 * d * bf + bf * d) * it   # wg/wu/wd blocks
+            + 2 * 2 * bt * (hd // 2) * 4       # rope cos/sin rows (fp32)
+            + 3 * d * it)              # norm weights
+
+
+def _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype):
+    """First (block_t, block_o, block_f) — widest out/hidden blocks
+    first, then tallest token block — whose working set fits the VMEM
+    budget; None when nothing fits (the eligibility gate)."""
+    q = _row_quantum(dtype)
+    bts = [b for b in (256, 128, 64, 32, 16, 8) if b >= q]
+    for bo in (512, 256, 128):
+        if bo % hd or dq % bo or dkv % bo or d % bo:
+            continue
+        for bf in (512, 256, 128):
+            if f % bf:
+                continue
+            for bt in bts:
+                if s % bt:
+                    continue
+                if decoder_vmem_bytes(s, d, dq, dkv, hd, f, bt, bo, bf,
+                                      dtype) < _DECODER_VMEM_BUDGET:
+                    return bt, bo, bf
+    return None
+
+
+def fused_decoder_eligible(b, s, d, dq, dkv, hd, f, dtype="float32") -> bool:
+    """Shape gate for the whole-block kernel: lane-tileable feature
+    dims, whole 128-aligned heads (RoPE and the per-head attention
+    slices walk head boundaries), a flash-legal sequence for the VJP
+    recompute, and a (bt, bo, bf) choice inside the VMEM budget."""
+    q = _row_quantum(dtype)
+    if s < q or s % q:
+        return False
+    if s % min(128, s):                 # flash blocks in the backward
+        return False
+    if d % 128 or dq % 128 or dkv % 128 or f % 128:
+        return False
+    if hd <= 0 or hd % 128 or dq % hd or dkv % hd:
+        return False
+    if (dq // hd) % (dkv // hd):        # GQA: q heads per kv head
+        return False
+    return _default_decoder_blocks(s, d, dq, dkv, hd, f,
+                                   str(dtype)) is not None
+
+
+def _decoder_kernel(x_ref, wn1_ref, wq_ref, wk_ref, wv_ref, cos_ref,
+                    sin_ref, wo_ref, wn2_ref, wg_ref, wu_ref, wd_ref,
+                    y_ref, xn_scr, q_scr, k_scr, v_scr, attn_scr, x2_scr,
+                    m_scr, l_scr, acc_scr, yacc_scr, *, eps, nh, nkvh,
+                    hd, bt, bo, bf, nqc, nkc, nt, no, nf):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    B0 = nqc + nkc
+    C0 = B0 + nh * nt
+    D0 = C0 + no
+    hh = hd // 2
+    rep = nh // nkvh
+    scale = 1.0 / (hd ** 0.5)
+    io_dt = y_ref.dtype
+
+    def _rmsnorm_into(src_f32, wn_ref):
+        inv = jax.lax.rsqrt(
+            jnp.mean(src_f32 * src_f32, axis=-1, keepdims=True) + eps)
+        xn_scr[:] = ((src_f32 * inv)
+                     * wn_ref[:].astype(jnp.float32)).astype(xn_scr.dtype)
+
+    @pl.when(j == 0)
+    def _norm1():
+        _rmsnorm_into(x_ref[0].astype(jnp.float32), wn1_ref)
+
+    def _proj(w_ref):
+        return jax.lax.dot_general(
+            xn_scr[:], w_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    def _rope_heads(blk_f32):
+        """RoPE per whole head of a [bt, bo] projection block — the
+        unfused chain quantizes projections to the io dtype before the
+        fp32 rotation, so this does too."""
+        cos = cos_ref[:].astype(jnp.float32)           # [bt, hd//2]
+        sin = sin_ref[:].astype(jnp.float32)
+        heads = []
+        for h0 in range(bo // hd):
+            gh = blk_f32[:, h0 * hd:(h0 + 1) * hd].astype(io_dt) \
+                .astype(jnp.float32)
+            x1, x2 = gh[:, :hh], gh[:, hh:]
+            heads.append(jnp.concatenate(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1))
+        return jnp.concatenate(heads, axis=-1) if len(heads) > 1 \
+            else heads[0]
+
+    # -- phase A: projections + RoPE into scratch ---------------------------
+    @pl.when(j < nqc)
+    def _q_cols():
+        q_scr[:, pl.ds(j * bo, bo)] = _rope_heads(_proj(wq_ref)) \
+            .astype(q_scr.dtype)
+
+    @pl.when(jnp.logical_and(j >= nqc, j < B0))
+    def _kv_cols():
+        jk = j - nqc
+        rows = pl.ds(i * bt, bt)
+        k_scr[rows, pl.ds(jk * bo, bo)] = _rope_heads(_proj(wk_ref)) \
+            .astype(k_scr.dtype)
+        v_scr[rows, pl.ds(jk * bo, bo)] = _proj(wv_ref).astype(v_scr.dtype)
+
+    # -- phase B: causal flash attention over the VMEM-resident K/V --------
+    @pl.when(jnp.logical_and(j >= B0, j < C0))
+    def _attention():
+        t = j - B0
+        h = t // nt
+        kj = t % nt
+
+        @pl.when(kj == 0)
+        def _init():
+            acc_scr[:] = jnp.zeros_like(acc_scr)
+            m_scr[:] = jnp.full_like(m_scr, _DEC_NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+
+        @pl.when(kj <= i)
+        def _fold():
+            qh = q_scr[:, pl.ds(h * hd, hd)]
+            kvh = h // rep
+            kb = k_scr[pl.ds(kj * bt, bt), pl.ds(kvh * hd, hd)]
+            vb = v_scr[pl.ds(kj * bt, bt), pl.ds(kvh * hd, hd)]
+            s_ = jax.lax.dot_general(
+                qh, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [bt, bt]
+            q_pos = i * bt + jax.lax.broadcasted_iota(
+                jnp.int32, (bt, bt), 0)
+            k_pos = kj * bt + jax.lax.broadcasted_iota(
+                jnp.int32, (bt, bt), 1)
+            s_ = jnp.where(q_pos >= k_pos, s_, _DEC_NEG_INF)
+            m_prev = m_scr[:]
+            m_new = jnp.maximum(m_prev, jnp.max(s_, axis=-1, keepdims=True))
+            p = jnp.exp(s_ - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_scr[:] = corr * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jax.lax.dot_general(
+                p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            acc_scr[:] = acc_scr[:] * corr + pv
+            m_scr[:] = m_new
+
+        @pl.when(kj == i)                   # last visible block: finalize
+        def _finalize():
+            l = l_scr[:]
+            safe_l = jnp.where(l > 0, l, 1.0)
+            attn_scr[:, pl.ds(h * hd, hd)] = \
+                (acc_scr[:] / safe_l).astype(attn_scr.dtype)
+
+    # -- phase C: output projection + residual ------------------------------
+    @pl.when(jnp.logical_and(j >= C0, j < D0))
+    def _o_proj():
+        jo = j - C0
+        cols = pl.ds(jo * bo, bo)
+        ob = jax.lax.dot_general(
+            attn_scr[:], wo_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        x2_scr[:, cols] = x_ref[0, :, cols] + ob.astype(io_dt)
+
+    # -- phase D: post-attention norm + SwiGLU MLP + residual ---------------
+    @pl.when(j == D0)
+    def _norm2():
+        _rmsnorm_into(x2_scr[:].astype(jnp.float32), wn2_ref)
+        yacc_scr[:] = jnp.zeros_like(yacc_scr)
+
+    @pl.when(j >= D0)
+    def _mlp():
+        xb = xn_scr[:]
+        g = jax.lax.dot_general(
+            xb, wg_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(
+            xb, wu_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        hgu = jax.nn.silu(g) * u
+        yacc_scr[:] += jax.lax.dot_general(
+            hgu.astype(wd_ref.dtype), wd_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == D0 + nf - 1)
+    def _emit():
+        y_ref[0] = x2_scr[:] + yacc_scr[:].astype(io_dt)
+
+
+_DEC_NEG_INF = -1e30
+
+
+def _decoder_pallas(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd, *,
+                    eps, nh, nkvh, bt, bo, bf, interpret):
+    b, s, d = x.shape
+    dq, dkv, f = wq.shape[1], wk.shape[1], wu.shape[1]
+    hd = dq // nh
+    nt = s // bt
+    nqc, nkc = dq // bo, dkv // bo
+    no, nf = d // bo, f // bf
+    B0 = nqc + nkc
+    C0 = B0 + nh * nt
+    D0 = C0 + no
+    inner = D0 + nf
+
+    def _clamp(lo, n):
+        return lambda bi, i, j: (0, jnp.clip(j - lo, 0, n - 1))
+
+    params = {}
+    if _HAVE_TPU_PL and not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"))
+
+    return pl.pallas_call(
+        functools.partial(_decoder_kernel, eps=eps, nh=nh, nkvh=nkvh,
+                          hd=hd, bt=bt, bo=bo, bf=bf, nqc=nqc, nkc=nkc,
+                          nt=nt, no=no, nf=nf),
+        grid=(b, nt, inner),
+        in_specs=[
+            pl.BlockSpec((1, bt, d), lambda bi, i, j: (bi, i, 0)),
+            pl.BlockSpec((1, d), lambda bi, i, j: (0, 0)),
+            pl.BlockSpec((d, bo), _clamp(0, nqc)),
+            pl.BlockSpec((d, bo), _clamp(nqc, nkc)),
+            pl.BlockSpec((d, bo), _clamp(nqc, nkc)),
+            pl.BlockSpec((bt, hd // 2), lambda bi, i, j: (i, 0)),
+            pl.BlockSpec((bt, hd // 2), lambda bi, i, j: (i, 0)),
+            pl.BlockSpec((dq, bo), _clamp(C0, no)),
+            pl.BlockSpec((1, d), lambda bi, i, j: (0, 0)),
+            pl.BlockSpec((d, bf), _clamp(D0, nf)),
+            pl.BlockSpec((d, bf), _clamp(D0, nf)),
+            pl.BlockSpec((bf, d),
+                         lambda bi, i, j: (jnp.clip(j - D0, 0, nf - 1), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bt, d), lambda bi, i, j: (bi, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, s, d), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bt, d), x.dtype),       # xn (norm1, reused norm2)
+            pltpu.VMEM((bt, dq), x.dtype),      # roped q
+            pltpu.VMEM((s, dkv), x.dtype),      # K rows, whole sequence
+            pltpu.VMEM((s, dkv), x.dtype),      # V rows, whole sequence
+            pltpu.VMEM((bt, dq), x.dtype),      # attention output
+            pltpu.VMEM((bt, d), x.dtype),       # post-attention residual
+            pltpu.VMEM((bt, 1), jnp.float32),   # online-softmax max
+            pltpu.VMEM((bt, 1), jnp.float32),   # online-softmax sum
+            pltpu.VMEM((bt, hd), jnp.float32),  # per-head softmax acc
+            pltpu.VMEM((bt, d), jnp.float32),   # MLP down accumulator
+        ],
+        interpret=interpret,
+        **params,
+    )(x, wn1.reshape(1, d), wq, wk, wv, cos, sin, wo,
+      wn2.reshape(1, d), wg, wu, wd)
+
+
+def _rope_ref(x, cos, sin):
+    """Reference RoPE on [b, s, heads, hd] with [s, hd//2] tables — the
+    same half-rotation math as F.apply_rotary_emb at offset 0."""
+    c = cos[None, :, None, :].astype(jnp.float32)
+    s_ = sin[None, :, None, :].astype(jnp.float32)
+    half = x.shape[-1] // 2
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s_, x2 * c + x1 * s_],
+                           axis=-1).astype(x.dtype)
+
+
+def _decoder_reference(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd,
+                       *, eps, nh, nkvh):
+    """The unfused decoder-block composition: rmsnorm → projections →
+    RoPE → causal flash attention → o-proj → residual → rmsnorm →
+    SwiGLU MLP → residual.  Differentiable end-to-end (flash's blockwise
+    backward keeps memory O(s·block)) — both the ineligible-shape
+    fallback of :func:`fused_decoder_block` and the recompute target of
+    its block-boundary-remat VJP."""
+    b, s, d = x.shape
+    dq, dkv = wq.shape[1], wk.shape[1]
+    hd = dq // nh
+    x2d = x.reshape(-1, d)
+    q, k, v = _qkv_reference(x2d, wn1, wq, wk, wv, eps)
+    q = _rope_ref(q.reshape(b, s, nh, hd), cos, sin)
+    k = _rope_ref(k.reshape(b, s, nkvh, hd), cos, sin)
+    v = v.reshape(b, s, nkvh, hd)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    blk = min(128, s)
+    o = flash_attention(q, k, v, causal=True, block_q=blk, block_k=blk,
+                        autotune=False)
+    h = jax.lax.dot_general(
+        o.reshape(-1, dq), wo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    x2 = x + h.reshape(b, s, d)
+    xf = x2.astype(jnp.float32)
+    inv = jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)
+    xn2 = ((xf * inv) * wn2.astype(jnp.float32)).astype(x.dtype)
+    y = _mlp_gated_reference(xn2.reshape(-1, d), wg, wu, wd, "silu")
+    return x2 + y.reshape(b, s, d)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(12, 13, 14, 15, 16, 17, 18, 19))
+def _decoder_core(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd,
+                  eps, nh, nkvh, use_pallas, interpret, bt, bo, bf):
+    if use_pallas:
+        return _decoder_pallas(x, wn1, wq, wk, wv, cos, sin, wo, wn2,
+                               wg, wu, wd, eps=eps, nh=nh, nkvh=nkvh,
+                               bt=bt, bo=bo, bf=bf, interpret=interpret)
+    return _decoder_reference(x, wn1, wq, wk, wv, cos, sin, wo, wn2,
+                              wg, wu, wd, eps=eps, nh=nh, nkvh=nkvh)
+
+
+def _decoder_fwd(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd,
+                 eps, nh, nkvh, use_pallas, interpret, bt, bo, bf):
+    y = _decoder_core(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd,
+                      eps, nh, nkvh, use_pallas, interpret, bt, bo, bf)
+    # block-boundary remat: save only the INPUTS — one activation tensor
+    # per layer instead of the unfused chain's q/k/v/attention/hidden set
+    return y, (x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd)
+
+
+def _decoder_bwd(eps, nh, nkvh, use_pallas, interpret, bt, bo, bf, res, dy):
+    # recompute the block from its saved inputs in reference math and
+    # differentiate that — the VJP of the unfused chain (flash keeps the
+    # attention backward blockwise), costing one extra block forward but
+    # no saved intermediates: the training memory story of the kernel
+    def ref(*args):
+        return _decoder_reference(*args, eps=eps, nh=nh, nkvh=nkvh)
+
+    _, vjp = jax.vjp(ref, *res)
+    return vjp(dy)
+
+
+_decoder_core.defvjp(_decoder_fwd, _decoder_bwd)
+
+
+def fused_decoder_block(x, norm1_weight, wq, wk, wv, rope_cos, rope_sin,
+                        wo, norm2_weight, wg, wu, wd, *, num_heads: int,
+                        num_kv_heads: int, epsilon: float = 1e-5,
+                        block_t: int = None, block_o: int = None,
+                        block_f: int = None, interpret: bool = None,
+                        autotune: bool = None, use_pallas: bool = None):
+    """One whole llama decoder block — rmsnorm → QKV → RoPE → causal
+    attention → o-proj (+residual) → rmsnorm → SwiGLU MLP (+residual) —
+    as a single Pallas pass whose boundary activations never round-trip
+    HBM.
+
+    x: [b, s, d]; rope_cos/rope_sin: [max_pos, head_dim//2] tables
+    (rows [0, s) are used — the no-cache, offset-0 training/prefill
+    form).  Weight layouts match the llama Linears ([in, out]).
+    Differentiable wrt every array input via block-boundary remat;
+    ineligible shapes take the unfused reference composition inside the
+    same custom VJP (the API is total)."""
+    if x.ndim != 3:
+        raise ValueError(f"fused_decoder_block expects [b, s, d], got "
+                         f"shape {tuple(x.shape)}")
+    b, s, d = int(x.shape[0]), int(x.shape[1]), int(x.shape[2])
+    dq, dkv, f = int(wq.shape[-1]), int(wk.shape[-1]), int(wu.shape[-1])
+    nh, nkvh = int(num_heads), int(num_kv_heads)
+    hd = dq // nh
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if use_pallas is None:
+        use_pallas = (int(rope_cos.shape[0]) >= s and
+                      fused_decoder_eligible(b, s, d, dq, dkv, hd, f,
+                                             x.dtype))
+    if autotune is None:
+        autotune = not interpret
+    if use_pallas and (block_t is None or block_o is None
+                       or block_f is None):
+        if autotune and not interpret:
+            from paddle_tpu.ops.pallas.autotune import decoder_block_sizes
+            blocks = decoder_block_sizes(b, s, d, dq, dkv, hd, f,
+                                         str(x.dtype))
+        else:
+            blocks = _default_decoder_blocks(s, d, dq, dkv, hd, f,
+                                             str(x.dtype))
+        if blocks is None:
+            raise ValueError(
+                f"no decoder block sizes fit the VMEM budget at "
+                f"s={s} d={d} dkv={dkv} f={f}")
+        block_t = block_t or blocks[0]
+        block_o = block_o or blocks[1]
+        block_f = block_f or blocks[2]
+    if use_pallas and (s % block_t or dq % block_o or dkv % block_o
+                       or d % block_o or f % block_f or block_o % hd):
+        raise ValueError(
+            f"shapes s={s} d={d} dq={dq} dkv={dkv} f={f} hd={hd} not "
+            f"divisible by blocks ({block_t}, {block_o}, {block_f})")
+    cos = jnp.asarray(rope_cos)[:s].astype(jnp.float32)
+    sin = jnp.asarray(rope_sin)[:s].astype(jnp.float32)
+    return _decoder_core(x, norm1_weight, wq, wk, wv, cos, sin, wo,
+                         norm2_weight, wg, wu, wd, float(epsilon), nh,
+                         nkvh, bool(use_pallas), bool(interpret),
+                         int(block_t or 0), int(block_o or 0),
+                         int(block_f or 0))
 
 
 def fused_ffn(x, w1, w2, b1=None, b2=None, activation: str = "relu",

@@ -377,6 +377,60 @@ class TestSDCSentinel:
                                                  "ok": True}
 
 
+class TestTrainStepSDCHook:
+    """ISSUE 15 satellite: SDCSentinel as an optional TrainStep hook —
+    publish/verify at the ``sdc_check_interval=`` step cadence instead
+    of a hand-written training loop driving the sentinel."""
+
+    def _step(self, sentinel, interval):
+        import paddle_tpu as pp
+        from paddle_tpu import nn
+        from paddle_tpu.jit import TrainStep
+        pp.seed(0)
+        m = nn.Linear(4, 2)
+        opt = pp.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=m.parameters())
+        return TrainStep(m, opt,
+                         loss_fn=lambda out, y: ((out - y) ** 2).mean(),
+                         sdc_sentinel=sentinel,
+                         sdc_check_interval=interval)
+
+    def test_publishes_and_verifies_at_cadence(self):
+        store = LocalStore()
+        sent = rec.SDCSentinel(store, rank=0, dp_peers=[0], host="h0",
+                               timeout=1.0)
+        step = self._step(sent, interval=2)
+        batch = (np.ones((2, 4), np.float32), np.zeros((2, 2), np.float32))
+        for _ in range(4):
+            step(batch)
+        # host steps 2 and 4 hit the cadence; 1 and 3 must not publish
+        assert store.check("sdc/2/0") and store.check("sdc/4/0")
+        assert not store.check("sdc/1/0") and not store.check("sdc/3/0")
+        assert step.last_sdc_verdict is not None
+        assert step.last_sdc_verdict["ok"]
+        assert step.last_sdc_verdict["step"] == 4
+
+    def test_hook_detects_peer_divergence(self):
+        store = LocalStore()
+        sent = rec.SDCSentinel(store, rank=0, dp_peers=[0, 1], host="h0",
+                               timeout=1.0, quarantine=False)
+        step = self._step(sent, interval=1)
+        batch = (np.ones((2, 4), np.float32), np.zeros((2, 2), np.float32))
+        # peer rank 1 publishes a digest that cannot match rank 0's
+        peer = rec.SDCSentinel(store, rank=1, dp_peers=[0, 1], host="h1",
+                               timeout=1.0, quarantine=False)
+        peer.publish(1, {"w": np.full((3,), 7.0, np.float32)})
+        step(batch)
+        assert step.last_sdc_verdict is not None
+        assert not step.last_sdc_verdict["ok"]
+
+    def test_interval_validation(self):
+        store = LocalStore()
+        sent = rec.SDCSentinel(store, rank=0, dp_peers=[0], timeout=1.0)
+        with pytest.raises(ValueError, match="sdc_check_interval"):
+            self._step(sent, interval=0)
+
+
 class TestQuarantineRoster:
     def test_roundtrip_and_clear(self):
         store = LocalStore()
