@@ -61,7 +61,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis.lint",
         description="jaxpr-level program linter / cost model")
-    ap.add_argument("target", help="module:symbol (fn, Layer, or class)")
+    ap.add_argument("target", nargs="?", default=None,
+                    help="module:symbol (fn, Layer, or class); omit "
+                         "with --kernels")
     ap.add_argument("--spec", action="append", default=[],
                     help="example input as dtype[dims], repeatable")
     ap.add_argument("--init", default=None,
@@ -74,6 +76,12 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="non-zero exit on WARNINGs too")
     ap.add_argument("--no-cost-table", action="store_true")
+    ap.add_argument("--kernels", action="store_true",
+                    help="skip tracing: statically verify the whole "
+                         "ops/pallas kernel catalog at the autotune "
+                         "bench shapes (analysis/kernel_verify) and "
+                         "print the verdict table; exit non-zero on "
+                         "ERROR (or WARNING with --strict)")
     ap.add_argument("--autoshard", action="store_true",
                     help="run the GSPMD-style layout planner instead of "
                          "the lint pipeline: enumerate DP/FSDP/TP(/PP) "
@@ -98,6 +106,10 @@ def main(argv=None) -> int:
 
     import paddle_tpu.analysis as analysis
 
+    if args.kernels:
+        return _kernels_main(args)
+    if args.target is None:
+        ap.error("target is required (or pass --kernels)")
     obj = resolve(args.target, args.init)
     example = [parse_spec(s) for s in args.spec]
     if args.autoshard:
@@ -113,6 +125,33 @@ def main(argv=None) -> int:
     if report.errors():
         return 1
     if args.strict and report.warnings():
+        return 1
+    return 0
+
+
+def _kernels_main(args) -> int:
+    """``--kernels``: the chip-free kernel-catalog verdict table.  Every
+    shipped Pallas kernel is checked at its bench shapes against the
+    Mosaic lowering constraints (VMEM footprint, lane/sublane tiling,
+    index-map coverage/races, dtype discipline)."""
+    from paddle_tpu.analysis import kernel_verify as kv
+
+    rows = kv.catalog_report()
+    print(kv.render_catalog_table(rows))
+    nerr = sum(r["errors"] for r in rows)
+    nwarn = sum(r["warnings"] for r in rows)
+    if nerr:
+        print(f"lint --kernels: FAIL — {nerr} ERROR finding(s)",
+              file=sys.stderr)
+        for r in rows:
+            for d in r["diags"]:
+                if d.severity >= kv.Severity.ERROR:
+                    print(f"  {r['kernel']}: {d.message}",
+                          file=sys.stderr)
+        return 1
+    if args.strict and nwarn:
+        print(f"lint --kernels: FAIL (--strict) — {nwarn} WARNING "
+              f"finding(s)", file=sys.stderr)
         return 1
     return 0
 

@@ -89,3 +89,7 @@ from paddle_tpu.analysis.passes import (  # noqa: E402,F401
 # layout search is opt-in via `--passes autoshard` / the lint --autoshard
 # CLI mode / analysis.autoshard.plan())
 from paddle_tpu.analysis.autoshard import planner as _autoshard  # noqa: E402,F401
+# the Pallas/Mosaic kernel static verifier registers itself too (not in
+# DEFAULT_PASSES — programs without pallas_call eqns get nothing from it;
+# opt-in via `--passes kernel-verify` / lint --kernels / verify_static())
+from paddle_tpu.analysis import kernel_verify as _kernel_verify  # noqa: E402,F401

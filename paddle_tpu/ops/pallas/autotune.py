@@ -197,6 +197,18 @@ def enabled() -> bool:
     return True
 
 
+def _verify_prune(op: str, shape: tuple, cands: list):
+    """Drop candidates the static verifier proves Mosaic-illegal before
+    any of them is benchmarked (TVM-style legality-before-search).
+    Returns (kept, n_pruned); never empties the set and never raises —
+    a broken verifier must not cost a sweep."""
+    try:
+        from paddle_tpu.analysis.kernel_verify import prune_candidates
+        return prune_candidates(op, shape, cands)
+    except Exception:   # pragma: no cover - verifier bugs must not bench-fail
+        return list(cands), 0
+
+
 def autotune(op_name: str, key: str, candidates: Sequence,
              bench: Callable[[object], float], default):
     """Return the cached winner for (op_name, key), measuring once.
@@ -284,8 +296,10 @@ def flash_block_sizes(b: int, s: int, h: int, hk: int, d: int,
     default = (min(128, s), min(128, s),
                True if pallas_bwd is None else bool(pallas_bwd))
     cands = _flash_candidates(s, d, dtype, pallas_bwd)
+    cands, _ = _verify_prune("flash", (b, s, h, hk, d, dtype, causal),
+                             cands)
     if len(cands) == 1:
-        return cands[0]
+        return tuple(cands[0])
     key = flash_key(b, s, h, hk, d, dtype, causal, pallas_bwd)
 
     def bench(blocks):
@@ -363,6 +377,7 @@ def ce_block_sizes(t: int, v: int, dtype: str) -> Tuple[int, int]:
     from paddle_tpu.ops.pallas.cross_entropy import _default_blocks
     default = _default_blocks(t, v)
     cands = _ce_candidates(t, v, dtype)
+    cands, _ = _verify_prune("fused_ce", (t, v, dtype), cands)
     if len(cands) == 1:
         return tuple(cands[0])
     key = ce_key(t, v, dtype)
@@ -435,6 +450,8 @@ def qkv_block_sizes(t: int, d: int, dq: int, dk: int, dv: int,
     from paddle_tpu.ops.pallas.fused_block import _default_qkv_blocks
     default = _default_qkv_blocks(t, d, dq, dk, dv, dtype)
     cands = _qkv_candidates(t, d, dq, dk, dv, dtype)
+    cands, _ = _verify_prune("fused_qkv", (t, d, dq, dk, dv, dtype),
+                             cands)
     if len(cands) == 1:
         return tuple(cands[0])
     key = qkv_key(t, d, dq, dk, dv, dtype)
@@ -510,6 +527,7 @@ def mlp_block_sizes(t: int, d: int, f: int, dtype: str) -> Tuple[int, int]:
     from paddle_tpu.ops.pallas.fused_block import _default_mlp_blocks
     default = _default_mlp_blocks(t, d, f, dtype)
     cands = _mlp_candidates(t, d, f, dtype)
+    cands, _ = _verify_prune("fused_mlp", (t, d, f, dtype), cands)
     if len(cands) == 1:
         return tuple(cands[0])
     key = mlp_key(t, d, f, dtype)
@@ -596,6 +614,8 @@ def decoder_block_sizes(b, s, d, dq, dkv, hd, f,
     from paddle_tpu.ops.pallas.fused_block import _default_decoder_blocks
     default = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
     cands = _decoder_candidates(s, d, dq, dkv, hd, f, dtype)
+    cands, _ = _verify_prune(
+        "fused_decoder", (b, s, d, dq, dkv, hd, f, dtype), cands)
     if default is None:
         raise ValueError(
             f"no decoder block sizes fit the VMEM budget at s={s} d={d} "
@@ -665,7 +685,7 @@ def _quant_candidates(t, k, n, wdtype, xdtype) -> list:
     for bn in (128, 256, 512):
         if n % bn:
             continue
-        for bt in (32, 64, 128, 256, 512):
+        for bt in (8, 16, 32, 64, 128, 256, 512):
             if t % bt or bt > t:
                 continue
             vmem = (2 * bt * k * x_item          # double-buffered x io
@@ -677,7 +697,7 @@ def _quant_candidates(t, k, n, wdtype, xdtype) -> list:
     if not out:
         from paddle_tpu.ops.pallas.quant_matmul import \
             _default_quant_blocks
-        out = [_default_quant_blocks(t, n)]
+        out = [_default_quant_blocks(t, n, xdtype)]
     return out
 
 
@@ -692,8 +712,10 @@ def quant_block_sizes(t: int, k: int, n: int, wdtype: str,
     at this [t, k] x [k, n] shape — forward only (serving decode never
     differentiates through it)."""
     from paddle_tpu.ops.pallas.quant_matmul import _default_quant_blocks
-    default = _default_quant_blocks(t, n)
+    default = _default_quant_blocks(t, n, xdtype)
     cands = _quant_candidates(t, k, n, wdtype, xdtype)
+    cands, _ = _verify_prune("quant_matmul", (t, k, n, wdtype, xdtype),
+                             cands)
     if len(cands) == 1:
         return tuple(cands[0])
     key = quant_key(t, k, n, wdtype, xdtype)
@@ -774,47 +796,54 @@ SWEEP_SHAPES = {
         (256, 1024, 3584, "int8", "bfloat16"),
         (256, 1024, 1024, "int8", "bfloat16"),
         (256, 1024, 3584, "float8_e4m3fn", "bfloat16"),
-        (8, 1024, 1024, "int8", "bfloat16"),
+        (16, 1024, 1024, "int8", "bfloat16"),
     ],
 }
 
 
 def _sweep_one(op, shape, dry_run, backend):
-    """(key, winner, n_candidates) for one (op, shape) sweep entry."""
+    """(key, winner, n_candidates, n_pruned) for one (op, shape) sweep
+    entry — ``n_pruned`` counts candidates the static verifier rejected
+    before any timing (``pruned_invalid`` in the sweep output)."""
     if op == "flash":
         b, s, h, hk, d, dtype, causal = shape
         cands = _flash_candidates(s, d, dtype)
         default = (min(128, s), min(128, s), True)
         key = flash_key(b, s, h, hk, d, dtype, causal, None,
                         backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
             return key, flash_block_sizes(b, s, h, hk, d, dtype, causal), \
-                len(cands)
+                len(cands), npruned
     elif op == "fused_ce":
         t, v, dtype = shape
         from paddle_tpu.ops.pallas.cross_entropy import _default_blocks
         cands = _ce_candidates(t, v, dtype)
         default = _default_blocks(t, v)
         key = ce_key(t, v, dtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
-            return key, ce_block_sizes(t, v, dtype), len(cands)
+            return key, ce_block_sizes(t, v, dtype), len(cands), npruned
     elif op == "fused_qkv":
         t, d, dq, dk, dv, dtype = shape
         from paddle_tpu.ops.pallas.fused_block import _default_qkv_blocks
         cands = _qkv_candidates(t, d, dq, dk, dv, dtype)
         default = _default_qkv_blocks(t, d, dq, dk, dv, dtype)
         key = qkv_key(t, d, dq, dk, dv, dtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
             return key, qkv_block_sizes(t, d, dq, dk, dv, dtype), \
-                len(cands)
+                len(cands), npruned
     elif op == "fused_mlp":
         t, d, f, dtype = shape
         from paddle_tpu.ops.pallas.fused_block import _default_mlp_blocks
         cands = _mlp_candidates(t, d, f, dtype)
         default = _default_mlp_blocks(t, d, f, dtype)
         key = mlp_key(t, d, f, dtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
-            return key, mlp_block_sizes(t, d, f, dtype), len(cands)
+            return key, mlp_block_sizes(t, d, f, dtype), len(cands), \
+                npruned
     elif op == "fused_decoder":
         b, s, d, dq, dkv, hd, f, dtype = shape
         from paddle_tpu.ops.pallas.fused_block import \
@@ -822,25 +851,91 @@ def _sweep_one(op, shape, dry_run, backend):
         cands = _decoder_candidates(s, d, dq, dkv, hd, f, dtype)
         default = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
         key = decoder_key(b, s, d, dq, dkv, hd, f, dtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
             return key, decoder_block_sizes(b, s, d, dq, dkv, hd, f,
-                                            dtype), len(cands)
+                                            dtype), len(cands), npruned
     elif op == "quant_matmul":
         t, k, n, wdtype, xdtype = shape
         from paddle_tpu.ops.pallas.quant_matmul import \
             _default_quant_blocks
         cands = _quant_candidates(t, k, n, wdtype, xdtype)
-        default = _default_quant_blocks(t, n)
+        default = _default_quant_blocks(t, n, xdtype)
         key = quant_key(t, k, n, wdtype, xdtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
         if not dry_run:
             return key, quant_block_sizes(t, k, n, wdtype, xdtype), \
-                len(cands)
+                len(cands), npruned
     else:
         raise ValueError(f"unknown sweep op {op!r}")
     # dry run: the heuristic default stands in for the measured winner —
     # exercises key construction + persistence without touching a chip
     _put(op, key, tuple(default))
-    return key, tuple(default), len(cands)
+    return key, tuple(default), len(cands), npruned
+
+
+def _sweep_candidates(op, shape):
+    """The sweep's candidate list for one (op, shape) entry."""
+    if op == "flash":
+        b, s, h, hk, d, dtype, causal = shape
+        return _flash_candidates(s, d, dtype)
+    if op == "fused_ce":
+        t, v, dtype = shape
+        return _ce_candidates(t, v, dtype)
+    if op == "fused_qkv":
+        t, d, dq, dk, dv, dtype = shape
+        return _qkv_candidates(t, d, dq, dk, dv, dtype)
+    if op == "fused_mlp":
+        t, d, f, dtype = shape
+        return _mlp_candidates(t, d, f, dtype)
+    if op == "fused_decoder":
+        b, s, d, dq, dkv, hd, f, dtype = shape
+        return _decoder_candidates(s, d, dq, dkv, hd, f, dtype)
+    if op == "quant_matmul":
+        t, k, n, wdtype, xdtype = shape
+        return _quant_candidates(t, k, n, wdtype, xdtype)
+    raise ValueError(f"unknown sweep op {op!r}")
+
+
+def _verify_only_main(args) -> int:
+    """--sweep --verify-only: dry-validate every candidate for every
+    sweep shape — zero timings, zero cache writes.  On-chip sweep day
+    starts from this report and skips the doomed configs."""
+    from paddle_tpu.analysis.kernel_verify import candidate_ok
+    ops = sorted(SWEEP_SHAPES) if not args.ops else \
+        [o.strip() for o in args.ops.split(",") if o.strip()]
+    all_dead = []
+    total = pruned = 0
+    for op in ops:
+        for shape in SWEEP_SHAPES[op]:
+            cands = _sweep_candidates(op, shape)
+            bad = []
+            for c in cands:
+                try:
+                    ok = candidate_ok(op, shape, c)
+                except Exception:
+                    ok = True   # match _verify_prune: never lose a config
+                if not ok:
+                    bad.append(tuple(c))
+            total += len(cands)
+            pruned += len(bad)
+            status = "ALL-PRUNED" if bad and len(bad) == len(cands) \
+                else "ok"
+            print(f"verify {op} {shape}: {len(cands) - len(bad)}/"
+                  f"{len(cands)} valid, pruned_invalid={len(bad)} "
+                  f"{('-> ' + status) if status != 'ok' else ''}".rstrip())
+            if bad:
+                print(f"  pruned: {bad}")
+            if bad and len(bad) == len(cands):
+                all_dead.append((op, shape))
+    print(f"verify-only: {total} candidates checked, {pruned} pruned, "
+          f"0 timed")
+    if all_dead:
+        print(f"FAIL: candidate set(s) 100% pruned (wrongly-strict "
+              f"verifier or unservable shape): {all_dead}",
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -855,6 +950,11 @@ def main(argv=None) -> int:
     ap.add_argument("--dry-run", action="store_true",
                     help="skip timing: write heuristic winners "
                          "(persistence round-trip without a chip)")
+    ap.add_argument("--verify-only", action="store_true",
+                    help="statically validate every sweep candidate "
+                         "(analysis/kernel_verify) with ZERO timings "
+                         "and no cache write; exit 1 if any op/shape "
+                         "has its whole candidate set pruned")
     ap.add_argument("--cache", default=None,
                     help="cache file to write (default: "
                          "PADDLE_TPU_AUTOTUNE_CACHE / ~/.cache)")
@@ -868,6 +968,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if not args.sweep:
         ap.error("nothing to do (pass --sweep)")
+    if args.verify_only:
+        return _verify_only_main(args)
 
     if args.cache:
         os.environ["PADDLE_TPU_AUTOTUNE_CACHE"] = args.cache
@@ -880,8 +982,8 @@ def main(argv=None) -> int:
     for op in ops:
         for shape in SWEEP_SHAPES[op]:
             try:
-                key, winner, ncand = _sweep_one(op, shape, args.dry_run,
-                                                backend)
+                key, winner, ncand, npruned = _sweep_one(
+                    op, shape, args.dry_run, backend)
             except Exception as e:     # a shape too big for this host
                 print(f"sweep {op} {shape}: SKIP ({type(e).__name__}: "
                       f"{e})", file=sys.stderr)
@@ -889,7 +991,8 @@ def main(argv=None) -> int:
             n += 1
             mode = "dry-run default" if args.dry_run else "measured"
             print(f"sweep {op} {shape} -> {winner}  "
-                  f"[{ncand} candidates, {mode}]")
+                  f"[{ncand} candidates, pruned_invalid={npruned}, "
+                  f"{mode}]")
     _save(args.cache)
     print(f"autotune cache: wrote {n} entries (schema v{CACHE_VERSION}) "
           f"to {args.cache or cache_path()}")

@@ -214,6 +214,11 @@ def _default_blocks(t: int, v: int):
         if v % cand == 0:
             block_v = cand
             break
+    if v % block_v:
+        # odd vocab (no power-of-two divisor >= 128): a non-dividing
+        # block would leave uncovered columns — fall back to one whole-
+        # vocab block (the verifier's coverage check catches regressions)
+        block_v = v
     block_t = 128 if t >= 128 else max(8, -(-t // 8) * 8)
     return block_t, block_v
 
@@ -260,3 +265,63 @@ def fused_softmax_cross_entropy(logits, labels, block_t=None, block_v=None,
     per_tok = _ce_core(x, lbl[:, None], int(block_t), int(block_v),
                        bool(interpret))
     return per_tok[:t]
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify)
+
+
+def _fwd_verify_spec(tp, v, bt, bv, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    nt, nv = tp // bt, v // bv
+    col = lambda i, j: (i, 0)
+    return kv.KernelSpec(
+        name="fused_ce_fwd", grid=(nt, nv),
+        args=[
+            kv.ArgSpec("x", (tp, v), (bt, bv), lambda i, j: (i, j), dtype),
+            kv.ArgSpec("lbl", (tp, 1), (bt, 1), col, "int32"),
+            kv.ArgSpec("loss", (tp, 1), (bt, 1), col, "float32",
+                       is_output=True),
+            kv.ArgSpec("lse", (tp, 1), (bt, 1), col, "float32",
+                       is_output=True),
+        ],
+        scratch=[kv.ScratchSpec("m", (bt, 1), "float32"),
+                 kv.ScratchSpec("s", (bt, 1), "float32"),
+                 kv.ScratchSpec("gold", (bt, 1), "float32")],
+        dimension_semantics=("parallel", "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"fused_ce_fwd[t={tp} v={v} bt={bt} bv={bv} {dtype}]")
+
+
+def _bwd_verify_spec(tp, v, bt, bv, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    nt, nv = tp // bt, v // bv
+    col = lambda i, j: (i, 0)
+    return kv.KernelSpec(
+        name="fused_ce_bwd", grid=(nt, nv),
+        args=[
+            kv.ArgSpec("x", (tp, v), (bt, bv), lambda i, j: (i, j), dtype),
+            kv.ArgSpec("lbl", (tp, 1), (bt, 1), col, "int32"),
+            kv.ArgSpec("lse", (tp, 1), (bt, 1), col, "float32"),
+            kv.ArgSpec("g", (tp, 1), (bt, 1), col, "float32"),
+            kv.ArgSpec("dx", (tp, v), (bt, bv), lambda i, j: (i, j),
+                       dtype, is_output=True),
+        ],
+        dimension_semantics=("parallel", "parallel"),
+        where=f"fused_ce_bwd[t={tp} v={v} bt={bt} bv={bv} {dtype}]")
+
+
+def verify_static(t, v, dtype="float32", block_t=None, block_v=None):
+    """Static Mosaic-legality findings for the fused cross-entropy
+    (fwd + bwd pallas_calls) at this shape/config.  The token axis pads
+    to the row block exactly like the wrapper does."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    dtype = str(dtype)
+    if block_t is None or block_v is None:
+        bt_d, bv_d = _default_blocks(t, v)
+        block_t = block_t or bt_d
+        block_v = block_v or bv_d
+    bt, bv = int(block_t), int(block_v)
+    tp = -(-t // bt) * bt
+    return (kv.verify_kernel(_fwd_verify_spec(tp, v, bt, bv, dtype))
+            + kv.verify_kernel(_bwd_verify_spec(tp, v, bt, bv, dtype)))

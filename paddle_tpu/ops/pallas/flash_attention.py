@@ -533,3 +533,123 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
                       bool(causal), block_q, block_k, bool(interpret),
                       bool(pallas_bwd))
     return jnp.swapaxes(out, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify) — the fwd / bwd-dq /
+# bwd-dkv pallas_calls described as KernelSpecs, same grids and index
+# maps the real calls install.
+
+
+def _fwd_verify_spec(b, s, h, hk, d, bq, bk, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    rep = h // hk
+    nq, nk = s // bq, s // bk
+    q4 = (b, h, s, d)
+    kv4 = (b, hk, s, d)
+    return kv.KernelSpec(
+        name="flash_fwd", grid=(b, h, nq, nk),
+        args=[
+            kv.ArgSpec("q", q4, (1, 1, bq, d),
+                       lambda b_, h_, i, j: (b_, h_, i, 0), dtype),
+            kv.ArgSpec("k", kv4, (1, 1, bk, d),
+                       lambda b_, h_, i, j: (b_, h_ // rep, j, 0), dtype),
+            kv.ArgSpec("v", kv4, (1, 1, bk, d),
+                       lambda b_, h_, i, j: (b_, h_ // rep, j, 0), dtype),
+            kv.ArgSpec("o", q4, (1, 1, bq, d),
+                       lambda b_, h_, i, j: (b_, h_, i, 0), dtype,
+                       is_output=True),
+            kv.ArgSpec("lse", (b, h, nq, 1, bq), (1, 1, 1, 1, bq),
+                       lambda b_, h_, i, j: (b_, h_, i, 0, 0), "float32",
+                       is_output=True),
+        ],
+        scratch=[kv.ScratchSpec("acc", (bq, d), "float32"),
+                 kv.ScratchSpec("m", (bq, 1), "float32"),
+                 kv.ScratchSpec("l", (bq, 1), "float32")],
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"flash_fwd[b={b} s={s} h={h}/{hk} d={d} bq={bq} bk={bk} "
+              f"{dtype}]")
+
+
+def _bwd_dq_verify_spec(b, s, h, hk, d, bq, bk, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    rep = h // hk
+    nq, nk = s // bq, s // bk
+    q4, kv4, stat5 = (b, h, s, d), (b, hk, s, d), (b, h, nq, 1, bq)
+    qmap = lambda b_, h_, i, j: (b_, h_, i, 0)
+    kmap = lambda b_, h_, i, j: (b_, h_ // rep, j, 0)
+    smap = lambda b_, h_, i, j: (b_, h_, i, 0, 0)
+    return kv.KernelSpec(
+        name="flash_bwd_dq", grid=(b, h, nq, nk),
+        args=[
+            kv.ArgSpec("q", q4, (1, 1, bq, d), qmap, dtype),
+            kv.ArgSpec("k", kv4, (1, 1, bk, d), kmap, dtype),
+            kv.ArgSpec("v", kv4, (1, 1, bk, d), kmap, dtype),
+            kv.ArgSpec("g", q4, (1, 1, bq, d), qmap, dtype),
+            kv.ArgSpec("lse", stat5, (1, 1, 1, 1, bq), smap, "float32"),
+            kv.ArgSpec("delta", stat5, (1, 1, 1, 1, bq), smap, "float32"),
+            kv.ArgSpec("dq", q4, (1, 1, bq, d), qmap, dtype,
+                       is_output=True),
+        ],
+        scratch=[kv.ScratchSpec("acc", (bq, d), "float32")],
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"flash_bwd_dq[b={b} s={s} h={h}/{hk} d={d} bq={bq} "
+              f"bk={bk} {dtype}]")
+
+
+def _bwd_dkv_verify_spec(b, s, h, hk, d, bq, bk, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    rep = h // hk
+    nq, nk = s // bq, s // bk
+    q4, kv4, stat5 = (b, h, s, d), (b, hk, s, d), (b, h, nq, 1, bq)
+    qmap = lambda b_, g_, j, t: (b_, g_ * rep + t // nq, t % nq, 0)
+    kmap = lambda b_, g_, j, t: (b_, g_, j, 0)
+    smap = lambda b_, g_, j, t: (b_, g_ * rep + t // nq, t % nq, 0, 0)
+    return kv.KernelSpec(
+        name="flash_bwd_dkv", grid=(b, hk, nk, rep * nq),
+        args=[
+            kv.ArgSpec("q", q4, (1, 1, bq, d), qmap, dtype),
+            kv.ArgSpec("k", kv4, (1, 1, bk, d), kmap, dtype),
+            kv.ArgSpec("v", kv4, (1, 1, bk, d), kmap, dtype),
+            kv.ArgSpec("g", q4, (1, 1, bq, d), qmap, dtype),
+            kv.ArgSpec("lse", stat5, (1, 1, 1, 1, bq), smap, "float32"),
+            kv.ArgSpec("delta", stat5, (1, 1, 1, 1, bq), smap, "float32"),
+            kv.ArgSpec("dk", kv4, (1, 1, bk, d), kmap, dtype,
+                       is_output=True),
+            kv.ArgSpec("dv", kv4, (1, 1, bk, d), kmap, dtype,
+                       is_output=True),
+        ],
+        scratch=[kv.ScratchSpec("dk_acc", (bk, d), "float32"),
+                 kv.ScratchSpec("dv_acc", (bk, d), "float32")],
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"flash_bwd_dkv[b={b} s={s} h={h}/{hk} d={d} bq={bq} "
+              f"bk={bk} {dtype}]")
+
+
+def verify_static(b, s, h, hk, d, dtype="bfloat16", causal=True,
+                  block_q=None, block_k=None, parts=("fwd", "bwd")):
+    """Static Mosaic-legality findings for the flash kernels at this
+    shape/config.  ``parts`` selects fwd and/or the two Pallas backward
+    kernels; defaults mirror :func:`flash_attention`'s non-autotuned
+    block choice (min(128, s))."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    del causal  # masking happens in-kernel; the layout is causal-agnostic
+    dtype = str(dtype)
+    bq = min(int(block_q or min(128, s)), s)
+    bk = min(int(block_k or min(128, s)), s)
+    diags = []
+    if "fwd" in parts:
+        diags += kv.verify_kernel(_fwd_verify_spec(b, s, h, hk, d, bq, bk,
+                                                   dtype))
+    if "bwd" in parts:
+        diags += kv.verify_kernel(_bwd_dq_verify_spec(b, s, h, hk, d, bq,
+                                                      bk, dtype))
+        diags += kv.verify_kernel(_bwd_dkv_verify_spec(b, s, h, hk, d, bq,
+                                                       bk, dtype))
+    return diags

@@ -165,6 +165,28 @@ def record_path(kernel: str, fused: bool):
 
 
 # ---------------------------------------------------------------------------
+# clamped index maps — the DMA-once idiom shared by the fused kernels
+# and their static-verifier specs (analysis/kernel_verify checks the
+# "each block DMAs exactly once per inner sweep" invariant concretely)
+# ---------------------------------------------------------------------------
+
+def _clamped(lo, n):
+    """Weight-spec index map: clamp the walking axis into [lo, lo+n) so
+    a block outside its phase re-uses the resident block (no DMA)."""
+    return lambda i, j: (0, jnp.clip(j - lo, 0, n - 1))
+
+
+def _clamped_out(lo, n):
+    """Output-spec variant of :func:`_clamped` (row block tracks i)."""
+    return lambda i, j: (i, jnp.clip(j - lo, 0, n - 1))
+
+
+def _clamp3(lo, n):
+    """Decoder-grid (batch, token, inner) variant of :func:`_clamped`."""
+    return lambda bi, i, j: (0, jnp.clip(j - lo, 0, n - 1))
+
+
+# ---------------------------------------------------------------------------
 # fused rmsnorm + QKV projection
 # ---------------------------------------------------------------------------
 
@@ -220,15 +242,10 @@ def _qkv_pallas(x2d, wn, wq, wk, wv, *, eps, block_t, block_o, interpret,
     nq, nkb, nvb = dq // block_o, dk // block_o, dv // block_o
 
     # each weight/output spec clamps the out-axis index into its own
-    # range: while j walks another projection's blocks the index map
-    # returns the previous value, so Mosaic re-uses the resident block
-    # instead of issuing a DMA — every block is fetched/flushed once
-    def _clamped(lo, n):
-        return lambda i, j: (0, jnp.clip(j - lo, 0, n - 1))
-
-    def _clamped_out(lo, n):
-        return lambda i, j: (i, jnp.clip(j - lo, 0, n - 1))
-
+    # range (module-level _clamped/_clamped_out): while j walks another
+    # projection's blocks the index map returns the previous value, so
+    # Mosaic re-uses the resident block instead of issuing a DMA —
+    # every block is fetched/flushed once
     out_specs = [
         pl.BlockSpec((block_t, block_o), _clamped_out(0, nq)),
         pl.BlockSpec((block_t, block_o), _clamped_out(nq, nkb)),
@@ -702,23 +719,15 @@ _DECODER_VMEM_BUDGET = 12 * (1 << 20)
 
 
 def decoder_vmem_bytes(s, d, dq, dkv, hd, f, bt, bo, bf, dtype) -> int:
-    """Analytic VMEM working set of the whole-block kernel: the
+    """VMEM working set of the whole-block kernel, computed by the
+    SHARED verifier footprint model (``analysis/kernel_verify``): the
     sequence-wide K/V scratch dominates; walked weight/io blocks are
-    double-buffered by the grid pipeline."""
-    it = 2 if "bfloat16" in str(dtype) or "float16" in str(dtype) else 4
-    return (2 * bt * d * it            # x block, double-buffered
-            + 2 * bt * d * it          # y block, double-buffered
-            + 2 * s * dkv * it         # K + V scratch (the budget driver)
-            + bt * d * it              # norm scratch (reused for norm2)
-            + 2 * bt * dq * it         # q + attention-out scratch
-            + bt * d * it              # post-attention residual scratch
-            + bt * d * 4               # fp32 MLP down accumulator
-            + bt * hd * 4 + 2 * bt * 4  # per-head softmax acc + m/l
-            + 2 * 3 * d * bo * it      # wq/wk/wv blocks, double-buffered
-            + 2 * dq * bo * it         # wo block
-            + 2 * (2 * d * bf + bf * d) * it   # wg/wu/wd blocks
-            + 2 * 2 * bt * (hd // 2) * 4       # rope cos/sin rows (fp32)
-            + 3 * d * it)              # norm weights
+    double-buffered by the grid pipeline, constant-map norm weights are
+    resident.  Because the eligibility gate and ``lint --kernels`` both
+    read this one model, their verdicts can never disagree."""
+    from paddle_tpu.analysis.kernel_verify import footprint_bytes
+    return footprint_bytes(
+        _decoder_verify_spec(1, s, d, dq, dkv, hd, f, bt, bo, bf, dtype))
 
 
 def _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype):
@@ -918,9 +927,6 @@ def _decoder_pallas(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd, *,
     D0 = C0 + no
     inner = D0 + nf
 
-    def _clamp(lo, n):
-        return lambda bi, i, j: (0, jnp.clip(j - lo, 0, n - 1))
-
     params = {}
     if _HAVE_TPU_PL and not interpret:
         params["compiler_params"] = pltpu.CompilerParams(
@@ -934,15 +940,15 @@ def _decoder_pallas(x, wn1, wq, wk, wv, cos, sin, wo, wn2, wg, wu, wd, *,
         in_specs=[
             pl.BlockSpec((1, bt, d), lambda bi, i, j: (bi, i, 0)),
             pl.BlockSpec((1, d), lambda bi, i, j: (0, 0)),
-            pl.BlockSpec((d, bo), _clamp(0, nqc)),
-            pl.BlockSpec((d, bo), _clamp(nqc, nkc)),
-            pl.BlockSpec((d, bo), _clamp(nqc, nkc)),
+            pl.BlockSpec((d, bo), _clamp3(0, nqc)),
+            pl.BlockSpec((d, bo), _clamp3(nqc, nkc)),
+            pl.BlockSpec((d, bo), _clamp3(nqc, nkc)),
             pl.BlockSpec((bt, hd // 2), lambda bi, i, j: (i, 0)),
             pl.BlockSpec((bt, hd // 2), lambda bi, i, j: (i, 0)),
-            pl.BlockSpec((dq, bo), _clamp(C0, no)),
+            pl.BlockSpec((dq, bo), _clamp3(C0, no)),
             pl.BlockSpec((1, d), lambda bi, i, j: (0, 0)),
-            pl.BlockSpec((d, bf), _clamp(D0, nf)),
-            pl.BlockSpec((d, bf), _clamp(D0, nf)),
+            pl.BlockSpec((d, bf), _clamp3(D0, nf)),
+            pl.BlockSpec((d, bf), _clamp3(D0, nf)),
             pl.BlockSpec((bf, d),
                          lambda bi, i, j: (jnp.clip(j - D0, 0, nf - 1), 0)),
         ],
@@ -1139,3 +1145,200 @@ def fused_ffn(x, w1, w2, b1=None, b2=None, activation: str = "relu",
                   bool(use_pallas), bool(interpret),
                   int(block_t or 0), int(block_f or 0))
     return y.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify) — the fused kernels
+# described as KernelSpecs so the Mosaic-legality model can check them
+# without a chip.  The specs reuse the SAME index-map closures the
+# pallas_calls install (_clamped/_clamped_out/_clamp3), so what the
+# verifier sweeps is what Mosaic would see.
+# ---------------------------------------------------------------------------
+
+def _qkv_verify_spec(t, d, dq, dk, dv, bt, bo, dtype, residuals=True):
+    from paddle_tpu.analysis import kernel_verify as kv
+    nt = t // bt if bt else 0
+    nq, nkb, nvb = dq // bo, dk // bo, dv // bo
+    args = [
+        kv.ArgSpec("x", (t, d), (bt, d), lambda i, j: (i, 0), dtype),
+        kv.ArgSpec("wn", (1, d), (1, d), lambda i, j: (0, 0), dtype,
+                   resident=True),
+        kv.ArgSpec("wq", (d, dq), (d, bo), _clamped(0, nq), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wk", (d, dk), (d, bo), _clamped(nq, nkb), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wv", (d, dv), (d, bo), _clamped(nq + nkb, nvb), dtype,
+                   dma_once=True),
+        kv.ArgSpec("q", (t, dq), (bt, bo), _clamped_out(0, nq), dtype,
+                   is_output=True),
+        kv.ArgSpec("k", (t, dk), (bt, bo), _clamped_out(nq, nkb), dtype,
+                   is_output=True),
+        kv.ArgSpec("v", (t, dv), (bt, bo), _clamped_out(nq + nkb, nvb),
+                   dtype, is_output=True),
+    ]
+    if residuals:
+        args += [
+            kv.ArgSpec("xn", (t, d), (bt, d), lambda i, j: (i, 0), dtype,
+                       is_output=True),
+            kv.ArgSpec("inv", (t, 1), (bt, 1), lambda i, j: (i, 0),
+                       "float32", is_output=True),
+        ]
+    return kv.KernelSpec(
+        name="fused_qkv", grid=(nt, nq + nkb + nvb), args=args,
+        scratch=[kv.ScratchSpec("xn_scr", (bt, d), "float32")],
+        dimension_semantics=("parallel", "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"fused_qkv[t={t} d={d} dq={dq} dk={dk} dv={dv} "
+              f"bt={bt} bo={bo} {dtype}]")
+
+
+def verify_static_qkv(t, d, dq, dk, dv, dtype="float32", block_t=None,
+                      block_o=None, residuals=True):
+    """Static Mosaic-legality findings for the fused rmsnorm+QKV kernel
+    at this shape/config (defaults = the heuristic blocks)."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    if block_t is None or block_o is None:
+        bt, bo = _default_qkv_blocks(t, d, dq, dk, dv, str(dtype))
+        block_t = block_t or bt
+        block_o = block_o or bo
+    spec = _qkv_verify_spec(t, d, dq, dk, dv, int(block_t), int(block_o),
+                            str(dtype), residuals=residuals)
+    return kv.verify_kernel(spec)
+
+
+def _mlp_verify_spec(t, d, f, bt, bf, dtype, gated=True):
+    from paddle_tpu.analysis import kernel_verify as kv
+    nt = t // bt if bt else 0
+    nf = f // bf if bf else 0
+    args = [
+        kv.ArgSpec("x", (t, d), (bt, d), lambda i, j: (i, 0), dtype),
+    ]
+    wnames = ("wg", "wu") if gated else ("w1",)
+    for w in wnames:
+        args.append(kv.ArgSpec(w, (d, f), (d, bf),
+                               lambda i, j: (0, j), dtype, dma_once=True))
+    args.append(kv.ArgSpec("wd", (f, d), (bf, d),
+                           lambda i, j: (j, 0), dtype, dma_once=True))
+    args.append(kv.ArgSpec("y", (t, d), (bt, d), lambda i, j: (i, 0),
+                           dtype, is_output=True))
+    return kv.KernelSpec(
+        name="fused_mlp" if gated else "fused_ffn",
+        grid=(nt, nf), args=args,
+        scratch=[kv.ScratchSpec("acc", (bt, d), "float32")],
+        dimension_semantics=("parallel", "arbitrary"),
+        needs_fp32_acc=True,
+        where=f"fused_mlp[t={t} d={d} f={f} bt={bt} bf={bf} {dtype}]")
+
+
+def verify_static_mlp(t, d, f, dtype="float32", block_t=None,
+                      block_f=None, gated=True):
+    """Static Mosaic-legality findings for the fused MLP/FFN kernel at
+    this shape/config (defaults = the heuristic blocks)."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    if block_t is None or block_f is None:
+        bt, bf = _default_mlp_blocks(t, d, f, str(dtype))
+        block_t = block_t or bt
+        block_f = block_f or bf
+    spec = _mlp_verify_spec(t, d, f, int(block_t), int(block_f),
+                            str(dtype), gated=gated)
+    return kv.verify_kernel(spec)
+
+
+def _decoder_verify_spec(b, s, d, dq, dkv, hd, f, bt, bo, bf, dtype):
+    from paddle_tpu.analysis import kernel_verify as kv
+    dtype = str(dtype)
+    nh, nkvh = dq // hd, dkv // hd
+    nt = s // bt if bt else 0
+    nqc, nkc = dq // bo, dkv // bo
+    no, nf = d // bo, f // bf
+    B0 = nqc + nkc
+    C0 = B0 + nh * nt
+    D0 = C0 + no
+    inner = D0 + nf
+    hh = hd // 2
+    args = [
+        kv.ArgSpec("x", (b, s, d), (1, bt, d),
+                   lambda bi, i, j: (bi, i, 0), dtype),
+        kv.ArgSpec("wn1", (1, d), (1, d), lambda bi, i, j: (0, 0), dtype,
+                   resident=True),
+        kv.ArgSpec("wq", (d, dq), (d, bo), _clamp3(0, nqc), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wk", (d, dkv), (d, bo), _clamp3(nqc, nkc), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wv", (d, dkv), (d, bo), _clamp3(nqc, nkc), dtype,
+                   dma_once=True),
+        kv.ArgSpec("cos", (s, hh), (bt, hh),
+                   lambda bi, i, j: (i, 0), "float32"),
+        kv.ArgSpec("sin", (s, hh), (bt, hh),
+                   lambda bi, i, j: (i, 0), "float32"),
+        kv.ArgSpec("wo", (dq, d), (dq, bo), _clamp3(C0, no), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wn2", (1, d), (1, d), lambda bi, i, j: (0, 0), dtype,
+                   resident=True),
+        kv.ArgSpec("wg", (d, f), (d, bf), _clamp3(D0, nf), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wu", (d, f), (d, bf), _clamp3(D0, nf), dtype,
+                   dma_once=True),
+        kv.ArgSpec("wd", (f, d), (bf, d),
+                   lambda bi, i, j: (jnp.clip(j - D0, 0, nf - 1), 0),
+                   dtype, dma_once=True),
+        kv.ArgSpec("y", (b, s, d), (1, bt, d),
+                   lambda bi, i, j: (bi, i, 0), dtype, is_output=True),
+    ]
+    kv_note = (f"K/V rows for the WHOLE sequence stay VMEM-resident "
+               f"(s={s}, dkv={dkv})")
+    scratch = [
+        kv.ScratchSpec("xn", (bt, d), dtype),
+        kv.ScratchSpec("q", (bt, dq), dtype),
+        kv.ScratchSpec("k_seq", (s, dkv), dtype, seq_scaling=True,
+                       note=kv_note),
+        kv.ScratchSpec("v_seq", (s, dkv), dtype, seq_scaling=True,
+                       note=kv_note),
+        kv.ScratchSpec("attn", (bt, dq), dtype),
+        kv.ScratchSpec("x2", (bt, d), dtype),
+        kv.ScratchSpec("m", (bt, 1), "float32"),
+        kv.ScratchSpec("l", (bt, 1), "float32"),
+        kv.ScratchSpec("acc", (bt, hd), "float32"),
+        kv.ScratchSpec("yacc", (bt, d), "float32"),
+    ]
+    return kv.KernelSpec(
+        name="fused_decoder", grid=(b, nt, inner), args=args,
+        scratch=scratch,
+        dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        vmem_budget=_DECODER_VMEM_BUDGET,
+        needs_fp32_acc=True,
+        lane_concat=(
+            f"in-kernel RoPE concatenates rotated half-heads and "
+            f"{bo // hd} head slice(s) along the last axis of a "
+            f"[{bt}, {bo}] block (hd={hd})"),
+        where=f"fused_decoder[b={b} s={s} d={d} dq={dq} dkv={dkv} "
+              f"f={f} bt={bt} bo={bo} bf={bf} {dtype}]")
+
+
+def verify_static_decoder(b, s, d, dq, dkv, hd, f, dtype="float32",
+                          block_t=None, block_o=None, block_f=None):
+    """Static Mosaic-legality findings for the whole-decoder-block
+    megakernel at this shape/config.  Surfaces the two named Mosaic
+    risks as WARNINGs (lane-axis RoPE concat, seq-scaling K/V scratch)
+    and errors when no block choice fits the VMEM budget."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    dtype = str(dtype)
+    if block_t is None or block_o is None or block_f is None:
+        blocks = _default_decoder_blocks(s, d, dq, dkv, hd, f, dtype)
+        if blocks is None:
+            diags = [kv._d(
+                kv.Severity.ERROR, kv.VMEM_EXCEEDED,
+                f"fused_decoder: no (block_t, block_o, block_f) choice "
+                f"fits the {_DECODER_VMEM_BUDGET >> 20} MiB budget at "
+                f"s={s} d={d} dkv={dkv} f={f} ({dtype})",
+                where=f"fused_decoder[b={b} s={s} d={d} {dtype}]",
+                hint="the 2*s*dkv K/V scratch dominates; shorten the "
+                     "sequence or fall back to the per-segment kernels")]
+            kv._record("fused_decoder", kv.verdict_of(diags))
+            return diags
+        block_t = block_t or blocks[0]
+        block_o = block_o or blocks[1]
+        block_f = block_f or blocks[2]
+    spec = _decoder_verify_spec(b, s, d, dq, dkv, hd, f, int(block_t),
+                                int(block_o), int(block_f), dtype)
+    return kv.verify_kernel(spec)

@@ -223,3 +223,55 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths,
         **params,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
       *operands)
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify)
+
+
+def verify_static(B, h, hd, kvh, bs, nb, mb, dtype="bfloat16",
+                  quant=False):
+    """Static Mosaic-legality findings for the paged decode kernel.
+    The block-table scalar-prefetch operand is synthesized (row b's
+    logical block j lives at physical block ``(b*mb + j) % nb``) so the
+    pool index maps evaluate concretely over the whole (B, mb) grid."""
+    import numpy as np
+    from paddle_tpu.analysis import kernel_verify as kv
+    dtype = str(dtype)
+    group = h // kvh
+    bt = (np.arange(B, dtype=np.int32)[:, None] * mb
+          + np.arange(mb, dtype=np.int32)[None, :]) % nb
+    lengths = np.full((B,), mb * bs, dtype=np.int32)
+    pool4 = (nb, bs, kvh, hd)
+    pool_map = lambda b, j, bt, ln: (bt[b, j], 0, 0, 0)
+    row_map = lambda b, j, bt, ln: (b, 0, 0)
+    args = [
+        kv.ArgSpec("q", (B, h, hd), (1, h, hd), row_map, dtype),
+        kv.ArgSpec("k_pool", pool4, (1, bs, kvh, hd), pool_map,
+                   "int8" if quant else dtype),
+        kv.ArgSpec("v_pool", pool4, (1, bs, kvh, hd), pool_map,
+                   "int8" if quant else dtype),
+    ]
+    if quant:
+        scale_map = lambda b, j, bt, ln: (bt[b, j], 0, 0)
+        args += [
+            kv.ArgSpec("k_scale", (nb, bs, kvh), (1, bs, kvh), scale_map,
+                       "float32"),
+            kv.ArgSpec("v_scale", (nb, bs, kvh), (1, bs, kvh), scale_map,
+                       "float32"),
+        ]
+    args.append(kv.ArgSpec("o", (B, h, hd), (1, h, hd), row_map, dtype,
+                           is_output=True))
+    spec = kv.KernelSpec(
+        name="paged_decode", grid=(B, mb), args=args,
+        scratch=[kv.ScratchSpec("acc", (kvh, group, hd), "float32"),
+                 kv.ScratchSpec("m", (kvh, group, 1), "float32"),
+                 kv.ScratchSpec("l", (kvh, group, 1), "float32")],
+        dimension_semantics=("parallel", "arbitrary"),
+        scalar_prefetch=(bt, lengths),
+        needs_fp32_acc=True,
+        scale_pairs=[("k_scale", "k_pool"),
+                     ("v_scale", "v_pool")] if quant else [],
+        where=f"paged_decode[B={B} h={h}/{kvh} hd={hd} bs={bs} nb={nb} "
+              f"mb={mb} {dtype}{' int8-kv' if quant else ''}]")
+    return kv.verify_kernel(spec)

@@ -102,15 +102,26 @@ def record_path(kernel: str, path: str):
         pass
 
 
-def _default_quant_blocks(t: int, n: int):
+def _default_quant_blocks(t: int, n: int, xdtype=None):
     """Heuristic (block_t, block_n) when the autotune cache is cold.
     Always valid: falls back to degenerate blocks when a dim doesn't
-    tile (interpret-mode tests at odd shapes)."""
-    bt = 1
-    for c in (256, 128, 64, 32, 16, 8):
-        if t >= c and t % c == 0:
+    tile (interpret-mode tests at odd shapes).  ``xdtype`` (the io/
+    activation dtype) restricts the row block to its sublane quantum
+    (bf16/fp16 tiles pack 16 rows) so the choice Mosaic sees is never
+    sublane-padded."""
+    quantum = 16 if xdtype is not None and \
+        ("bfloat16" in str(xdtype) or "float16" in str(xdtype)) else 8
+    bt = None
+    for c in (256, 128, 64, 32, 16, 8):   # quantum-aligned first
+        if c % quantum == 0 and t >= c and t % c == 0:
             bt = c
             break
+    if bt is None:                        # degenerate shapes: old ladder
+        bt = 1
+        for c in (256, 128, 64, 32, 16, 8):
+            if t >= c and t % c == 0:
+                bt = c
+                break
     bn = n
     for c in (512, 256, 128):
         if n % c == 0:
@@ -208,3 +219,39 @@ def quant_matmul(x, qw, scale, *, mode: str = "int8", interpret=None):
     x2d = x.reshape(t, k)
     out = quant_matmul_pallas(x2d, qw, scale, interpret=interpret)
     return out.reshape(lead + (n,))
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify)
+
+
+def verify_static(t, k, n, wdtype="int8", xdtype="bfloat16",
+                  block_t=None, block_n=None):
+    """Static Mosaic-legality findings for the weight-only quantized
+    matmul at this shape/config — includes the scale-operand shape
+    agreement check (``scale`` lanes must track the weight tile)."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    wdtype, xdtype = str(wdtype), str(xdtype)
+    if block_t is None or block_n is None:
+        bt_d, bn_d = _default_quant_blocks(t, n, xdtype)
+        block_t = block_t or bt_d
+        block_n = block_n or bn_d
+    bt, bn = int(block_t), int(block_n)
+    spec = kv.KernelSpec(
+        name="quant_matmul", grid=(t // bt if bt else 0,
+                                   n // bn if bn else 0),
+        args=[
+            kv.ArgSpec("x", (t, k), (bt, k), lambda i, j: (i, 0), xdtype),
+            kv.ArgSpec("qw", (k, n), (k, bn), lambda i, j: (0, j),
+                       wdtype),
+            kv.ArgSpec("scale", (1, n), (1, bn), lambda i, j: (0, j),
+                       "float32"),
+            kv.ArgSpec("o", (t, n), (bt, bn), lambda i, j: (i, j),
+                       xdtype, is_output=True),
+        ],
+        dimension_semantics=("parallel", "parallel"),
+        needs_fp32_acc=True, acc_inline=True,
+        scale_pairs=[("scale", "qw")],
+        where=f"quant_matmul[t={t} k={k} n={n} {wdtype}/{xdtype} "
+              f"bt={bt} bn={bn}]")
+    return kv.verify_kernel(spec)

@@ -86,6 +86,18 @@ def _fwd_pallas(x2d, res2d, w, *, eps, block_rows, interpret):
     return y, h, inv
 
 
+def _default_block_rows(rows, d, dtype):
+    """Row-block heuristic.  VMEM budget: the block holds x, res, y, h
+    (io dtype) plus ~3 fp32 working copies — keep it under ~8 MB."""
+    import numpy as np
+    per_row = d * (4 * np.dtype(dtype).itemsize + 3 * 4)
+    budget = (8 << 20) // max(per_row, 1)
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if cand <= budget and rows % cand == 0:
+            return cand
+    return 8
+
+
 def _ref_fwd(x2d, res2d, w, eps):
     h = x2d.astype(jnp.float32)
     if res2d is not None:
@@ -104,15 +116,7 @@ def _fwd(x2d, res2d, w, eps, has_res, use_pallas, interpret):
     r = res2d if has_res else None
     if use_pallas:
         rows, d = x2d.shape
-        # VMEM budget: the block holds x, res, y, h (io dtype) plus ~3
-        # fp32 working copies — keep it under ~8 MB
-        per_row = d * (4 * x2d.dtype.itemsize + 3 * 4)
-        budget = (8 << 20) // per_row
-        block_rows = 8
-        for cand in (512, 256, 128, 64, 32, 16, 8):
-            if cand <= budget and rows % cand == 0:
-                block_rows = cand
-                break
+        block_rows = _default_block_rows(rows, d, x2d.dtype)
         y, h, inv = _fwd_pallas(x2d, r, w, eps=eps, block_rows=block_rows,
                                 interpret=interpret)
     else:
@@ -167,3 +171,35 @@ def fused_rmsnorm(x, weight, residual=None, epsilon: float = 1e-5,
     y, h = _core(x2d, res2d, weight, float(epsilon), has_res,
                  bool(use_pallas), bool(interpret))
     return y.reshape(shape), h.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# static verification (analysis/kernel_verify)
+
+
+def verify_static(rows, d, dtype="float32", block_rows=None,
+                  residual=True):
+    """Static Mosaic-legality findings for the fused rmsnorm forward at
+    this shape/config (the residual-add variant by default — it is a
+    superset of the plain one's operand list)."""
+    from paddle_tpu.analysis import kernel_verify as kv
+    dtype = str(dtype)
+    br = int(block_rows or _default_block_rows(rows, d, dtype))
+    row = lambda i: (i, 0)
+    args = [
+        kv.ArgSpec("x", (rows, d), (br, d), row, dtype),
+        kv.ArgSpec("res", (rows, d), (br, d), row, dtype),
+        kv.ArgSpec("w", (1, d), (1, d), lambda i: (0, 0), dtype,
+                   resident=True),
+        kv.ArgSpec("y", (rows, d), (br, d), row, dtype, is_output=True),
+        kv.ArgSpec("h", (rows, d), (br, d), row, dtype, is_output=True),
+        kv.ArgSpec("inv", (rows, 1), (br, 1), row, "float32",
+                   is_output=True),
+    ]
+    if not residual:
+        args = [a for a in args if a.name != "res"]
+    spec = kv.KernelSpec(
+        name="rmsnorm_fwd", grid=(rows // br,), args=args,
+        dimension_semantics=("parallel",),
+        where=f"rmsnorm_fwd[rows={rows} d={d} br={br} {dtype}]")
+    return kv.verify_kernel(spec)

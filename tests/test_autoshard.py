@@ -372,6 +372,7 @@ class TestPlanLlama:
         t = res.table()
         assert "pred ms" in t and "<- emit" in t
 
+    @pytest.mark.slow
     def test_plan_runs_through_trainstep_shardings(self, llama_step):
         cfg, model, step, batch = llama_step
         res = autoshard.plan(step, batch, n_devices=8)

@@ -87,6 +87,7 @@ class TestFusedDecoderKernel:
         scale = max(float(jnp.abs(yr).max()), 1e-6)
         assert float(jnp.abs(y - yr).max()) / scale < 3e-2
 
+    @pytest.mark.slow
     def test_grads_match_reference(self):
         b, s, d, nh, nkvh, f = 2, 64, 256, 2, 1, 512
         hd = 128
@@ -228,6 +229,7 @@ class TestDecoderRouting:
         ld, l0 = logits("decoder"), logits("0")
         assert np.abs(ld - l0).max() < 2e-4, np.abs(ld - l0).max()
 
+    @pytest.mark.slow
     def test_trainstep_losses_match_reference_path(self, monkeypatch):
         import paddle_tpu as pp
         from paddle_tpu.jit import TrainStep
@@ -546,6 +548,7 @@ class TestCollectiveOverlap:
         assert jc != ja
         assert "optimization_barrier" in jc
 
+    @pytest.mark.slow
     def test_loss_equivalent_and_counter_fires(self):
         rng = np.random.default_rng(0)
         ids = rng.integers(0, 256, (8, 17)).astype(np.int32)

@@ -323,6 +323,7 @@ class TestRouting:
         l0 = np.asarray(model(pp.to_tensor(ids)).numpy(), np.float32)
         assert np.abs(l1 - l0).max() < 2e-4, np.abs(l1 - l0).max()
 
+    @pytest.mark.slow
     def test_trainstep_losses_match_reference_path(self, monkeypatch):
         import paddle_tpu as pp
         from paddle_tpu.jit import TrainStep

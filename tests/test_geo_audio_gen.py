@@ -141,6 +141,7 @@ class TestGenerate:
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         return LlamaForCausalLM(LlamaConfig.tiny())
 
+    @pytest.mark.slow
     def test_greedy_matches_full_forward(self):
         from paddle_tpu.generation import GenerationConfig
         model = self._model()
@@ -196,6 +197,7 @@ class TestGenerate:
 
 
 class TestGPTGenerate:
+    @pytest.mark.slow
     def test_gpt_greedy_matches_full_forward(self):
         pp.seed(0)
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

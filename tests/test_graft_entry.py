@@ -18,11 +18,13 @@ def test_entry_compiles():
     assert np.isfinite(np.asarray(out).sum())
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_1():
     import __graft_entry__ as g
     g.dryrun_multichip(1)

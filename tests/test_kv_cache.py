@@ -309,6 +309,7 @@ class TestPagedEngineParity:
         rid = eng.add_request(prompt, max_new_tokens=8)
         assert eng.run()[rid][1] == _reference(tiny_model, prompt, 8)
 
+    @pytest.mark.slow
     def test_multi_slot_reuse(self, tiny_model):
         rng = np.random.default_rng(11)
         prompts = [rng.integers(0, 256, (n,)) for n in (5, 13, 17, 30)]
@@ -343,6 +344,7 @@ class TestPagedEngineParity:
         rid = eng.add_request(prompt, max_new_tokens=5)
         assert eng.run()[rid][1] == _reference(tiny_model, prompt, 5)
 
+    @pytest.mark.slow
     def test_steps_per_sync_parity(self, tiny_model):
         rng = np.random.default_rng(14)
         prompts = [rng.integers(0, 256, (n,)) for n in (6, 11)]

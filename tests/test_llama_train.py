@@ -32,6 +32,7 @@ def test_llama_gqa_and_tied():
     assert model.lm_head is None
 
 
+@pytest.mark.slow
 def test_llama_kv_cache_matches_full_forward():
     cfg = LlamaConfig.tiny()
     model = LlamaForCausalLM(cfg)
@@ -147,6 +148,7 @@ def test_train_step_remat():
     assert np.isfinite(float(step(_batch(cfg))))
 
 
+@pytest.mark.slow
 def test_train_step_checkpoint_roundtrip():
     cfg = LlamaConfig.tiny()
     model = LlamaForCausalLM(cfg)
@@ -177,6 +179,7 @@ def test_to_static_layer():
     np.testing.assert_allclose(eager, static, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_bf16_model_trains():
     cfg = LlamaConfig.tiny(dtype="bfloat16")
     model = LlamaForCausalLM(cfg)

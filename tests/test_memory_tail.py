@@ -230,6 +230,7 @@ class TestGradAccum:
         losses = [float(step(batch)) for _ in range(steps)]
         return losses, step.params
 
+    @pytest.mark.slow
     def test_accum4_matches_full_batch(self):
         l1, p1 = self._train(1)
         l4, p4 = self._train(4)

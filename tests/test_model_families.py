@@ -72,6 +72,7 @@ class TestMoELLM:
         assert model.model.layers[0].is_dense
         assert not model.model.layers[1].is_dense
 
+    @pytest.mark.slow
     def test_train_step_with_ep_sharding(self):
         pp.seed(0)
         cfg = MoEConfig.tiny(num_experts=4)
@@ -95,6 +96,7 @@ class TestMoELLM:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_expert_grads_flow(self):
         pp.seed(0)
         cfg = MoEConfig.tiny(num_experts=4, first_k_dense_replace=0)
@@ -179,6 +181,7 @@ class TestDiT:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet18_forward(self):
         from paddle_tpu.vision.models import resnet18
         pp.seed(0)
@@ -187,6 +190,7 @@ class TestResNet:
         out = net(x)
         assert tuple(out.shape) == (2, 10)
 
+    @pytest.mark.slow
     def test_resnet50_bottleneck(self):
         from paddle_tpu.vision.models import resnet50
         pp.seed(0)
@@ -194,6 +198,7 @@ class TestResNet:
         x = pp.randn([1, 3, 64, 64])
         assert tuple(net(x).shape) == (1, 4)
 
+    @pytest.mark.slow
     def test_train_step(self):
         from paddle_tpu.vision.models import resnet18
         pp.seed(0)
@@ -243,6 +248,7 @@ class TestErnie:
         assert tuple(h.shape) == (2, 12, cfg.hidden_size)
         assert tuple(pooled.shape) == (2, cfg.hidden_size)
 
+    @pytest.mark.slow
     def test_classifier_trains_to_loss_drop(self):
         from paddle_tpu.models import (ErnieConfig,
                                        ErnieForSequenceClassification)
@@ -319,6 +325,7 @@ class TestConvFamilyTraining:
     """Conv-family models train to a loss drop (the vision-zoo models the
     conv_train_bench measures; VERDICT r4 Next #3)."""
 
+    @pytest.mark.slow
     def test_resnet18_reduces_loss(self):
         from paddle_tpu.vision.models import resnet18
         pp.seed(0)
@@ -336,6 +343,7 @@ class TestConvFamilyTraining:
         losses = [float(step((x, y))) for _ in range(8)]
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow
     def test_crnn_ctc_reduces_loss(self):
         """conv backbone -> BiLSTM -> CTC (the PP-OCR recognizer shape)
         trains: loss drops over a few steps on a fixed batch."""

@@ -19,6 +19,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.mark.slow
 class TestTinyPlanInProcess:
     def test_llama_plan_reports_memory(self):
         from paddle_tpu.distributed.planner import DenseConfig, plan_llama
@@ -64,6 +65,7 @@ import json
 """
 
 
+@pytest.mark.slow
 class TestFlagshipConfigsFitV5p:
     """The BASELINE.md config matrix, compiled at full size on 64 virtual
     devices; per-device peak must fit a v5p chip (95 GiB HBM)."""

@@ -378,6 +378,7 @@ class TestKnobOffRegression:
         assert "i8[" in jaxpr
         eng.close()
 
+    @pytest.mark.slow
     def test_knob_off_tokens_identical(self, tiny_model, monkeypatch):
         monkeypatch.delenv("PADDLE_TPU_QUANT_WEIGHTS", raising=False)
         monkeypatch.delenv("PADDLE_TPU_QUANT_KV", raising=False)

@@ -97,6 +97,7 @@ class TestPallasFlashAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.slow
     def test_backward_blockwise(self):
         from paddle_tpu.ops.pallas.flash_attention import flash_attention
         q, k, v = make_qkv(s=128, d=64)
@@ -115,6 +116,7 @@ class TestPallasFlashAttention:
         with pytest.raises(ValueError, match="divisible"):
             flash_attention(q, k, v, block_q=64, block_k=64, interpret=True)
 
+    @pytest.mark.slow
     def test_backward_pallas_gqa_matches_dense(self):
         # grouped-GQA through the Pallas dkv kernel (query-group inner axis)
         from paddle_tpu.ops.pallas.flash_attention import flash_attention

@@ -37,6 +37,7 @@ class TestContinuousBatching:
         results = eng.run()
         assert results[rid][1] == _reference(tiny_model, prompt, 8)
 
+    @pytest.mark.slow
     def test_slot_reuse_more_requests_than_slots(self, tiny_model):
         """5 requests through 2 slots: all finish, all match the
         sequential generate oracle, different prompt lengths exercise

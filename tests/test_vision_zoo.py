@@ -11,10 +11,12 @@ from paddle_tpu.vision import models
 
 @pytest.mark.parametrize("build,in_shape,classes", [
     (lambda: models.LeNet(num_classes=10), (2, 1, 28, 28), 10),
-    (lambda: models.mobilenet_v2(scale=0.35, num_classes=7),
-     (1, 3, 64, 64), 7),
-    (lambda: models.squeezenet1_1(num_classes=5), (1, 3, 96, 96), 5),
-    (lambda: models.vgg11(num_classes=4), (1, 3, 224, 224), 4),
+    pytest.param(lambda: models.mobilenet_v2(scale=0.35, num_classes=7),
+                 (1, 3, 64, 64), 7, marks=pytest.mark.slow),
+    pytest.param(lambda: models.squeezenet1_1(num_classes=5),
+                 (1, 3, 96, 96), 5, marks=pytest.mark.slow),
+    pytest.param(lambda: models.vgg11(num_classes=4),
+                 (1, 3, 224, 224), 4, marks=pytest.mark.slow),
 ])
 def test_forward_shapes(build, in_shape, classes):
     pp.seed(0)
