@@ -159,6 +159,7 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
                 f"fleet.speedup {float(cf['speedup']):.3f} < prev "
                 f"{float(pf['speedup']):.3f} - {tolerance:.0%} "
                 "tolerance")
+    regressions += _compare_calibration(cur, prev, tolerance)
     return regressions
 
 
@@ -235,6 +236,33 @@ def compare_records(cur: dict, prev: dict, tolerance: float = 0.05):
         regressions.append(
             f"recovery.mttr_s {float(cm):.4f} > prev {float(pm):.4f} + "
             f"{tolerance:.0%} tolerance")
+    regressions += _compare_calibration(cur, prev, tolerance)
+    return regressions
+
+
+def _compare_calibration(cur: dict, prev: dict, tolerance: float):
+    """Calibration-health trajectory (guarded: only once BOTH artifacts
+    carry an enabled ``detail.calibration`` section): ledger coverage is
+    better-higher, mean |residual-1| better-lower.  Residuals on a
+    shared CPU host are noisy, so the residual bar is a 2x+ blowup past
+    tolerance (the cold-start convention), while coverage — a counting
+    ratio — uses the plain tolerance."""
+    regressions = []
+    pc = (prev.get("detail") or {}).get("calibration") or {}
+    cc = (cur.get("detail") or {}).get("calibration") or {}
+    if not (pc.get("enabled") and cc.get("enabled")):
+        return regressions
+    pv, cv = pc.get("coverage"), cc.get("coverage")
+    if pv and cv is not None and \
+            float(cv) < float(pv) * (1.0 - tolerance):
+        regressions.append(
+            f"calibration.coverage {float(cv):.3f} < prev "
+            f"{float(pv):.3f} - {tolerance:.0%} tolerance")
+    pv, cv = pc.get("mean_abs_residual"), cc.get("mean_abs_residual")
+    if pv and cv and float(cv) > float(pv) * (2.0 + tolerance):
+        regressions.append(
+            f"calibration.mean_abs_residual {float(cv):.3f} > prev "
+            f"{float(pv):.3f} x (2 + {tolerance:.0%})")
     return regressions
 
 
@@ -724,6 +752,32 @@ def main(argv=None):
         },
     }
 
+    # measurement ledger (ROADMAP 5): the whole measured train step
+    # lands in the calibration corpus with its roofline prediction —
+    # the record a fresh planner process calibrates against — and the
+    # detail.calibration section summarizes residual health for
+    # --compare (coverage better-higher, |residual| better-lower).
+    # The profiler segments above already fed their own rows.
+    from paddle_tpu.observability import calibration
+    if calibration.enabled():
+        peak = bw = None
+        if profile_segments:
+            try:
+                peak, bw = prof.peak_flops, prof.hbm_bw
+            except Exception:
+                peak = bw = None
+        if not peak or not bw:
+            from paddle_tpu.observability.device_profiler import \
+                detect_roofline
+            peak, bw = detect_roofline()
+        step_pred_s = max(
+            compile_info.stats.flops / peak if peak else 0.0,
+            compile_info.stats.bytes_accessed / bw if bw else 0.0)
+        calibration.ledger().record(
+            "train_step", (batch, seq), measured_s=dt,
+            predicted_s=step_pred_s, provenance="bench")
+    calibration_detail = calibration.bench_detail()
+
     # goodput ledger (fleet observability): productive step seconds over
     # the bench's own wall clock, with the lost-time attribution — the
     # field --compare guards alongside MFU once two artifacts carry it
@@ -765,6 +819,7 @@ def main(argv=None):
             "device_profile": device_profile,
             "cold_start": cold_start,
             "goodput": goodput_detail,
+            "calibration": calibration_detail,
         },
     }
     print(json.dumps(result))

@@ -438,6 +438,17 @@ def main(argv=None):
                 if k.endswith("/deserialize_error")),
         },
     }
+    # measurement ledger (PADDLE_TPU_CALIBRATION=1): serving's decode
+    # latency joins the corpus (provenance bench_serve; no model
+    # prediction, so it contributes measurement coverage, not a
+    # residual) and the artifact carries the same calibration-health
+    # section bench.py does, guarded identically by --compare
+    from paddle_tpu.observability import calibration
+    if calibration.enabled() and tpot["p50"]:
+        calibration.ledger().record(
+            "serve_decode", (args.slots, args.max_len),
+            measured_s=float(tpot["p50"]), provenance="bench_serve")
+    detail["calibration"] = calibration.bench_detail()
     if paged:
         detail["kv_blocks_total"] = eng._num_blocks - 1
         detail["kv_blocks_peak_used"] = eng._blocks_used_peak

@@ -59,11 +59,25 @@ class CandidateScore:
     refined_hbm_bytes: Optional[int] = None   # XLA buffer assignment
     pp_overhead_s: float = 0.0
     pruned: Optional[str] = None
+    calibrated_s: Optional[float] = None    # ledger-corrected step time
+    residual: Optional[float] = None        # measured/predicted factor
+
+    @property
+    def raw_step_seconds(self) -> float:
+        """The uncalibrated analytic prediction."""
+        return (max(self.compute_s, self.memory_s) + self.collective_s
+                + self.pp_overhead_s)
 
     @property
     def step_seconds(self) -> float:
-        return (max(self.compute_s, self.memory_s) + self.collective_s
-                + self.pp_overhead_s)
+        """What ranking and the beats-manual gate use: the calibrated
+        time when the measurement ledger covered this shape
+        (PADDLE_TPU_CALIBRATION=1 + a matching train_step record),
+        otherwise the raw roofline prediction — coverage-gated
+        fallback, so with the knob off nothing changes."""
+        if self.calibrated_s is not None:
+            return self.calibrated_s
+        return self.raw_step_seconds
 
     @property
     def hbm_bytes(self) -> int:
@@ -170,15 +184,29 @@ class PlanResult:
         # "raw ms" the undiscounted ring time — printed side by side so
         # a manual-baseline comparison stays honest about how much of
         # the predicted win is latency hiding vs fewer bytes
-        rows = [f"{'rank':>4s} {'layout':22s} {'pred ms':>9s} "
-                f"{'compute':>8s} {'memory':>8s} {'coll ms':>8s} "
-                f"{'raw ms':>8s} {'coll MB':>8s} {'HBM MiB':>8s}  note"]
+        # calib ms / resid render only when the measurement ledger
+        # served this shape (PADDLE_TPU_CALIBRATION=1 + coverage) —
+        # then ranking already used the calibrated number
         live = [s for s in self.scored if s.pruned is None]
         live.sort(key=lambda s: s.step_seconds)
+        calibrated = any(s.calibrated_s is not None for s in live)
+
+        def _cal_cols(s) -> str:
+            if not calibrated:
+                return ""
+            if s.calibrated_s is None:
+                return f"{'-':>9s} {'-':>6s} "
+            return f"{s.calibrated_s * 1e3:9.3f} {s.residual:6.2f} "
+
+        cal_hdr = f"{'calib ms':>9s} {'resid':>6s} " if calibrated else ""
+        rows = [f"{'rank':>4s} {'layout':22s} {'pred ms':>9s} {cal_hdr}"
+                f"{'compute':>8s} {'memory':>8s} {'coll ms':>8s} "
+                f"{'raw ms':>8s} {'coll MB':>8s} {'HBM MiB':>8s}  note"]
         for i, s in enumerate(live[:top] if top else live):
             rows.append(
                 f"{i + 1:4d} {s.candidate.label:22s} "
-                f"{s.step_seconds * 1e3:9.3f} {s.compute_s * 1e3:8.3f} "
+                f"{s.raw_step_seconds * 1e3:9.3f} {_cal_cols(s)}"
+                f"{s.compute_s * 1e3:8.3f} "
                 f"{s.memory_s * 1e3:8.3f} {s.collective_s * 1e3:8.3f} "
                 f"{s.collective_raw_s * 1e3:8.3f} "
                 f"{s.collective_bytes / 1e6:8.1f} "
@@ -191,7 +219,8 @@ class PlanResult:
         if self.manual is not None:
             rows.append(
                 f"   * {'manual layout':22s} "
-                f"{self.manual.step_seconds * 1e3:9.3f} "
+                f"{self.manual.raw_step_seconds * 1e3:9.3f} "
+                f"{_cal_cols(self.manual)}"
                 f"{self.manual.compute_s * 1e3:8.3f} "
                 f"{self.manual.memory_s * 1e3:8.3f} "
                 f"{self.manual.collective_s * 1e3:8.3f} "
@@ -205,6 +234,12 @@ class PlanResult:
                 f"overlap_fraction={live0.overlap_fraction:.2f}: coll ms "
                 "is the overlap-discounted charge (raw ms = undiscounted "
                 "ring time)")
+        if calibrated and live0 is not None and \
+                live0.residual is not None:
+            rows.append(
+                f"calibration: measurement-ledger residual "
+                f"{live0.residual:.2f}x on train_step (ranking uses "
+                "calib ms; pred ms = raw roofline)")
         return "\n".join(rows)
 
 
@@ -260,10 +295,22 @@ def _options(options):
         DEFAULT_HBM_BW, DEFAULT_LINK_BW, DEFAULT_PEAK_FLOPS,
         default_overlap_fraction)
     o = dict(options or {})
+    overlap = o.get("overlap_fraction")
+    if overlap is None:
+        # the PR-15 static table value, corrected by the measurement
+        # ledger when PADDLE_TPU_CALIBRATION=1 recorded an achieved
+        # overlap fraction for this backend (no record -> unchanged)
+        overlap = default_overlap_fraction()
+        try:
+            from paddle_tpu.observability.calibration import (
+                calibrated_overlap_fraction)
+            overlap = calibrated_overlap_fraction(overlap)
+        except Exception:   # pragma: no cover - circular-import guard
+            pass
     return (float(o.get("peak_flops", DEFAULT_PEAK_FLOPS)),
             float(o.get("hbm_bw", DEFAULT_HBM_BW)),
             float(o.get("link_bw", DEFAULT_LINK_BW)),
-            float(o.get("overlap_fraction", default_overlap_fraction())))
+            float(overlap))
 
 
 def score_layout(tr, specs: Dict, mesh_shape: Dict[str, int],
@@ -390,6 +437,12 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
         scored.append(sc)
         colls_of[cand] = (specs, colls)
 
+    residual = _calibration_residual(scored, batch_shape)
+    if residual is not None:
+        for sc in scored:
+            if sc.pruned is None:
+                sc.calibrated_s = sc.raw_step_seconds * residual
+                sc.residual = residual
     live = sorted((s for s in scored if s.pruned is None),
                   key=lambda s: s.step_seconds)
     plans = []
@@ -413,8 +466,50 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
             manual_batch_spec
             if manual_batch_spec is not None else _default_batch_spec(),
             options=options)
+        if residual is not None:
+            manual.calibrated_s = manual.raw_step_seconds * residual
+            manual.residual = residual
     return PlanResult(plans=plans, scored=scored, n_devices=n_devices,
                       manual=manual)
+
+
+def _calibration_residual(scored: List[CandidateScore],
+                          batch_shape) -> Optional[float]:
+    """measured/predicted for this (batch-shape bucket, backend) from
+    the measurement ledger, or None.
+
+    The ledger's ``train_step`` entries are whole-step seconds measured
+    by bench.py on the pure-data-parallel layout (a single-process
+    bench shards nothing), so the residual is computed against THIS
+    planner's own prediction for the pure-DP candidate — the calibrated
+    time of that candidate then equals the measured time exactly, and
+    every other candidate is corrected by the same model-error factor.
+    Backend fencing is inherited from the ledger key: a CPU record can
+    never calibrate a TPU planning run (or one for a different device
+    count — the fingerprint carries ``nN``).  Coverage-gated: no
+    matching record, or calibration disabled, leaves every score raw."""
+    try:
+        from paddle_tpu.observability import calibration
+    except Exception:   # pragma: no cover - circular-import guard
+        return None
+    if not calibration.enabled() or not batch_shape:
+        return None
+    ref = None
+    for sc in scored:
+        cand = sc.candidate
+        if sc.pruned is None and cand is not None and cand.fsdp == 1 \
+                and cand.tp == 1 and getattr(cand, "pp", 1) == 1:
+            ref = sc
+            break
+    if ref is None or ref.raw_step_seconds <= 0.0:
+        return None
+    model = calibration.CalibratedCostModel()
+    measured = model.measured_for("train_step", tuple(batch_shape))
+    if measured is None:
+        return None
+    residual = measured / ref.raw_step_seconds
+    calibration.observe_residual("train_step", residual)
+    return residual
 
 
 def _default_batch_spec():

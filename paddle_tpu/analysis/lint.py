@@ -63,7 +63,7 @@ def main(argv=None) -> int:
         description="jaxpr-level program linter / cost model")
     ap.add_argument("target", nargs="?", default=None,
                     help="module:symbol (fn, Layer, or class); omit "
-                         "with --kernels")
+                         "with --kernels/--calibration")
     ap.add_argument("--spec", action="append", default=[],
                     help="example input as dtype[dims], repeatable")
     ap.add_argument("--init", default=None,
@@ -82,6 +82,16 @@ def main(argv=None) -> int:
                          "bench shapes (analysis/kernel_verify) and "
                          "print the verdict table; exit non-zero on "
                          "ERROR (or WARNING with --strict)")
+    ap.add_argument("--calibration", action="store_true",
+                    help="skip tracing: render the predicted-vs-"
+                         "measured table over the measurement ledger "
+                         "(observability/calibration) for this "
+                         "backend — segment, predicted ms, measured "
+                         "ms, residual, samples, provenance")
+    ap.add_argument("--max-residual", type=float, default=None,
+                    help="with --calibration: exit non-zero when any "
+                         "entry's residual factor max(r, 1/r) exceeds "
+                         "this bound (the CI calibration gate)")
     ap.add_argument("--autoshard", action="store_true",
                     help="run the GSPMD-style layout planner instead of "
                          "the lint pipeline: enumerate DP/FSDP/TP(/PP) "
@@ -108,8 +118,10 @@ def main(argv=None) -> int:
 
     if args.kernels:
         return _kernels_main(args)
+    if args.calibration:
+        return _calibration_main(args)
     if args.target is None:
-        ap.error("target is required (or pass --kernels)")
+        ap.error("target is required (or pass --kernels/--calibration)")
     obj = resolve(args.target, args.init)
     example = [parse_spec(s) for s in args.spec]
     if args.autoshard:
@@ -152,6 +164,63 @@ def _kernels_main(args) -> int:
     if args.strict and nwarn:
         print(f"lint --kernels: FAIL (--strict) — {nwarn} WARNING "
               f"finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _calibration_main(args) -> int:
+    """``--calibration``: the predicted-vs-measured report.  Every
+    measurement-ledger entry for THIS backend fingerprint renders as a
+    row (a TPU ledger consulted from a CPU process shows nothing — by
+    design); residual = measured/predicted where the feeder recorded a
+    model prediction.  ``--max-residual X`` turns the report into the
+    CI gate: exit non-zero when any residual factor ``max(r, 1/r)``
+    exceeds X."""
+    from paddle_tpu.observability import calibration
+
+    backend = calibration.backend_tag()
+    ents = calibration.ledger().entries(backend=backend)
+    rows = [f"{'segment / op-class':28s} {'shape':>14s} {'dtype':>9s} "
+            f"{'layout':>12s} {'pred ms':>9s} {'meas ms':>9s} "
+            f"{'resid':>7s} {'n':>4s}  provenance"]
+    worst = None
+    n_pred = 0
+    for key in sorted(ents):
+        e = ents[key]
+        head = key.rsplit("@", 1)[0]
+        parts = (head.split("|") + ["", "", ""])[:4]
+        op, shape, dtype, layout = parts
+        pred = float(e.get("predicted_s") or 0.0)
+        meas = float(e["measured_s"])
+        if pred > 0.0:
+            res = meas / pred
+            n_pred += 1
+            factor = max(res, 1.0 / res)
+            if worst is None or factor > worst[1]:
+                worst = (op, factor, res)
+            pred_c, res_c = f"{pred * 1e3:9.4f}", f"{res:7.2f}"
+        else:
+            pred_c, res_c = f"{'-':>9s}", f"{'-':>7s}"
+        rows.append(
+            f"{op:28s} {shape:>14s} {dtype:>9s} {layout:>12s} "
+            f"{pred_c} {meas * 1e3:9.4f} {res_c} "
+            f"{int(e.get('n', 1)):4d}  "
+            f"{','.join(e.get('provenance', []))}")
+    coverage = n_pred / len(ents) if ents else 0.0
+    print(f"calibration: {len(ents)} ledger entr"
+          f"{'y' if len(ents) == 1 else 'ies'} for backend {backend} "
+          f"({calibration.ledger().path}); prediction coverage "
+          f"{coverage:.0%}")
+    print("\n".join(rows))
+    if not ents:
+        print("calibration: ledger empty for this backend — run bench "
+              "or an autotune sweep with PADDLE_TPU_CALIBRATION=1",
+              file=sys.stderr)
+    if args.max_residual is not None and worst is not None and \
+            worst[1] > args.max_residual:
+        print(f"lint --calibration: FAIL — residual {worst[2]:.2f}x on "
+              f"{worst[0]} exceeds --max-residual {args.max_residual:g}",
+              file=sys.stderr)
         return 1
     return 0
 

@@ -190,15 +190,22 @@ def _fused_decoder(layer, x, rope_cos, rope_sin):
     PADDLE_TPU_FUSED_BLOCK=decoder tier and the shapes allow; None →
     caller takes the per-segment/unfused path.  The routing decision
     happens at trace time, so every other knob value reproduces its
-    previous jaxpr exactly."""
+    previous jaxpr exactly.  The ``measured`` tier makes the same
+    choice per shape from the measurement ledger: the megakernel routes
+    only when it was measured fastest for this (b, s, d) on this
+    backend (``FB.measured_tier_for``)."""
     from paddle_tpu.ops.pallas import fused_block as FB
-    if not FB.fused_decoder_enabled():
+    tier = FB.fused_block_tier()
+    if tier not in ("decoder", "measured"):
+        return None
+    b, s, d = int(x.shape[0]), int(x.shape[1]), int(x.shape[2])
+    if tier == "measured" and \
+            FB.measured_tier_for((b, s, d), x.dtype) != "decoder":
         return None
     attn, mlp = layer.self_attn, layer.mlp
     projs = (attn.q_proj, attn.k_proj, attn.v_proj, attn.o_proj,
              mlp.gate_proj, mlp.up_proj, mlp.down_proj)
     quanted = any(getattr(p, "quantized", False) for p in projs)
-    b, s, d = int(x.shape[0]), int(x.shape[1]), int(x.shape[2])
     dq = attn.num_heads * attn.head_dim
     dkv = attn.num_kv_heads * attn.head_dim
     f = None if quanted else int(mlp.gate_proj.weight.shape[-1])

@@ -35,6 +35,14 @@ package):
   attribution table** joining measured device time against the static
   cost model (the fusion target list), and an HBM live-buffer census /
   watermark with leak detection.
+* **calibration** — the measurement ledger (ISSUE 17): a persistent,
+  content-addressed corpus of every measured kernel/segment/step time
+  (fed by the device profiler, the autotune bench closures, and the
+  bench scripts under ``PADDLE_TPU_CALIBRATION=1``) plus a
+  :class:`CalibratedCostModel` whose per-(op-class, shape-bucket,
+  backend) residual factors correct the static roofline predictions —
+  closing the predicted-vs-measured loop for the planner, the
+  fusion-tier router, and the ``calibration_drift`` watchdog rule.
 
 Relationship to its siblings: ``paddle_tpu.analysis`` predicts cost
 statically, ``paddle_tpu.profiler`` measures a window you open by hand,
@@ -77,9 +85,13 @@ from paddle_tpu.observability.goodput import (GoodputMonitor,
                                               slo_targets)
 from paddle_tpu.observability.device_profiler import (
     AttributionResult, CompileInfo, DeviceMemoryMonitor, DeviceProfiler,
-    ExecutableStats, Segment, aot_compile, compile_records,
-    compiled_stats, detect_roofline, device_memory_monitor,
-    llama_step_segments, signature_of)
+    ExecutableStats, Segment, SegmentReport, aot_compile,
+    compile_records, compiled_stats, detect_roofline,
+    device_memory_monitor, llama_step_segments, segment_records,
+    signature_of)
+from paddle_tpu.observability.calibration import (CalibratedCostModel,
+                                                  MeasurementLedger)
+from paddle_tpu.observability import calibration
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -96,7 +108,9 @@ __all__ = [
     "GoodputMonitor", "compute_goodput", "goodput_monitor",
     "slo_attainment", "slo_targets",
     "AttributionResult", "CompileInfo", "DeviceMemoryMonitor",
-    "DeviceProfiler", "ExecutableStats", "Segment", "aot_compile",
-    "compile_records", "compiled_stats", "detect_roofline",
-    "device_memory_monitor", "llama_step_segments", "signature_of",
+    "DeviceProfiler", "ExecutableStats", "Segment", "SegmentReport",
+    "aot_compile", "compile_records", "compiled_stats",
+    "detect_roofline", "device_memory_monitor", "llama_step_segments",
+    "segment_records", "signature_of",
+    "CalibratedCostModel", "MeasurementLedger", "calibration",
 ]

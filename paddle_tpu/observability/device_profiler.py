@@ -63,6 +63,7 @@ __all__ = ["ExecutableStats", "CompileInfo", "aot_compile", "compiled_stats",
            "compile_records", "record_compile_info", "signature_of",
            "detect_roofline",
            "Segment", "SegmentReport", "AttributionResult", "DeviceProfiler",
+           "segment_records", "record_segment_report",
            "DeviceMemoryMonitor", "device_memory_monitor",
            "llama_step_segments", "capture_xla_trace"]
 
@@ -424,6 +425,54 @@ _SEGMENT_BUCKETS = (1e-5, 2.5e-5, 1e-4, 2.5e-4, 1e-3, 2.5e-3, 1e-2,
                     2.5e-2, 0.1, 0.25, 1.0, 2.5, 10.0)
 
 
+# process-wide segment-timing log mirroring the compile log above:
+# segment timings used to be fire-and-forget (alive only inside the
+# AttributionResult a single profile() call returned) — every measured
+# row now also lands here so the measurement ledger and tests consume
+# structured SegmentReports instead of parsing summary tables
+_SEGMENT_LOG: deque = deque(maxlen=512)
+_SEGMENT_LOCK = threading.Lock()
+
+
+def record_segment_report(report: SegmentReport):
+    """Append an externally-produced row to the segment log (mirrors
+    :func:`record_compile_info`)."""
+    with _SEGMENT_LOCK:
+        _SEGMENT_LOG.append(report)
+
+
+def segment_records(name: Optional[str] = None) -> List[SegmentReport]:
+    """Recent :class:`SegmentReport` rows across every profiler in the
+    process (optionally one segment's) — the structured counterpart of
+    :func:`compile_records` for measured device time."""
+    with _SEGMENT_LOCK:
+        records = list(_SEGMENT_LOG)
+    if name is not None:
+        records = [r for r in records if r.name == name]
+    return records
+
+
+def _primary_shape_dtype(args) -> Tuple[tuple, str]:
+    """The ledger shape/dtype key of a segment: its highest-rank array
+    leaf (ties: the larger one) — for every llama segment that is the
+    activation ``x``, which is exactly what a query site knows."""
+    best = None
+    for leaf in jax.tree_util.tree_leaves(args):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        size = 1
+        for dim in shape:
+            size *= max(1, int(dim))
+        rank = len(shape)
+        if best is None or (rank, size) > (best[0], best[1]):
+            best = (rank, size, tuple(shape), str(dtype))
+    if best is None:
+        return (), ""
+    return best[2], best[3]
+
+
 class DeviceProfiler:
     """Times instrumented sub-segments of a step on the device and
     attributes the roofline gap per op group.
@@ -445,6 +494,7 @@ class DeviceProfiler:
             from paddle_tpu.observability.metrics import default_registry
             registry = default_registry()
         self._registry = registry
+        self._records: List[SegmentReport] = []
         self._seg_hist = registry.histogram(
             "paddle_tpu_device_segment_seconds",
             "measured per-call device time of profiled step segments",
@@ -454,9 +504,50 @@ class DeviceProfiler:
         self._segments.append(segment)
         return self
 
+    def records(self, name: Optional[str] = None) -> List[SegmentReport]:
+        """Every :class:`SegmentReport` this profiler measured, across
+        all its ``profile()`` calls (optionally one segment's) — the
+        structured accessor mirroring :func:`compile_records`, so the
+        measurement ledger and tests get rows, not tables."""
+        records = list(self._records)
+        if name is not None:
+            records = [r for r in records if r.name == name]
+        return records
+
     def add_segment(self, name: str, fn: Callable, *args, count: int = 1,
                     group: str = "op", **kwargs) -> "DeviceProfiler":
         return self.add(Segment(name, fn, args, kwargs, count, group))
+
+    def _feed_ledger(self, seg: Segment, report: SegmentReport):
+        """Measurement-ledger feeder (PADDLE_TPU_CALIBRATION=1): every
+        measured segment lands with its roofline prediction, keyed by
+        the activation shape and the fusion tier active when it was
+        measured — so 'decoder_block under tier=fused' and 'under
+        tier=decoder' are distinct populations the measured tier router
+        can compare."""
+        from paddle_tpu.observability import calibration
+        if not calibration.enabled():
+            return
+        try:
+            from paddle_tpu.ops.pallas.fused_block import fused_block_tier
+            tier = fused_block_tier()
+        except Exception:
+            tier = "-"
+        try:
+            shape, dtype = _primary_shape_dtype(seg.args)
+            calibration.ledger().record(
+                seg.name, shape, dtype,
+                measured_s=report.device_s,
+                predicted_s=report.predicted_s,
+                layout=f"tier={tier}", provenance="device_profiler",
+                save=False)
+        except Exception:
+            pass
+
+    def _save_ledger(self):
+        from paddle_tpu.observability import calibration
+        if calibration.enabled():
+            calibration.ledger().save()
 
     def _predict(self, seg: Segment):
         """Static roofline prediction from the PR-1 cost model; zeros
@@ -513,18 +604,24 @@ class DeviceProfiler:
                 self._seg_hist.labels(segment=seg.name).observe(device_s)
                 pred_s, mflops, mbytes, bound = self._predict(seg)
                 gap = device_s / pred_s if pred_s > 0 else float("inf")
-                reports.append(SegmentReport(
+                report = SegmentReport(
                     name=seg.name, count=seg.count, group=seg.group,
                     device_s=device_s, compile_s=info.total_s,
                     flops=info.stats.flops,
                     bytes_accessed=info.stats.bytes_accessed,
                     peak_bytes=info.stats.peak_bytes,
                     model_flops=mflops, model_bytes=mbytes,
-                    predicted_s=pred_s, gap=gap, bound=bound))
+                    predicted_s=pred_s, gap=gap, bound=bound)
+                reports.append(report)
+                self._records.append(report)
+                record_segment_report(report)
+                self._feed_ledger(seg, report)
             if capture_xla and self._segments:
                 seg = self._segments[0]
                 trace_dir = capture_xla_trace(
                     lambda: seg.fn(*seg.args, **seg.kwargs))
+        if reports:
+            self._save_ledger()
         return AttributionResult(segments=reports,
                                  peak_flops=self.peak_flops,
                                  hbm_bw=self.hbm_bw,

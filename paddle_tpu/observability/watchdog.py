@@ -47,6 +47,12 @@ Built-in rule types (see ``default_rules()``):
                       delta) above ``target_s`` — recovery slower
                       than the MTTR budget (stale peer snapshots, or
                       fell back to the disk-restore path)
+``calibration_drift`` a ``paddle_tpu_calibration_residual{segment}``
+                      gauge (measured/predicted, from the measurement
+                      ledger) outside ``[1/factor, factor]`` — fresh
+                      measurements diverge from the cost model, i.e.
+                      the instruments every planner/fusion decision
+                      trusts are lying
 =================  =======================================================
 
 The fleet-flavored rules are registered in ``RULE_TYPES`` (spec-string
@@ -79,7 +85,7 @@ __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
            "MfuDriftRule", "CompileStormRule", "StragglerRule",
            "GoodputFloorRule", "SloAttainmentRule", "RestartStormRule",
-           "MttrRule",
+           "MttrRule", "CalibrationDriftRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -576,6 +582,46 @@ class MttrRule(Rule):
                 f"restart(s) on {who} > MTTR target {self.target_s:g}s")
 
 
+class CalibrationDriftRule(Rule):
+    """The predicted-vs-measured loop's alarm: any
+    ``paddle_tpu_calibration_residual{segment}`` gauge (written by the
+    calibrated cost model whenever the measurement ledger serves a
+    query) outside ``[1/factor, factor]`` means fresh measurements
+    diverge from the roofline model beyond the tolerated band — the
+    numbers the planner ranks by and the fusion router compares are no
+    longer describing the hardware (wrong roofline constants, an
+    interfering co-tenant, or a kernel regression since the ledger was
+    refreshed).  Silent when the gauge doesn't exist (calibration off)
+    — safe in ``default_rules()``."""
+
+    def __init__(self, metric: str = "paddle_tpu_calibration_residual",
+                 factor: float = 4.0, name: str = "calibration_drift"):
+        self.name = name
+        self.metric = metric
+        self.factor = float(factor)
+
+    def evaluate(self, registry, now: float) -> Optional[str]:
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        worst = None
+        for labels, child in m.series():
+            v = child.value()
+            if not (v > 0.0):        # absent/zero/NaN: no measurement
+                continue
+            drift = max(v, 1.0 / v)
+            if drift > self.factor and \
+                    (worst is None or drift > worst[1]):
+                worst = ("/".join(labels) or "?", drift, v)
+        if worst is None:
+            return None
+        seg, _, v = worst
+        return (f"calibration residual {v:.2f}x on {seg} outside "
+                f"[1/{self.factor:g}, {self.factor:g}] — measured time "
+                f"diverges from the cost model; refresh the ledger "
+                f"(sweep day) or fix the roofline constants")
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
@@ -589,13 +635,14 @@ RULE_TYPES = {
     "slo_attainment": SloAttainmentRule,
     "restart_storm": RestartStormRule,
     "mttr": MttrRule,
+    "calibration_drift": CalibrationDriftRule,
 }
 
 
 def default_rules() -> List[Rule]:
     return [StepTimeDriftRule(), RecompileStormRule(),
             QueueSaturationRule(), SkipStreakRule(), HeartbeatGapRule(),
-            MfuDriftRule(), CompileStormRule()]
+            MfuDriftRule(), CompileStormRule(), CalibrationDriftRule()]
 
 
 def _coerce(v: str):

@@ -242,9 +242,30 @@ def autotune(op_name: str, key: str, candidates: Sequence,
             best, best_t = c, t
     if best is None:
         best = default
+    else:
+        _feed_calibration(op_name, key, best_t)
     _mem_cache[full_key] = list(best) if isinstance(best, tuple) else best
     _save()
     return best
+
+
+def _feed_calibration(op_name: str, key: str, measured_s: float):
+    """Measurement-ledger feeder (PADDLE_TPU_CALIBRATION=1): the
+    winner's benched seconds land in the calibration ledger under the
+    kernel's own content-addressed key — the autotune sweep is one of
+    the three measurement sources the calibrated cost model reads."""
+    try:
+        from paddle_tpu.observability import calibration
+        if not calibration.enabled():
+            return
+        # the autotune key already embeds its backend tag; strip it and
+        # let the ledger key carry the process fingerprint instead
+        shape_part = key.rsplit("@", 1)[0]
+        calibration.ledger().record(
+            f"autotune:{op_name}", shape_part, measured_s=measured_s,
+            provenance="autotune")
+    except Exception:
+        pass
 
 
 def _put(op_name: str, key: str, value):

@@ -63,6 +63,14 @@ Env knobs:
                                   decoder layers through the
                                   whole-block megakernel (per-segment
                                   kernels keep ineligible layers)
+  PADDLE_TPU_FUSED_BLOCK=measured per-shape decision from the
+                                  measurement ledger: an eligible
+                                  decoder layer routes through the
+                                  megakernel only when the ledger
+                                  measured it faster than the
+                                  per-segment path for that shape on
+                                  this backend (no coverage -> the
+                                  per-segment tier, i.e. auto)
 """
 
 from __future__ import annotations
@@ -84,6 +92,7 @@ except Exception:  # pragma: no cover
 __all__ = ["fused_rmsnorm_qkv", "fused_mlp", "fused_ffn",
            "fused_decoder_block", "fused_block_enabled",
            "fused_block_tier", "fused_decoder_enabled",
+           "measured_tier_for",
            "fused_qkv_eligible", "fused_mlp_eligible",
            "fused_decoder_eligible", "decoder_vmem_bytes", "record_path",
            "SUPPORTED_ACTS"]
@@ -104,12 +113,16 @@ def fused_block_tier() -> str:
     llama decoder layers through the whole-block megakernel).  Unset =
     auto: ``"fused"`` on a TPU backend, ``"off"`` elsewhere — the
     decoder tier is opt-in only, so existing knob values reproduce
-    their previous jaxprs exactly."""
+    their previous jaxprs exactly.  ``"measured"`` resolves the
+    decoder-vs-per-segment choice per shape from the measurement
+    ledger (:func:`measured_tier_for`) instead of globally."""
     env = os.environ.get("PADDLE_TPU_FUSED_BLOCK", "").strip().lower()
     if env in ("0", "false", "off", "no"):
         return "off"
     if env == "decoder":
         return "decoder"
+    if env == "measured":
+        return "measured"
     if env in ("1", "true", "on", "yes"):
         return "fused"
     return "fused" if jax.default_backend() == "tpu" else "off"
@@ -124,8 +137,51 @@ def fused_block_enabled() -> bool:
 def fused_decoder_enabled() -> bool:
     """True only at the explicit ``PADDLE_TPU_FUSED_BLOCK=decoder``
     tier — never auto-on, so every pre-existing knob value keeps its
-    exact previous lowering."""
+    exact previous lowering.  (The ``measured`` tier routes the
+    megakernel per shape through :func:`measured_tier_for`, not through
+    this global gate.)"""
     return fused_block_tier() == "decoder"
+
+
+def measured_tier_for(shape, dtype) -> str:
+    """The ``PADDLE_TPU_FUSED_BLOCK=measured`` decision for one decoder
+    activation shape ``(b, s, d)``: which tier the measurement ledger
+    recorded as fastest on THIS backend.
+
+    The DeviceProfiler feeder tags every ``decoder_block`` /
+    ``decoder_block_fused`` segment row with the fusion tier active
+    when it was measured, so the three lowerings are distinct ledger
+    populations: a sweep day that profiles under ``off``, ``1`` and
+    ``decoder`` gives this function all three measurements to compare.
+    Returns ``"decoder"``, ``"fused"`` or ``"off"`` — the fastest tier
+    with coverage; without any coverage the answer is ``"fused"`` (the
+    auto default), so an empty ledger makes ``measured`` behave exactly
+    like the per-segment tier.
+
+    Only the decoder-layer boundary consults this (megakernel vs
+    per-segment routing, the decision with measured 10x+ spread); the
+    per-segment kernels themselves stay enabled under ``measured`` as
+    under auto."""
+    dtype = str(dtype)
+    times = {}
+    try:
+        from paddle_tpu.observability import calibration
+        model = calibration.CalibratedCostModel()
+        t = model.measured_for("decoder_block_fused", shape, dtype,
+                               layout="tier=decoder")
+        if t is not None:
+            times["decoder"] = t
+        for tier, op in (("fused", "decoder_block"),
+                         ("off", "decoder_block")):
+            t = model.measured_for(op, shape, dtype,
+                                   layout=f"tier={tier}")
+            if t is not None:
+                times[tier] = t
+    except Exception:
+        return "fused"
+    if not times:
+        return "fused"
+    return min(times, key=times.get)
 
 
 def _row_quantum(dtype) -> int:

@@ -1,0 +1,552 @@
+"""Measurement ledger + calibrated cost model (ISSUE 17).
+
+What must hold for a measurement corpus to be trustworthy enough that
+the planner ranks by it and the fusion router routes by it:
+
+* records written by one process are served to a FRESH process (same
+  key discipline as the compile cache);
+* backend fencing is absolute — a CPU-measured record can never answer
+  a TPU query, and vice versa (the fingerprint carries device count
+  too);
+* a corrupt / truncated / old-schema ledger file — or a malformed
+  entry inside a healthy file — is silently invalidated, never raised;
+* residual math is exact (measured/predicted), coverage-gated: a query
+  the ledger cannot serve falls back to the raw prediction unchanged;
+* with the knob off there is ZERO behavior change: planner scores and
+  fusion-tier routing are identical to the uncalibrated build;
+* the ``calibration_drift`` watchdog rule fires on divergence in
+  either direction, respects cooldown, and stays silent when
+  calibration is off.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pp
+from paddle_tpu.observability import calibration
+from paddle_tpu.observability.calibration import (CalibratedCostModel,
+                                                  MeasurementLedger,
+                                                  make_key, shape_bucket)
+from paddle_tpu.observability.metrics import MetricsRegistry
+from paddle_tpu.observability.recorder import FlightRecorder
+from paddle_tpu.observability.watchdog import (RULE_TYPES,
+                                               CalibrationDriftRule,
+                                               Watchdog, default_rules)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cal_env(tmp_path, monkeypatch):
+    d = str(tmp_path / "calibration")
+    monkeypatch.setenv("PADDLE_TPU_CALIBRATION", "1")
+    monkeypatch.setenv("PADDLE_TPU_CALIBRATION_DIR", d)
+    calibration.reset()
+    yield d
+    calibration.reset()
+
+
+@pytest.fixture
+def cal_off(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_CALIBRATION", raising=False)
+    calibration.reset()
+    yield
+    calibration.reset()
+
+
+# ----------------------------------------------------------------- keys
+class TestKeys:
+    def test_shape_bucket_pow2_rows(self):
+        # leading dims flatten to a row count; everything rounds up
+        assert shape_bucket((2, 16)) == "r2x16"
+        assert shape_bucket((2, 16, 64)) == "r32x64"
+        assert shape_bucket((4, 2048, 2048)) == "r8192x2048"
+        assert shape_bucket((8, 1024, 2048)) == "r8192x2048"
+        assert shape_bucket((5,)) == "r8"
+        assert shape_bucket(()) == "scalar"
+
+    def test_string_shape_passes_through(self):
+        # autotune keys are already content-addressed
+        assert shape_bucket("f32[128,256]") == "f32[128,256]"
+
+    def test_make_key_format_and_backend(self):
+        k = make_key("attention", (4, 64, 128), "float32",
+                     backend="tpu:v5e:n8")
+        assert k == "attention|r256x128|float32|-@tpu:v5e:n8"
+        # default backend is THIS process's fingerprint
+        assert make_key("x", (2, 2)).endswith(
+            "@" + calibration.backend_tag())
+
+
+# --------------------------------------------------------------- ledger
+class TestLedger:
+    def test_round_trip_fresh_instance(self, cal_env):
+        led = MeasurementLedger()
+        key = led.record("attention", (4, 64, 128), "float32",
+                         measured_s=1.5e-3, predicted_s=1.0e-3,
+                         provenance="device_profiler")
+        assert key.startswith("attention|r256x128|float32|-@")
+        # a FRESH instance (new process simulation) reads the file
+        other = MeasurementLedger()
+        e = other.query("attention", (4, 64, 128), "float32")
+        assert e is not None
+        assert e["measured_s"] == pytest.approx(1.5e-3)
+        assert e["predicted_s"] == pytest.approx(1.0e-3)
+        assert e["provenance"] == ["device_profiler"]
+
+    def test_aggregation_min_mean_count_provenance(self, cal_env):
+        led = MeasurementLedger()
+        led.record("mm", (8, 8), measured_s=2.0e-3, provenance="bench")
+        led.record("mm", (8, 8), measured_s=1.0e-3, predicted_s=5e-4,
+                   provenance="autotune")
+        e = led.query("mm", (8, 8))
+        assert e["measured_s"] == pytest.approx(1.0e-3)   # running min
+        assert e["mean_s"] == pytest.approx(1.5e-3)
+        assert e["n"] == 2
+        assert e["provenance"] == ["autotune", "bench"]
+        assert e["predicted_s"] == pytest.approx(5e-4)    # latest nonzero
+
+    def test_rejects_garbage_measurements(self, cal_env):
+        led = MeasurementLedger()
+        assert led.record("mm", (8, 8), measured_s=0.0) == ""
+        assert led.record("mm", (8, 8), measured_s=-1.0) == ""
+        assert led.record("mm", (8, 8), measured_s=float("nan")) == ""
+        assert led.query("mm", (8, 8)) is None
+
+    def test_backend_fencing(self, cal_env):
+        """A CPU record can NEVER answer a TPU query (and vice versa)."""
+        led = MeasurementLedger()
+        led.record("attention", (4, 64, 128), "float32",
+                   measured_s=1e-3)          # this (CPU) backend
+        # same population, different chip: nothing served
+        assert led.query("attention", (4, 64, 128), "float32",
+                         backend="tpu:v5e:n8") is None
+        # a TPU-tagged record is invisible to this CPU process's
+        # default query
+        led.record("matmul", (128, 128), "bfloat16", measured_s=2e-4,
+                   backend="tpu:v5e:n8")
+        assert led.query("matmul", (128, 128), "bfloat16") is None
+        assert led.query("matmul", (128, 128), "bfloat16",
+                         backend="tpu:v5e:n8") is not None
+        # device count is fenced too (n8 != n16)
+        assert led.query("matmul", (128, 128), "bfloat16",
+                         backend="tpu:v5e:n16") is None
+
+    def test_entries_backend_filter(self, cal_env):
+        led = MeasurementLedger()
+        led.record("a", (2, 2), measured_s=1e-3)
+        led.record("b", (2, 2), measured_s=1e-3, backend="tpu:v5e:n8")
+        mine = led.entries(backend=calibration.backend_tag())
+        assert len(mine) == 1 and len(led.entries()) == 2
+
+    def test_corrupt_file_silently_invalidated(self, cal_env):
+        os.makedirs(cal_env, exist_ok=True)
+        with open(calibration.ledger_path(), "w") as f:
+            f.write("{ not json !!")
+        led = MeasurementLedger()
+        assert led.entries() == {}
+        # and recording over the corpse works (atomic replace)
+        led.record("mm", (8, 8), measured_s=1e-3)
+        assert MeasurementLedger().query("mm", (8, 8)) is not None
+
+    def test_truncated_file_silently_invalidated(self, cal_env):
+        led = MeasurementLedger()
+        led.record("mm", (8, 8), measured_s=1e-3)
+        path = calibration.ledger_path()
+        blob = open(path).read()
+        with open(path, "w") as f:
+            f.write(blob[:len(blob) // 2])
+        assert MeasurementLedger().entries() == {}
+
+    def test_old_schema_silently_invalidated(self, cal_env):
+        os.makedirs(cal_env, exist_ok=True)
+        entry = {"op_class": "mm", "measured_s": 1e-3, "mean_s": 1e-3,
+                 "predicted_s": 0.0, "n": 1, "provenance": ["manual"],
+                 "updated": 0.0}
+        with open(calibration.ledger_path(), "w") as f:
+            json.dump({"version": calibration.LEDGER_VERSION + 98,
+                       "entries": {"mm|r8x8|-|-@x:y:n1": entry}}, f)
+        assert MeasurementLedger().entries() == {}
+
+    def test_malformed_entry_dropped_sibling_kept(self, cal_env):
+        led = MeasurementLedger()
+        good = led.record("mm", (8, 8), measured_s=1e-3)
+        path = calibration.ledger_path()
+        raw = json.load(open(path))
+        raw["entries"]["bad|r2x2|-|-@x:y:n1"] = {"measured_s": -4.0}
+        raw["entries"]["worse|r2x2|-|-@x:y:n1"] = "not a dict"
+        with open(path, "w") as f:
+            json.dump(raw, f)
+        ents = MeasurementLedger().entries()
+        assert list(ents) == [good]
+
+    def test_concurrent_writers_merge_not_clobber(self, cal_env):
+        """Two ledgers on the same path: the later save overlays the
+        earlier one's keys instead of erasing them."""
+        a, b = MeasurementLedger(), MeasurementLedger()
+        a.record("seg_a", (8, 8), measured_s=1e-3)    # a saves first
+        b.record("seg_b", (8, 8), measured_s=2e-3)    # b merges over
+        ents = MeasurementLedger().entries()
+        assert len(ents) == 2
+
+    @pytest.mark.slow  # subprocess boot; the CI calibration gate runs it
+    def test_round_trip_across_real_processes(self, cal_env):
+        script = (
+            "from paddle_tpu.observability import calibration\n"
+            "e = calibration.ledger().query('attention', (4, 64, 128),"
+            " 'float32')\n"
+            "assert e is not None and abs(e['measured_s'] - 1.5e-3)"
+            " < 1e-9, e\n"
+            "print('SERVED')\n")
+        MeasurementLedger().record("attention", (4, 64, 128), "float32",
+                                   measured_s=1.5e-3, predicted_s=1e-3)
+        env = dict(os.environ, PADDLE_TPU_CALIBRATION="1",
+                   PADDLE_TPU_CALIBRATION_DIR=cal_env,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run([sys.executable, "-c", script], cwd=REPO,
+                             env=env, capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+        assert "SERVED" in out.stdout
+
+
+# ----------------------------------------------------- calibrated model
+class TestCalibratedCostModel:
+    def test_residual_math(self, cal_env):
+        led = MeasurementLedger()
+        led.record("attention", (4, 64, 128), "float32",
+                   measured_s=2e-3, predicted_s=1e-3)
+        model = CalibratedCostModel(led)
+        assert model.residual_for("attention", (4, 64, 128),
+                                  "float32") == pytest.approx(2.0)
+        cal_s, res = model.calibrate(5e-4, "attention", (4, 64, 128),
+                                     "float32")
+        assert cal_s == pytest.approx(1e-3)
+        assert res == pytest.approx(2.0)
+
+    def test_coverage_gated_fallback(self, cal_env):
+        led = MeasurementLedger()
+        led.record("covered", (8, 8), measured_s=2e-3, predicted_s=1e-3)
+        led.record("no_pred", (8, 8), measured_s=2e-3)  # no prediction
+        model = CalibratedCostModel(led)
+        # no entry at all -> raw prediction unchanged, residual None
+        assert model.calibrate(7e-4, "missing", (8, 8)) == (7e-4, None)
+        # entry without a prediction cannot produce a residual either
+        assert model.calibrate(7e-4, "no_pred", (8, 8)) == (7e-4, None)
+        assert model.calibrate(1e-3, "covered", (8, 8))[1] is not None
+        assert model.coverage() == pytest.approx(1.0 / 3.0)
+
+    def test_min_records_gate(self, cal_env):
+        led = MeasurementLedger()
+        led.record("mm", (8, 8), measured_s=2e-3, predicted_s=1e-3)
+        assert CalibratedCostModel(led, min_records=2).residual_for(
+            "mm", (8, 8)) is None
+        led.record("mm", (8, 8), measured_s=2e-3, predicted_s=1e-3)
+        assert CalibratedCostModel(led, min_records=2).residual_for(
+            "mm", (8, 8)) == pytest.approx(2.0)
+
+    def test_gauges_published(self, cal_env):
+        reg = MetricsRegistry()
+        led = MeasurementLedger()
+        led.record("mm", (8, 8), measured_s=3e-3, predicted_s=1e-3)
+        model = CalibratedCostModel(led, registry=reg)
+        model.residual_for("mm", (8, 8))
+        g = reg.get("paddle_tpu_calibration_residual")
+        vals = {"/".join(k): c.value() for k, c in g.series()}
+        assert vals["mm"] == pytest.approx(3.0)
+        cov = reg.get("paddle_tpu_calibration_coverage")
+        assert cov.value() == pytest.approx(1.0)
+
+    def test_measured_for(self, cal_env):
+        led = MeasurementLedger()
+        led.record("decoder_block", (2, 16, 64), "float32",
+                   layout="tier=off", measured_s=4e-3)
+        model = CalibratedCostModel(led)
+        assert model.measured_for("decoder_block", (2, 16, 64),
+                                  "float32",
+                                  layout="tier=off") == \
+            pytest.approx(4e-3)
+        assert model.measured_for("decoder_block", (2, 16, 64),
+                                  "float32",
+                                  layout="tier=fused") is None
+
+
+# ------------------------------------------------------ overlap fraction
+class TestOverlapFraction:
+    def test_measured_overlap_served_when_enabled(self, cal_env):
+        calibration.record_overlap_fraction(0.55, provenance="bench")
+        assert calibration.calibrated_overlap_fraction(0.9) == \
+            pytest.approx(0.55)
+
+    def test_default_when_no_record(self, cal_env):
+        assert calibration.calibrated_overlap_fraction(0.75) == 0.75
+
+    def test_knob_off_returns_default(self, cal_off):
+        assert calibration.calibrated_overlap_fraction(0.75) == 0.75
+
+
+# --------------------------------------------------------------- planner
+def _tiny_plan_inputs():
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    pp.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    opt = pp.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    step = TrainStep(model, opt)
+    batch = {"input_ids": jax.ShapeDtypeStruct((8, 16), jnp.int32),
+             "labels": jax.ShapeDtypeStruct((8, 16), jnp.int32)}
+    return step, batch
+
+
+class TestPlannerCalibration:
+    def test_knob_off_scores_are_raw(self, cal_off):
+        from paddle_tpu.analysis import autoshard
+        step, batch = _tiny_plan_inputs()
+        res = autoshard.plan(step, batch, n_devices=8, topk=3)
+        for sc in res.scored:
+            assert sc.calibrated_s is None and sc.residual is None
+            if sc.pruned is None:
+                assert sc.step_seconds == sc.raw_step_seconds
+        txt = res.table()
+        assert "calib ms" not in txt and "resid" not in txt
+
+    def test_calibrated_column_and_reference_exactness(self, cal_env):
+        from paddle_tpu.analysis import autoshard
+        step, batch = _tiny_plan_inputs()
+        measured = 0.05
+        MeasurementLedger().record("train_step", (8, 16),
+                                   measured_s=measured,
+                                   provenance="bench")
+        res = autoshard.plan(step, batch, n_devices=8, topk=3)
+        live = [s for s in res.scored if s.pruned is None]
+        assert live and all(s.calibrated_s is not None for s in live)
+        # the residual is anchored on the pure-DP reference candidate:
+        # its calibrated time IS the measured time (within fp noise,
+        # far inside the 15% acceptance bound)
+        ref = next(s for s in live if s.candidate.fsdp == 1
+                   and s.candidate.tp == 1
+                   and getattr(s.candidate, "pp", 1) == 1)
+        assert abs(ref.calibrated_s - measured) / measured < 0.15
+        assert ref.calibrated_s == pytest.approx(measured)
+        # every candidate scaled by the same factor: ranking by
+        # step_seconds == ranking by raw_step_seconds
+        raws = sorted(live, key=lambda s: s.raw_step_seconds)
+        cals = sorted(live, key=lambda s: s.step_seconds)
+        assert [s.candidate for s in raws] == [s.candidate for s in cals]
+        txt = res.table()
+        assert "calib ms" in txt and "resid" in txt
+        assert "measurement-ledger residual" in txt
+
+    def test_no_coverage_leaves_scores_raw(self, cal_env):
+        # knob ON but empty ledger: coverage gate keeps everything raw
+        from paddle_tpu.analysis import autoshard
+        step, batch = _tiny_plan_inputs()
+        res = autoshard.plan(step, batch, n_devices=8, topk=3)
+        assert all(s.calibrated_s is None for s in res.scored)
+        assert "calib ms" not in res.table()
+
+
+# ------------------------------------------------------------ drift rule
+class TestCalibrationDriftRule:
+    def _wd(self, reg, factor=4.0, cooldown=60.0):
+        return Watchdog(rules=[CalibrationDriftRule(factor=factor)],
+                        registry=reg, recorder=FlightRecorder(),
+                        cooldown=cooldown)
+
+    def test_silent_without_metric(self):
+        reg = MetricsRegistry()
+        assert CalibrationDriftRule().evaluate(reg, now=0.0) is None
+
+    def test_silent_when_healthy(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("paddle_tpu_calibration_residual", "r",
+                      labelnames=("segment",))
+        g.labels(segment="mm").set(1.5)
+        assert CalibrationDriftRule(factor=4.0).evaluate(
+            reg, now=0.0) is None
+
+    def test_fires_both_directions(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("paddle_tpu_calibration_residual", "r",
+                      labelnames=("segment",))
+        g.labels(segment="mm").set(10.0)       # model optimistic 10x
+        msg = CalibrationDriftRule(factor=4.0).evaluate(reg, now=0.0)
+        assert msg and "10.00x" in msg and "mm" in msg
+        g.labels(segment="mm").set(0.05)       # model pessimistic 20x
+        assert CalibrationDriftRule(factor=4.0).evaluate(
+            reg, now=0.0) is not None
+
+    def test_fire_cooldown_refire_via_watchdog(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("paddle_tpu_calibration_residual", "r",
+                      labelnames=("segment",))
+        g.labels(segment="train_step").set(10.0)
+        wd = self._wd(reg, cooldown=60.0)
+        alerts = wd.evaluate_once(now=1000.0)
+        assert len(alerts) == 1
+        assert alerts[0].rule == "calibration_drift"
+        # still bad 10s later: cooldown suppresses the re-alert
+        assert wd.evaluate_once(now=1010.0) == []
+        # past the cooldown it re-fires
+        assert len(wd.evaluate_once(now=1100.0)) == 1
+
+    def test_registered_in_defaults_and_spec(self):
+        assert "calibration_drift" in RULE_TYPES
+        assert any(isinstance(r, CalibrationDriftRule)
+                   for r in default_rules())
+
+
+# ------------------------------------------------------ profiler feeder
+class TestProfilerFeeder:
+    def test_records_accessor(self):
+        from paddle_tpu.observability import DeviceProfiler
+        prof = DeviceProfiler()
+        x = jnp.ones((64, 64), jnp.float32)
+        prof.add_segment("mm", lambda a: a @ a, x)
+        prof.profile(reps=1, warmup=0, parent_span="test.records")
+        recs = prof.records()
+        assert len(recs) == 1 and recs[0].name == "mm"
+        assert prof.records("mm") == recs
+        assert prof.records("nope") == []
+        # and the module-level log mirrors compile_records()
+        from paddle_tpu.observability import segment_records
+        assert any(r.name == "mm" for r in segment_records())
+        assert segment_records("mm")[-1].device_s > 0
+
+    def test_profile_feeds_ledger(self, cal_env):
+        from paddle_tpu.observability import DeviceProfiler
+        prof = DeviceProfiler()
+        x = jnp.ones((64, 64), jnp.float32)
+        prof.add_segment("mm", lambda a: a @ a, x)
+        prof.profile(reps=1, warmup=0, parent_span="test.feed")
+        # the row landed with shape/dtype of the primary arg, the
+        # active fusion tier as layout, and the roofline prediction
+        ents = MeasurementLedger().entries()
+        keys = [k for k in ents if k.startswith("mm|r64x64|float32|")]
+        assert keys, list(ents)
+        e = ents[keys[0]]
+        assert e["provenance"] == ["device_profiler"]
+        assert e["measured_s"] > 0 and e["predicted_s"] > 0
+        assert "|tier=" in keys[0]
+
+    def test_profile_does_not_feed_when_off(self, cal_off, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_CALIBRATION_DIR",
+                           str(tmp_path / "cal_off"))
+        calibration.reset()
+        from paddle_tpu.observability import DeviceProfiler
+        prof = DeviceProfiler()
+        x = jnp.ones((16, 16), jnp.float32)
+        prof.add_segment("mm_off", lambda a: a @ a, x)
+        prof.profile(reps=1, warmup=0, parent_span="test.nofeed")
+        assert not os.path.exists(calibration.ledger_path())
+
+
+# -------------------------------------------------- measured fusion tier
+class TestMeasuredTier:
+    def test_no_coverage_defaults_to_fused(self, cal_env):
+        from paddle_tpu.ops.pallas.fused_block import measured_tier_for
+        assert measured_tier_for((2, 16, 64), "float32") == "fused"
+
+    def test_picks_fastest_measured_tier(self, cal_env):
+        from paddle_tpu.ops.pallas.fused_block import measured_tier_for
+        # the router consults the process-wide ledger, so feed that one
+        led = calibration.ledger()
+        led.record("decoder_block_fused", (2, 16, 64), "float32",
+                   layout="tier=decoder", measured_s=1e-3)
+        led.record("decoder_block", (2, 16, 64), "float32",
+                   layout="tier=fused", measured_s=3e-3)
+        led.record("decoder_block", (2, 16, 64), "float32",
+                   layout="tier=off", measured_s=5e-3)
+        assert measured_tier_for((2, 16, 64), "float32") == "decoder"
+        # a different shape bucket is a different population
+        assert measured_tier_for((2, 512, 64), "float32") == "fused"
+        # flip the winner: unfused measured fastest
+        led.record("decoder_block", (2, 16, 64), "float32",
+                   layout="tier=off", measured_s=1e-5)
+        assert measured_tier_for((2, 16, 64), "float32") == "off"
+
+    def test_measured_env_value(self, monkeypatch):
+        from paddle_tpu.ops.pallas import fused_block as FB
+        monkeypatch.setenv("PADDLE_TPU_FUSED_BLOCK", "measured")
+        assert FB.fused_block_tier() == "measured"
+        assert FB.fused_block_enabled() is True
+        # the megakernel is routed per shape, not globally
+        assert FB.fused_decoder_enabled() is False
+
+
+# --------------------------------------------------------- CLI + bench
+class TestLintCalibration:
+    def _seed(self, n=6):
+        led = MeasurementLedger()
+        for i in range(n):
+            led.record(f"seg{i}", (2 ** i, 64), "float32",
+                       measured_s=(i + 1) * 1e-3,
+                       predicted_s=1e-3, provenance="device_profiler")
+
+    def test_renders_table(self, cal_env, capsys):
+        from paddle_tpu.analysis import lint
+        self._seed()
+        assert lint.main(["--calibration"]) == 0
+        out = capsys.readouterr().out
+        assert "segment / op-class" in out
+        assert "coverage" in out
+        assert sum(1 for ln in out.splitlines()
+                   if ln.startswith("seg")) >= 5
+
+    def test_max_residual_gate(self, cal_env, capsys):
+        from paddle_tpu.analysis import lint
+        self._seed()
+        # worst residual is 6.0x (seg5): the CI gate trips below that
+        assert lint.main(["--calibration", "--max-residual", "4"]) == 1
+        assert "FAIL" in capsys.readouterr().err
+        assert lint.main(["--calibration", "--max-residual", "10"]) == 0
+
+    def test_empty_ledger_is_not_an_error(self, cal_env, capsys):
+        from paddle_tpu.analysis import lint
+        assert lint.main(["--calibration"]) == 0
+
+
+class TestBenchDetail:
+    def test_disabled_section(self, cal_off):
+        assert calibration.bench_detail() == {"enabled": False}
+
+    def test_enabled_section(self, cal_env):
+        led = MeasurementLedger()
+        led.record("train_step", (8, 16), measured_s=2e-3,
+                   predicted_s=1e-3, provenance="bench")
+        led.record("nopred", (8, 16), measured_s=2e-3)
+        d = calibration.bench_detail(registry=MetricsRegistry())
+        assert d["enabled"] and d["entries"] == 2
+        assert d["with_prediction"] == 1
+        assert d["coverage"] == pytest.approx(0.5)
+        assert d["residuals"]["train_step"] == pytest.approx(2.0)
+        assert d["max_residual_factor"] == pytest.approx(2.0)
+
+    def test_compare_flags_coverage_and_residual_regressions(self):
+        import bench
+        prev = {"detail": {"calibration": {
+            "enabled": True, "coverage": 0.8, "mean_abs_residual": 0.5}}}
+        cur_bad_cov = {"detail": {"calibration": {
+            "enabled": True, "coverage": 0.4, "mean_abs_residual": 0.5}}}
+        regs = bench.compare_records(cur_bad_cov, prev, tolerance=0.05)
+        assert any("coverage" in r for r in regs)
+        cur_bad_res = {"detail": {"calibration": {
+            "enabled": True, "coverage": 0.8, "mean_abs_residual": 2.0}}}
+        regs = bench.compare_records(cur_bad_res, prev, tolerance=0.05)
+        assert any("residual" in r for r in regs)
+        # guarded clause: sections missing on either side -> silent
+        assert bench.compare_records({"detail": {}}, prev,
+                                     tolerance=0.05) == []
+        ok = {"detail": {"calibration": {
+            "enabled": True, "coverage": 0.85,
+            "mean_abs_residual": 0.55}}}
+        assert bench.compare_records(ok, prev, tolerance=0.05) == []
