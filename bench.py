@@ -266,6 +266,422 @@ def _compare_calibration(cur: dict, prev: dict, tolerance: float):
     return regressions
 
 
+def _prev_named_record(prefix):
+    """Parsed payload of the newest ``{prefix}_rNN.json`` artifact — the
+    generic trajectory lookup the MoE / long-context variants share."""
+    best_round, best = -1, None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, f"{prefix}_r*.json")):
+        m = re.search(rf"{prefix}_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or rec
+            val = parsed.get("value")
+        except Exception:
+            continue
+        if val is not None and int(m.group(1)) > best_round:
+            best_round, best = int(m.group(1)), parsed
+    return best
+
+
+def _next_named_round(here: str, prefix: str) -> int:
+    rounds = [int(m.group(1)) for p in
+              glob.glob(os.path.join(here, f"{prefix}_r*.json"))
+              if (m := re.search(rf"{prefix}_r(\d+)\.json$", p))]
+    return max(rounds, default=0) + 1
+
+
+def _emit_named(args, result: dict, schema: str, prefix: str) -> None:
+    if not args.emit:
+        return
+    here = os.path.dirname(os.path.abspath(__file__))
+    path_out = args.emit
+    if path_out == "auto":
+        path_out = os.path.join(
+            here, f"{prefix}_r{_next_named_round(here, prefix):02d}.json")
+    with open(path_out, "w") as f:
+        json.dump({"schema": schema, "parsed": result}, f, indent=1)
+    print(f"wrote {path_out}", file=sys.stderr)
+
+
+def _metric_series(name):
+    from paddle_tpu.observability import default_registry
+    m = default_registry().get(name)
+    return {"/".join(k) or "all": c.value() for k, c in m.series()} \
+        if m is not None else {}
+
+
+def compare_moe_records(cur: dict, prev: dict, tolerance: float = 0.05):
+    """MoE trajectory check: the base value/step-time/calibration clauses
+    plus the grouped-kernel cost-model byte ratio (better-LOWER — the
+    kernel's whole claim is that the [G, C, h] hidden intermediate never
+    touches HBM) and knob-off parity, which must never rot."""
+    regressions = compare_records(cur, prev, tolerance)
+    pg = (prev.get("detail") or {}).get("grouped_kernel") or {}
+    cg = (cur.get("detail") or {}).get("grouped_kernel") or {}
+    pr, cr = pg.get("bytes_ratio"), cg.get("bytes_ratio")
+    if pr and cr and float(cr) > float(pr) * (1.0 + tolerance):
+        regressions.append(
+            f"grouped_kernel.bytes_ratio {float(cr):.3f} > prev "
+            f"{float(pr):.3f} + {tolerance:.0%} tolerance")
+    cp = (cur.get("detail") or {}).get("knob_off_parity") or {}
+    if cp and not cp.get("ok", True):
+        regressions.append(
+            f"knob_off_parity rel_diff {cp.get('rel_diff')} exceeded bar")
+    return regressions
+
+
+def compare_longctx_records(cur: dict, prev: dict,
+                            tolerance: float = 0.05):
+    """Long-context trajectory check: base clauses plus the ring-vs-
+    single-device parity error, judged against an ABSOLUTE bar (the
+    oracle is exact math, not a noisy timing, so drift is never ok)."""
+    regressions = compare_records(cur, prev, tolerance)
+    cp = (cur.get("detail") or {}).get("parity") or {}
+    bar = cp.get("bar", 2e-5)
+    ce = cp.get("max_abs_err")
+    if ce is not None and float(ce) > float(bar):
+        regressions.append(
+            f"parity.max_abs_err {float(ce):.2e} > {float(bar):.0e} bar")
+    return regressions
+
+
+def _moe_bench(args):
+    """MoE workload bench (ISSUE 18): full train step (fwd+bwd+AdamW) of
+    a MoE decoder with the grouped expert-matmul Pallas kernel ON,
+    emitting the ``moe_mfu`` trajectory line (activated-FLOPs MFU, the
+    standard MoE accounting — idle experts do no math).
+
+    The detail payload carries the acceptance evidence next to the
+    headline: the cost-model HBM-byte ratio of the grouped kernel vs the
+    dense-einsum dispatch at the sweep shape (< 0.5 means the [G, C, h]
+    hidden intermediate never round-trips HBM), knob-off loss parity
+    (``PADDLE_TPU_GROUPED_MOE=0`` must reproduce the reference
+    numerics), and the per-trace implementation-path counters.  The
+    measured step feeds the calibration ledger like the dense bench."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pp
+    from paddle_tpu import analysis
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import MoEConfig, MoEForCausalLM
+    from paddle_tpu.ops.pallas import autotune as at
+    from paddle_tpu.ops.pallas import grouped_matmul as gm
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    mode = os.environ.get("PT_MOE_DISPATCH", "einsum")
+    if on_tpu:
+        # DeepSeekMoE-family dims scaled to one 16G chip (the
+        # moe_train_bench "large" config, grouped-kernel path on)
+        cfg = MoEConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            moe_intermediate_size=1408, num_hidden_layers=4,
+            num_attention_heads=16, num_key_value_heads=16,
+            num_experts=16, num_experts_per_tok=2, num_shared_experts=1,
+            first_k_dense_replace=1, max_position_embeddings=2048,
+            capacity_factor=1.25, dispatch_mode=mode, dtype="bfloat16")
+        batch, seq, iters, warmup = 4, 2048, 8, 2
+    else:  # CI/CPU smoke — interpret-mode pallas
+        cfg = MoEConfig.tiny(dispatch_mode=mode)
+        batch, seq, iters, warmup = 2, 64, 2, 1
+    batch = int(os.environ.get("PT_MOE_BATCH", batch))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (batch, seq + 1))
+    batch_dict = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    def build_step(grouped: bool):
+        os.environ["PADDLE_TPU_GROUPED_MOE"] = "1" if grouped else "0"
+        pp.seed(0)
+        model = MoEForCausalLM(cfg)
+        opt = pp.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+        return TrainStep(model, opt)
+
+    knob_prev = os.environ.get("PADDLE_TPU_GROUPED_MOE")
+    try:
+        # knob-off reference first: same seed, same batch, one step —
+        # the grouped path must reproduce this loss
+        step_off = build_step(False)
+        loss_off = float(step_off(batch_dict))
+        del step_off
+
+        step = build_step(True)
+        loss_on = float(step(batch_dict))  # warmup step 1 + parity probe
+        for _ in range(warmup - 1):
+            step(batch_dict)
+        jax.block_until_ready(step.params)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(batch_dict)
+        jax.block_until_ready(step.params)
+        dt = (time.perf_counter() - t0) / iters
+    finally:
+        if knob_prev is None:
+            os.environ.pop("PADDLE_TPU_GROUPED_MOE", None)
+        else:
+            os.environ["PADDLE_TPU_GROUPED_MOE"] = knob_prev
+
+    rel_diff = abs(loss_on - loss_off) / max(abs(loss_off), 1e-9)
+    parity_ok = rel_diff <= 5e-3
+
+    n_params = sum(int(np.prod(a.shape)) for a in step.params.values())
+    expert_params = sum(int(np.prod(a.shape))
+                        for name, a in step.params.items()
+                        if ".experts." in name)
+    idle = int(expert_params
+               * (cfg.num_experts - cfg.num_experts_per_tok)
+               / cfg.num_experts)
+    activated = n_params - idle
+    tokens = batch * seq
+    flops_per_token = 6 * activated + \
+        12 * cfg.num_hidden_layers * seq * cfg.hidden_size
+    mfu = flops_per_token * tokens / dt / _peak_flops(dev)
+
+    # grouped-kernel acceptance: cost-model HBM bytes at the sweep shape
+    # vs the dense-einsum pair — trace-level analysis, no execution
+    g, c, d, h, dtp = at.SWEEP_SHAPES["grouped_matmul"][0]
+    jdt = jnp.bfloat16 if dtp == "bfloat16" else jnp.float32
+    xs = [jnp.zeros(s, jdt) for s in
+          ((g, c, d), (g, d, h), (g, h), (g, h, d), (g, d))]
+
+    def _cost(fn):
+        rep = analysis.check(fn, *xs, passes=["cost-model"])
+        return rep.extras["cost"]
+
+    cgr = _cost(lambda *a: gm.grouped_expert_ffn(*a))
+    cdn = _cost(lambda *a: gm.grouped_expert_ffn_reference(*a))
+    bytes_ratio = cgr.total_bytes / max(cdn.total_bytes, 1)
+
+    # calibration-ledger feed: the measured MoE step lands in the
+    # corpus with its roofline prediction, same as the dense bench
+    from paddle_tpu.observability import calibration
+    if calibration.enabled():
+        from paddle_tpu.observability.device_profiler import \
+            detect_roofline
+        peak_r, _bw = detect_roofline()
+        pred_s = flops_per_token * tokens / peak_r if peak_r else 0.0
+        calibration.ledger().record(
+            "moe_step", (batch, seq), measured_s=dt,
+            predicted_s=pred_s, provenance="bench")
+    calibration_detail = calibration.bench_detail()
+
+    prev = _prev_named_record("BENCH_moe")
+    result = {
+        "metric": "moe_mfu",
+        "value": round(mfu, 8),  # CPU smoke values are ~1e-6 of peak
+        "unit": "fraction_of_peak_activated_flops",
+        "vs_prev": round(mfu / float(prev["value"]), 4)
+        if prev and prev.get("value") else None,
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens / dt, 1),
+            "step_time_s": round(dt, 4),
+            "params_total": n_params,
+            "params_activated": activated,
+            "dispatch_mode": mode,
+            "experts": cfg.num_experts,
+            "top_k": cfg.num_experts_per_tok,
+            "batch": batch, "seq": seq,
+            "device": getattr(dev, "device_kind", dev.platform),
+            "final_loss": float(loss),
+            "grouped_kernel": {
+                "enabled": True,
+                "bytes": int(cgr.total_bytes),
+                "dense_bytes": int(cdn.total_bytes),
+                "bytes_ratio": round(float(bytes_ratio), 4),
+                "shape": {"g": g, "c": c, "d": d, "h": h, "dtype": dtp},
+                "paths": _metric_series(
+                    "paddle_tpu_grouped_moe_path_total"),
+            },
+            "knob_off_parity": {
+                "loss_grouped": loss_on,
+                "loss_reference": loss_off,
+                "rel_diff": float(rel_diff),
+                "ok": bool(parity_ok),
+            },
+            "calibration": calibration_detail,
+        },
+    }
+    print(json.dumps(result))
+    _emit_named(args, result, "bench_moe", "BENCH_moe")
+
+    rc = 0
+    if args.compare:
+        if prev is None:
+            print(json.dumps({"bench_compare": {
+                "ok": True, "note": "no previous BENCH_moe artifact"}}),
+                file=sys.stderr)
+        else:
+            tol = 0.05 if args.tolerance is None else args.tolerance
+            regressions = compare_moe_records(result, prev, tol)
+            print(json.dumps({"bench_compare": {
+                "ok": not regressions, "tolerance": tol,
+                "prev_value": prev.get("value"),
+                "regressions": regressions}}), file=sys.stderr)
+            rc = 1 if regressions else rc
+    if bytes_ratio >= 0.5:
+        print(f"moe bench: grouped-kernel bytes ratio "
+              f"{bytes_ratio:.3f} >= 0.5x dense acceptance bar",
+              file=sys.stderr)
+        rc = 1
+    if not parity_ok:
+        print(f"moe bench: knob-off parity FAILED "
+              f"(rel_diff {rel_diff:.2e})", file=sys.stderr)
+        rc = 1
+    return rc
+
+
+def _longctx_bench(args):
+    """Long-context bench (ISSUE 18): flash-backed ring attention on an
+    ``sp`` mesh, emitting the ``longctx_mfu`` trajectory line (attention
+    FLOPs utilisation of the fwd+bwd step at O(seq/sp) per-device
+    memory).  Off-TPU the mesh is the 8-way virtual CPU host platform —
+    the same program the multichip dryrun compiles — with pallas in
+    interpret mode.  The detail payload carries the single-device flash
+    parity error (absolute bar: the oracle is exact math), the striped
+    causal-balance variant's parity, and the per-device memory story;
+    the measured step feeds the calibration ledger."""
+    if "tpu" not in os.environ.get("JAX_PLATFORMS", "").lower():
+        from _jax_platform import force_cpu_default
+        force_cpu_default(min_devices=8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.nn.functional.attention import _sdpa_reference
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    sp = int(os.environ.get("PT_LONGCTX_SP", "4"))
+    if on_tpu:
+        b, s, h, d = 1, 32768, 8, 128
+        iters, warmup = 5, 2
+    else:  # CI/CPU smoke — interpret-mode flash per hop
+        b, s, h, d = 1, 512, 4, 32
+        iters, warmup = 2, 1
+    s = int(os.environ.get("PT_LONGCTX_SEQ", s))
+    sp = min(sp, len(jax.devices()))
+    mesh = Mesh(np.array(jax.devices()[:sp]), ("sp",))
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32) * 0.5
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32) * 0.5
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32) * 0.5
+
+    ring = dist.make_ring_attention(mesh, causal=True, impl="flash")
+    out = jax.jit(ring)(q, k, v)
+    want = _sdpa_reference(q, k, v, is_causal=True)
+    max_err = float(jnp.max(jnp.abs(out - want)))
+    parity_bar = 2e-5  # fp32 operands
+    parity_ok = max_err <= parity_bar
+
+    # striped causal-balance variant: operands pre-striped rank-major,
+    # unstriped output must match the same oracle
+    def _stripe(x):
+        return jnp.concatenate([x[:, r::sp] for r in range(sp)], axis=1)
+
+    def _unstripe(y):
+        t = y.reshape(b, sp, s // sp, *y.shape[2:])
+        return jnp.swapaxes(t, 1, 2).reshape(y.shape)
+
+    striped = dist.make_striped_ring_attention(mesh, causal=True)
+    out_s = _unstripe(jax.jit(striped)(_stripe(q), _stripe(k), _stripe(v)))
+    striped_err = float(jnp.max(jnp.abs(out_s - want)))
+
+    # timed: fwd+bwd through the flash-hop custom VJP — the training
+    # cost the MFU headline measures
+    loss_fn = jax.jit(jax.value_and_grad(
+        lambda q, k, v: (ring(q, k, v) ** 2).mean(), argnums=(0, 1, 2)))
+    for _ in range(warmup):
+        loss_fn(q, k, v)
+    jax.block_until_ready(loss_fn(q, k, v)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        val, grads = loss_fn(q, k, v)
+    jax.block_until_ready(grads[0])
+    dt = (time.perf_counter() - t0) / iters
+
+    # attention FLOPs: fwd = 4*b*h*s^2*d (QK^T + PV), bwd = 2x fwd
+    # (dQ/dK/dV + recompute), halved for causal
+    flops = 12 * b * h * s * s * d * 0.5
+    mfu = flops / dt / _peak_flops(dev)
+
+    from paddle_tpu.distributed.sharding import overlap_enabled
+    from paddle_tpu.observability import calibration
+    if calibration.enabled():
+        from paddle_tpu.observability.device_profiler import \
+            detect_roofline
+        peak_r, _bw = detect_roofline()
+        calibration.ledger().record(
+            "longctx_step", (b, s, sp), measured_s=dt,
+            predicted_s=flops / peak_r if peak_r else 0.0,
+            provenance="bench")
+    calibration_detail = calibration.bench_detail()
+
+    # per-device memory story: resident kv vs the dense score matrix
+    kv_bytes_per_dev = 2 * b * (s // sp) * h * d * 4
+    dense_scores_bytes = b * h * s * s * 4
+
+    prev = _prev_named_record("BENCH_longctx")
+    result = {
+        "metric": "longctx_mfu",
+        "value": round(mfu, 8),  # CPU smoke values are ~1e-6 of peak
+        "unit": "fraction_of_peak",
+        "vs_prev": round(mfu / float(prev["value"]), 4)
+        if prev and prev.get("value") else None,
+        "detail": {
+            "tokens_per_sec": round(b * s / dt, 1),
+            "step_time_s": round(dt, 4),
+            "batch": b, "seq": s, "heads": h, "head_dim": d,
+            "sp": sp, "impl": "flash", "causal": True,
+            "seq_per_device": s // sp,
+            "kv_bytes_per_device": kv_bytes_per_dev,
+            "dense_scores_bytes": dense_scores_bytes,
+            "collective_overlap": bool(overlap_enabled()),
+            "device": getattr(dev, "device_kind", dev.platform),
+            "final_loss": float(val),
+            "parity": {
+                "max_abs_err": max_err,
+                "striped_max_abs_err": striped_err,
+                "bar": parity_bar,
+                "ok": bool(parity_ok),
+            },
+            "calibration": calibration_detail,
+        },
+    }
+    print(json.dumps(result))
+    _emit_named(args, result, "bench_longctx", "BENCH_longctx")
+
+    rc = 0
+    if args.compare:
+        if prev is None:
+            print(json.dumps({"bench_compare": {
+                "ok": True,
+                "note": "no previous BENCH_longctx artifact"}}),
+                file=sys.stderr)
+        else:
+            tol = 0.05 if args.tolerance is None else args.tolerance
+            regressions = compare_longctx_records(result, prev, tol)
+            print(json.dumps({"bench_compare": {
+                "ok": not regressions, "tolerance": tol,
+                "prev_value": prev.get("value"),
+                "regressions": regressions}}), file=sys.stderr)
+            rc = 1 if regressions else rc
+    if not parity_ok:
+        print(f"longctx bench: ring-vs-flash parity FAILED "
+              f"(max_abs_err {max_err:.2e} > {parity_bar:.0e})",
+              file=sys.stderr)
+        rc = 1
+    return rc
+
+
 def _recovery_drill(args):
     """MTTR drill (ISSUE 14): kill a training rank mid-run under the
     chaos registry, recover it twice — from a peer's in-memory snapshot
@@ -542,14 +958,29 @@ def main(argv=None):
                          "checkpoint, verify the bitwise loss "
                          "trajectory + SDC sentinel blame (exit 1 on "
                          "any failure)")
+    ap.add_argument("--moe", action="store_true",
+                    help="instead of the dense training bench, run the "
+                         "MoE workload bench (grouped expert-matmul "
+                         "kernel on) and emit the moe_mfu line; "
+                         "--compare checks the newest BENCH_moe_r*.json")
+    ap.add_argument("--longctx", action="store_true",
+                    help="instead of the dense training bench, run the "
+                         "long-context ring-attention bench and emit "
+                         "the longctx_mfu line; --compare checks the "
+                         "newest BENCH_longctx_r*.json")
     ap.add_argument("--emit", metavar="PATH", nargs="?", const="auto",
-                    help="with --recovery-drill: write the artifact "
-                         "(auto = next BENCH_recovery_rNN.json beside "
+                    help="with --recovery-drill/--moe/--longctx: write "
+                         "the artifact (auto = next "
+                         "BENCH_{recovery,moe,longctx}_rNN.json beside "
                          "this script)")
     args = ap.parse_args(argv)
 
     if args.recovery_drill:
         return _recovery_drill(args)
+    if args.moe:
+        return _moe_bench(args)
+    if args.longctx:
+        return _longctx_bench(args)
 
     if args.compare_serve:
         with open(args.compare_serve) as f:

@@ -1,21 +1,32 @@
-"""Candidate DP/FSDP/TP/PP/sequence-parallel layout enumeration.
+"""Candidate DP/FSDP/TP/EP/PP/sequence-parallel layout enumeration.
 
 A candidate is a mesh-axis factorization of the device count onto the
-canonical ``("dp", "fsdp", "tp")`` GSPMD mesh (plus an optional pipeline
-factor scored analytically and a sequence-parallel flag that shards the
-batch's sequence dim over tp), together with the per-parameter placement
-template it induces:
+canonical ``("dp", "fsdp", "tp")`` GSPMD mesh (plus an optional expert
+axis ``ep`` for MoE models, a pipeline factor scored analytically and a
+sequence-parallel flag that shards the batch's sequence dim over tp),
+together with the per-parameter placement template it induces:
 
 * attention / MLP projections: Megatron column/row parallel on ``tp``
   with the other weight dim ZeRO-3-sharded on ``fsdp``;
 * embedding: vocab on ``tp``, hidden on ``fsdp``; lm-head column
   parallel; norms replicated;
+* stacked MoE expert weights (``[E, ...]``): expert dim on ``ep``, the
+  projections tp/fsdp-sharded like their dense counterparts; the router
+  gate replicated (every rank routes its own tokens);
 * anything unrecognised: largest dim on ``fsdp`` when it divides.
+
+``ep`` variants are enumerated only when the model has stacked experts
+(``num_experts``) and ``ep`` divides them; the batch shards over
+``(dp, fsdp, ep)`` — tokens are data-parallel over the expert axis and
+reach their expert through the dispatch all-to-all, which the planner
+charges analytically.
 
 Template entries whose shard factor does not divide the tensor dim are
 DEGRADED to replicated (never padded) — the scorer then charges the lost
-parallelism honestly instead of the checker flagging pad waste.
-Candidates whose batch cannot divide over (dp × fsdp) are pruned.
+parallelism honestly instead of the checker flagging pad waste; entries
+naming a mesh axis the candidate does not carry (``ep`` on a dense mesh)
+degrade the same way.  Candidates whose batch cannot divide over
+(dp × fsdp × ep) are pruned.
 """
 
 from __future__ import annotations
@@ -24,9 +35,10 @@ import dataclasses
 from typing import Dict, Optional, Tuple
 
 __all__ = ["MeshCandidate", "enumerate_candidates", "specs_for_candidate",
-           "AXIS_NAMES"]
+           "AXIS_NAMES", "EXPERT_AXIS"]
 
 AXIS_NAMES = ("dp", "fsdp", "tp")
+EXPERT_AXIS = "ep"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,26 +47,40 @@ class MeshCandidate:
     fsdp: int = 1
     tp: int = 1
     pp: int = 1                  # >1 → pipeline candidate (analytic score)
+    ep: int = 1                  # >1 → expert-parallel axis (MoE)
     seq_parallel: bool = False   # shard batch seq dim over tp
 
     @property
     def n_devices(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.pp
+        return self.dp * self.fsdp * self.tp * self.pp * self.ep
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        """Mesh axes this candidate actually carries — ``ep`` only when
+        expert-parallel, so dense plans keep the canonical 3-axis mesh."""
+        return AXIS_NAMES + (EXPERT_AXIS,) if self.ep > 1 else AXIS_NAMES
 
     def mesh_shape(self) -> Dict[str, int]:
         """The GSPMD mesh the per-stage program runs on (pp is a stage
         split, not a GSPMD axis here)."""
-        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp}
+        shape = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp}
+        if self.ep > 1:
+            shape[EXPERT_AXIS] = self.ep
+        return shape
 
     def batch_spec(self):
         from jax.sharding import PartitionSpec as P
+        data = ("dp", "fsdp", EXPERT_AXIS) if self.ep > 1 \
+            else ("dp", "fsdp")
         if self.seq_parallel:
-            return P(("dp", "fsdp"), "tp")
-        return P(("dp", "fsdp"))
+            return P(data, "tp")
+        return P(data)
 
     @property
     def label(self) -> str:
         parts = [f"dp{self.dp}", f"fsdp{self.fsdp}", f"tp{self.tp}"]
+        if self.ep > 1:
+            parts.append(f"ep{self.ep}")
         if self.pp > 1:
             parts.insert(0, f"pp{self.pp}")
         s = "x".join(parts)
@@ -74,20 +100,28 @@ def _factorizations(n: int):
 
 
 def enumerate_candidates(n_devices: int, *, max_pp: int = 1,
-                         seq_len: Optional[int] = None):
+                         seq_len: Optional[int] = None,
+                         num_experts: Optional[int] = None):
     """Yield every candidate for ``n_devices``: all (dp, fsdp, tp)
     factorizations, their sequence-parallel variants (tp > 1 and the
-    sequence divides), and — when ``max_pp`` > 1 — pipeline splits of
-    each with the remaining devices factorized the same way."""
+    sequence divides), their expert-parallel variants (``num_experts``
+    given, ep > 1 dividing both the device budget and the expert
+    count), and — when ``max_pp`` > 1 — pipeline splits of each with
+    the remaining devices factorized the same way."""
     pps = [p for p in range(1, max_pp + 1)
            if n_devices % p == 0]
     for pp in pps:
         inner = n_devices // pp
-        for dp, fsdp, tp in _factorizations(inner):
-            yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp)
-            if tp > 1 and (seq_len is None or seq_len % tp == 0):
-                yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp,
-                                    seq_parallel=True)
+        eps = [1]
+        if num_experts:
+            eps += [e for e in range(2, inner + 1)
+                    if inner % e == 0 and num_experts % e == 0]
+        for ep in eps:
+            for dp, fsdp, tp in _factorizations(inner // ep):
+                yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp, ep=ep)
+                if tp > 1 and (seq_len is None or seq_len % tp == 0):
+                    yield MeshCandidate(dp=dp, fsdp=fsdp, tp=tp, pp=pp,
+                                        ep=ep, seq_parallel=True)
 
 
 # -- per-parameter placement template ----------------------------------------
@@ -109,6 +143,16 @@ def _llama_rules():
         ".gate_proj.weight": col,
         ".up_proj.weight": col,
         ".down_proj.weight": row,
+        # stacked MoE expert weights [E, ...]: experts on ep, the
+        # projections tp/fsdp-sharded like their dense counterparts
+        # (MUST precede the Megatron .w1/.w2 patterns — _match is
+        # first-hit and "experts.w1" ends with ".w1" too); the router
+        # gate stays replicated so every rank routes its own tokens
+        "experts.w1": P(EXPERT_AXIS, "fsdp", "tp"),
+        "experts.b1": P(EXPERT_AXIS, "tp"),
+        "experts.w2": P(EXPERT_AXIS, "tp", "fsdp"),
+        "experts.b2": P(EXPERT_AXIS, "fsdp"),
+        "gate.gate": P(),
         # Megatron-naming variants (mpu layers, ernie, planner stacks)
         ".wq": col, ".wk": col, ".wv": col, ".wo": row,
         ".w1": col, ".w3": col, ".w2": row,
@@ -126,16 +170,21 @@ def _match(name: str, rules: Dict):
 
 def _degrade(spec, shape, mesh_shape):
     """Replace entries whose shard factor does not divide the dim with
-    None; drop trailing entries beyond the tensor rank."""
+    None; drop axes the mesh does not carry (``ep`` on a dense
+    candidate) and trailing entries beyond the tensor rank."""
     from jax.sharding import PartitionSpec as P
     entries = list(spec)[:len(shape)]
     out = []
     for d, e in enumerate(entries):
         axes = (e,) if isinstance(e, str) else tuple(e or ())
+        axes = tuple(a for a in axes if a in mesh_shape)
         total = 1
         for a in axes:
             total *= mesh_shape.get(a, 1)
-        out.append(None if (total > 1 and shape[d] % total) else e)
+        if not axes or (total > 1 and shape[d] % total):
+            out.append(None)
+        else:
+            out.append(axes[0] if len(axes) == 1 else axes)
     return P(*out)
 
 
@@ -149,11 +198,12 @@ def specs_for_candidate(cand: MeshCandidate,
     shape as ``LlamaForCausalLM.partition_specs``)."""
     from jax.sharding import PartitionSpec as P
     mesh_shape = cand.mesh_shape()
-    data = cand.dp * cand.fsdp
+    data = cand.dp * cand.fsdp * cand.ep
     if batch_shape:
         if batch_shape[0] % max(data, 1):
+            axes = "dp*fsdp*ep" if cand.ep > 1 else "dp*fsdp"
             return {}, (f"batch {batch_shape[0]} not divisible by "
-                        f"dp*fsdp={data}")
+                        f"{axes}={data}")
         if cand.seq_parallel and len(batch_shape) > 1 and \
                 batch_shape[1] % cand.tp:
             return {}, (f"seq {batch_shape[1]} not divisible by "

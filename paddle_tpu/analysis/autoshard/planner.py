@@ -32,6 +32,7 @@ import numpy as np
 from paddle_tpu.analysis.diagnostics import Diagnostic, Severity
 from paddle_tpu.analysis.passes import PassContext, register_pass
 from paddle_tpu.analysis.autoshard.candidates import (AXIS_NAMES,
+                                                      EXPERT_AXIS,
                                                       MeshCandidate,
                                                       enumerate_candidates,
                                                       specs_for_candidate)
@@ -111,9 +112,10 @@ class AutoShardPlan:
             raise NotImplementedError(
                 "pp>1 plans target distributed.PipelineTrainStep; the "
                 "GSPMD ProcessMesh covers the per-stage (dp, fsdp, tp)")
-        shape = tuple(self.mesh_shape[a] for a in AXIS_NAMES)
+        axes = self.candidate.axis_names       # + "ep" for MoE plans
+        shape = tuple(self.mesh_shape[a] for a in axes)
         n = int(np.prod(shape))
-        return ProcessMesh(np.arange(n).reshape(shape), list(AXIS_NAMES),
+        return ProcessMesh(np.arange(n).reshape(shape), list(axes),
                            _devices=list(devices)[:n] if devices else None)
 
     def jax_mesh(self, devices=None):
@@ -357,6 +359,43 @@ def score_layout(tr, specs: Dict, mesh_shape: Dict[str, int],
     return sc, prop.collectives
 
 
+def _num_experts(param_shapes: Dict[str, Tuple[int, ...]]) -> int:
+    """Stacked-expert count: the leading dim of any rank-3 ``experts.*``
+    parameter (``[E, d, h]`` / ``[E, h, d]``), 0 for dense models —
+    gates whether ``ep`` variants enter the candidate space at all."""
+    for name, shape in param_shapes.items():
+        if "experts." in name and len(shape) == 3:
+            return int(shape[0])
+    return 0
+
+
+def _apply_ep(sc: CandidateScore, cand: MeshCandidate, batch_shape,
+              d_model: int, link_bw: float, overlap_f: float):
+    """Analytic expert-dispatch charge for ep > 1: the propagation sees
+    the einsum-dispatch program, but an ep-sharded run moves every
+    routed token to its expert's rank and back through two all-to-alls
+    (dispatch + combine), each with a backward twin — four a2as over
+    the ``ep`` axis per step, priced by the same overlap-aware
+    ``collective_seconds`` the rest of the scorer uses.  Tokens are
+    top-2 routed (the MoELayer default), so each crosses twice."""
+    from paddle_tpu.analysis.passes.cost_model import collective_seconds
+    if not batch_shape or not d_model or cand.ep <= 1:
+        return sc
+    data = max(cand.dp * cand.fsdp * cand.ep, 1)
+    tokens = int(np.prod(batch_shape[:2])) // data
+    nbytes = tokens * d_model * 4 * 2              # fp32 wire, top-2
+    raw = 4.0 * collective_seconds("all_to_all", nbytes, cand.ep,
+                                   bandwidth=link_bw)
+    charged = 4.0 * collective_seconds("all_to_all", nbytes, cand.ep,
+                                       bandwidth=link_bw,
+                                       overlap_fraction=overlap_f)
+    sc.collective_raw_s += raw
+    sc.collective_s += charged
+    sc.collective_bytes += 4 * nbytes
+    sc.n_collectives += 4
+    return sc
+
+
 def _d_model(param_shapes: Dict[str, Tuple[int, ...]]) -> int:
     """Hidden size guess for pipeline boundary bytes: the most common
     1-D parameter length (norm weights)."""
@@ -364,6 +403,11 @@ def _d_model(param_shapes: Dict[str, Tuple[int, ...]]) -> int:
     ones = [s[0] for s in param_shapes.values() if len(s) == 1 and s[0] > 1]
     if ones:
         return Counter(ones).most_common(1)[0][0]
+    # norm-less traces (a bare MoE layer): the stacked experts' input
+    # width [E, d, h] is the token width the dispatch a2a moves
+    for name, s in param_shapes.items():
+        if "experts." in name and len(s) == 3:
+            return int(s[1])
     return 0
 
 
@@ -402,7 +446,7 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
                rules: Optional[Dict] = None,
                options: Optional[Dict] = None) -> PlanResult:
     """Search layouts for an existing ``TraceResult``."""
-    _, _, link_bw, _ = _options(options)
+    _, _, link_bw, overlap_f = _options(options)
     param_shapes = _param_shapes(tr)
     batch_shape = None
     for name, var in zip(tr.invar_names, tr.jaxpr.invars):
@@ -414,11 +458,13 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
     seq_len = batch_shape[1] if batch_shape and len(batch_shape) > 1 \
         else None
     dm = _d_model(param_shapes)
+    n_experts = _num_experts(param_shapes)
 
     scored: List[CandidateScore] = []
     colls_of: Dict[MeshCandidate, tuple] = {}
     for cand in enumerate_candidates(n_devices, max_pp=max_pp,
-                                     seq_len=seq_len):
+                                     seq_len=seq_len,
+                                     num_experts=n_experts or None):
         specs, prune = specs_for_candidate(cand, param_shapes,
                                            batch_shape=batch_shape,
                                            rules=rules)
@@ -428,6 +474,8 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
         sc, colls = score_layout(tr, specs, cand.mesh_shape(),
                                  cand.batch_spec(), options=options,
                                  candidate=cand)
+        if cand.ep > 1:
+            _apply_ep(sc, cand, batch_shape, dm, link_bw, overlap_f)
         if cand.pp > 1:
             _apply_pp(sc, cand, batch_shape, dm, link_bw)
         if hbm_gb is not None and sc.peak_hbm_bytes > hbm_gb * (1 << 30):
@@ -448,7 +496,12 @@ def plan_trace(tr, n_devices: int, *, max_pp: int = 1, topk: int = 5,
     plans = []
     for sc in live[:topk]:
         specs, colls = colls_of[sc.candidate]
-        expected = frozenset((c.kind, tuple(c.axes)) for c in colls)
+        expected = set((c.kind, tuple(c.axes)) for c in colls)
+        if sc.candidate.ep > 1:
+            # the analytic dispatch/combine pair (_apply_ep) — expected
+            # so an ep-sharded run's a2a rides through the checker clean
+            expected.add(("all_to_all", (EXPERT_AXIS,)))
+        expected = frozenset(expected)
         plans.append(AutoShardPlan(
             candidate=sc.candidate, score=sc, param_specs=specs,
             batch_spec=sc.candidate.batch_spec(),
@@ -498,7 +551,8 @@ def _calibration_residual(scored: List[CandidateScore],
     for sc in scored:
         cand = sc.candidate
         if sc.pruned is None and cand is not None and cand.fsdp == 1 \
-                and cand.tp == 1 and getattr(cand, "pp", 1) == 1:
+                and cand.tp == 1 and getattr(cand, "pp", 1) == 1 \
+                and getattr(cand, "ep", 1) == 1:
             ref = sc
             break
     if ref is None or ref.raw_step_seconds <= 0.0:

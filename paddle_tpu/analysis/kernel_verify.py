@@ -579,6 +579,12 @@ def candidate_findings(op: str, shape: Tuple, cand: Tuple
         bt, bn = cand
         return qm.verify_static(t, k, n, wdtype=wdtype, xdtype=xdtype,
                                 block_t=bt, block_n=bn)
+    if op == "grouped_matmul":
+        from paddle_tpu.ops.pallas import grouped_matmul as gm
+        g, c, d, h, dtype = shape
+        bc, bf = cand
+        return gm.verify_static(g, c, d, h, dtype=dtype,
+                                block_c=bc, block_f=bf)
     raise KeyError(f"unknown sweep op {op!r}")
 
 
@@ -624,7 +630,8 @@ def _catalog_entries() -> List[Dict[str, Any]]:
     from paddle_tpu.ops.pallas import autotune as at
     from paddle_tpu.ops.pallas import (
         cross_entropy as ce, flash_attention as fa, fused_block as fb,
-        paged_attention as pa, quant_matmul as qm, rmsnorm as rn)
+        grouped_matmul as gm, paged_attention as pa, quant_matmul as qm,
+        rmsnorm as rn)
 
     rows: List[Dict[str, Any]] = []
 
@@ -680,6 +687,12 @@ def _catalog_entries() -> List[Dict[str, Any]]:
             f"bt{bt} bn{bn}",
             lambda t=t, k=k, n=n, w=wdtype, x=xdtype:
             qm.verify_static(t, k, n, wdtype=w, xdtype=x))
+    for g, c, d_, h_, dtype in at.SWEEP_SHAPES["grouped_matmul"]:
+        bc, bf_ = gm._default_grouped_blocks(c, d_, h_, dtype)
+        add("grouped_matmul", f"g{g} c{c} d{d_} h{h_} {dtype}",
+            f"bc{bc} bf{bf_}",
+            lambda g=g, c=c, d=d_, h=h_, dtype=dtype:
+            gm.verify_static(g, c, d, h, dtype=dtype))
     for B, h, hd, kvh, bs, nb, mb, dtype, quant in (
             (8, 16, 128, 8, 16, 128, 16, "bfloat16", False),
             (8, 16, 128, 8, 16, 128, 16, "bfloat16", True)):

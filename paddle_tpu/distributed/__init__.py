@@ -35,7 +35,8 @@ from paddle_tpu.distributed.moe import (  # noqa: F401
     moe_forward_a2a, moe_forward_index, moe_forward_ragged,
     moe_shard_a2a, moe_shard_index_a2a, top_k_gating)
 from paddle_tpu.distributed.sequence_parallel import (  # noqa: F401
-    make_ring_attention, make_ulysses_attention, ring_attention,
+    make_ring_attention, make_striped_ring_attention, make_ulysses_attention,
+    ring_attention, ring_flash_enabled, striped_ring_attention,
     ulysses_attention)
 from paddle_tpu.distributed import checkpoint  # noqa: F401
 from paddle_tpu.distributed.elastic import (  # noqa: F401
@@ -61,8 +62,9 @@ __all__ = [
     "spmd_pipeline", "stack_stage_params",
     "MoELayer", "ExpertFFN", "NaiveGate", "SwitchGate", "GShardGate",
     "top_k_gating",
-    "ring_attention", "ulysses_attention", "make_ring_attention",
-    "make_ulysses_attention",
+    "ring_attention", "striped_ring_attention", "ulysses_attention",
+    "make_ring_attention", "make_striped_ring_attention",
+    "make_ulysses_attention", "ring_flash_enabled",
     "checkpoint", "save_state_dict", "load_state_dict",
     "async_save_state_dict", "validate_checkpoint", "Converter",
     "AutoCheckpoint",

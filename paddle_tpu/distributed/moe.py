@@ -139,7 +139,14 @@ def moe_forward_index(x2d, logits, experts_fn, *, E: int, top_k: int,
     # reads only (topi, slot) pairs and dropped pairs carry w == 0, so
     # no mask multiply (saves one [E, C, d] HBM pass)
     expert_in = x2d[tok_for]                              # [E, C, d]
-    expert_out = experts_fn(expert_in)                    # [E, C, d]
+    if _grouped_moe_enabled():
+        # per-expert kept-assignment counts (the front-packed slot
+        # prefix) let the grouped kernel skip empty capacity blocks
+        counts = jnp.zeros((E,), jnp.int32).at[safe_e.reshape(-1)].add(
+            1, mode="drop")
+        expert_out = experts_fn(expert_in, counts)        # [E, C, d]
+    else:
+        expert_out = experts_fn(expert_in)                # [E, C, d]
     picked = expert_out[topi, jnp.clip(slot, 0, capacity - 1)]  # [T, k, d]
     out = jnp.einsum("tkd,tk->td", picked, w.astype(x2d.dtype))
     dropped = 1.0 - keep.astype(jnp.float32).mean()
@@ -234,19 +241,129 @@ class ExpertFFN(Layer):
         self.b1.partition_spec = P(ep_axis, None)
         self.b2.partition_spec = P(ep_axis, None)
 
-    def forward(self, expert_inputs):
-        """expert_inputs: [E, C, d] -> [E, C, d]."""
+    def forward(self, expert_inputs, counts=None):
+        """expert_inputs: [E, C, d] -> [E, C, d].  ``counts`` (optional
+        [E] int32 valid-slot prefix per expert) lets the grouped Pallas
+        kernel skip empty capacity blocks when PADDLE_TPU_GROUPED_MOE
+        is on; it is ignored by the dense einsum path."""
         from paddle_tpu.core.dispatch import unwrap
         return _expert_ffn(unwrap(expert_inputs), unwrap(self.w1),
                            unwrap(self.b1), unwrap(self.w2), unwrap(self.b2),
-                           lambda v: unwrap(self.activation(v)))
+                           lambda v: unwrap(self.activation(v)),
+                           counts=counts)
 
 
-def _expert_ffn(x, w1, b1, w2, b2, act):
+def _grouped_moe_enabled() -> bool:
+    """Trace-time check of the PADDLE_TPU_GROUPED_MOE knob (lazy import
+    keeps distributed/ free of an eager ops.pallas dependency)."""
+    from paddle_tpu.ops.pallas.grouped_matmul import grouped_moe_enabled
+    return grouped_moe_enabled()
+
+
+def _router_metrics():
+    """Routing-observability instruments (ISSUE 18), lazily created on
+    the process-wide registry so an import of distributed/ never pulls
+    exporters in."""
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    return {
+        "dropped": reg.counter(
+            "paddle_tpu_moe_dropped_tokens_total",
+            "token-choice assignments dropped by the capacity bound"),
+        "overflow": reg.counter(
+            "paddle_tpu_moe_capacity_overflow_total",
+            "routed forwards in which at least one assignment was "
+            "dropped (capacity pressure events)"),
+        "aux": reg.gauge(
+            "paddle_tpu_moe_aux_loss",
+            "GShard load-balance auxiliary loss of the last routed "
+            "forward"),
+        "load": reg.gauge(
+            "paddle_tpu_moe_expert_load",
+            "kept token-choice assignments per expert in the last "
+            "routed forward", labelnames=("expert",)),
+        "imbalance": reg.gauge(
+            "paddle_tpu_moe_expert_imbalance",
+            "max/mean per-expert load of the last routed forward "
+            "(1.0 = perfectly balanced)"),
+    }
+
+
+def _record_router_metrics(aux, dropped_frac, total_assignments,
+                           load=None):
+    """Update the dropped-token / capacity-overflow counters, the
+    aux-loss gauge and the per-expert load/imbalance gauges from one
+    routed forward.  Concrete (eager) values only: under jit the stats
+    are tracers and the traced program must stay identical to the
+    uninstrumented one, so this silently skips (the trace-time
+    ``paddle_tpu_grouped_moe_path_total`` counter still attributes the
+    implementation path)."""
+    try:
+        import jax.core as _core
+        vals = [aux, dropped_frac]
+        if load is not None:
+            vals.append(load)
+        if any(isinstance(v, _core.Tracer) for v in vals):
+            return
+        m = _router_metrics()
+        m["aux"].set(float(aux))
+        df = float(dropped_frac)
+        if df > 0:
+            m["dropped"].inc(df * total_assignments)
+            m["overflow"].inc()
+        if load is not None:
+            import numpy as _np
+            arr = _np.asarray(load, dtype=float)
+            for e, val in enumerate(arr):
+                m["load"].labels(expert=e).set(float(val))
+            mean = arr.mean()
+            m["imbalance"].set(
+                float(arr.max() / mean) if mean > 0 else 1.0)
+    except Exception:  # pragma: no cover - telemetry must never break fwd
+        pass
+
+
+def _expert_ffn(x, w1, b1, w2, b2, act, counts=None):
     """Stacked-expert FFN compute shared by ExpertFFN.forward and the
-    all_to_all dispatch path: [E, C, d] -> [E, C, d]."""
+    all_to_all dispatch path: [E, C, d] -> [E, C, d] (more generally
+    [G, C, d] with G a multiple of the expert count — the a2a paths pass
+    per-source-shard groups).  With PADDLE_TPU_GROUPED_MOE=1 this routes
+    to the grouped Pallas kernel (ops/pallas/grouped_matmul.py), which
+    skips capacity blocks past ``counts`` and zeroes their rows — a
+    no-op for MoE outputs since those slots carry zero combine weight.
+    Knob off, the dense einsum pair below traces byte-identically to
+    what it always produced (regression-tested)."""
+    from paddle_tpu.ops.pallas import grouped_matmul as _gm
+    if _gm.grouped_moe_enabled() and _gm.grouped_ffn_eligible(
+            x.shape[0], x.shape[1], x.shape[2], w1.shape[2], w1.shape[0]):
+        _gm.record_path("grouped")
+        return _gm.grouped_expert_ffn(x, w1, b1, w2, b2, counts=counts,
+                                      act=act)
     h = jnp.einsum("ecd,edh->ech", x, w1) + b1[:, None, :]
     return jnp.einsum("ech,ehd->ecd", act(h), w2) + b2[:, None, :]
+
+
+def _grouped_a2a_ffn(recv, send_counts, w1, b1, w2, b2, act, capacity,
+                     ep_axis):
+    """Grouped-kernel expert compute for the all_to_all bodies.
+
+    ``recv [E_loc, n*C, d]`` holds n source-shard chunks per local
+    expert; each chunk is an independently front-packed capacity buffer,
+    so the per-chunk occupancy counts are exchanged alongside the tokens
+    (the same all_to_all permutation, tiled over the expert axis) and
+    the FFN runs over ``[E_loc*n, C, d]`` groups with ``g // n`` mapping
+    groups to local expert weights — empty tail blocks of every chunk
+    are skipped, not just the global tail."""
+    e_loc, nc, d = recv.shape
+    n = nc // capacity
+    # [E] -> [n*E_loc] ordered (source shard, local expert); regroup to
+    # (local expert, source shard) to match recv's chunk layout
+    counts_recv = jax.lax.all_to_all(send_counts, ep_axis, split_axis=0,
+                                     concat_axis=0, tiled=True)
+    counts_g = counts_recv.reshape(n, e_loc).T.reshape(-1)
+    grp = recv.reshape(e_loc * n, capacity, d)
+    out = _expert_ffn(grp, w1, b1, w2, b2, act, counts=counts_g)
+    return out.reshape(e_loc, nc, d)
 
 
 def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
@@ -286,7 +403,12 @@ def moe_shard_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
     # [E_loc, n_shards*C, d]
     recv = jax.lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
                               tiled=True)
-    out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
+    if _grouped_moe_enabled():
+        send_counts = dispatch.astype(jnp.int32).sum(axis=(0, 2))  # [E]
+        out_loc = _grouped_a2a_ffn(recv, send_counts, w1, b1, w2, b2,
+                                   act, capacity, ep_axis)
+    else:
+        out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
     # inverse exchange: [E_loc, n*C, d] -> [E, C, d]
     back = jax.lax.all_to_all(out_loc, ep_axis, split_axis=1, concat_axis=0,
                               tiled=True)
@@ -333,7 +455,13 @@ def moe_shard_index_a2a(x2d, gate_w, w1, b1, w2, b2, *, top_k: int,
     buf = x2d[tok_for]                                        # [E, C, d]
     recv = jax.lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=1,
                               tiled=True)                     # [E_loc, n*C, d]
-    out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
+    if _grouped_moe_enabled():
+        send_counts = jnp.zeros((E,), jnp.int32).at[safe_e.reshape(-1)].add(
+            1, mode="drop")
+        out_loc = _grouped_a2a_ffn(recv, send_counts, w1, b1, w2, b2,
+                                   act, capacity, ep_axis)
+    else:
+        out_loc = _expert_ffn(recv, w1, b1, w2, b2, act)
     back = jax.lax.all_to_all(out_loc, ep_axis, split_axis=1, concat_axis=0,
                               tiled=True)                     # [E, C, d]
     picked = back[topi, jnp.clip(slot, 0, capacity - 1)]      # [T, k, d]
@@ -385,11 +513,17 @@ def moe_forward_a2a(x, gate_w, w1, b1, w2, b2, *, mesh, top_k: int = 2,
                     capacity=capacity, activation=activation,
                     ep_axis=ep_axis)
 
+    extra = {}
+    if _grouped_moe_enabled():
+        # jax 0.4.x's static replication checker has no rule for
+        # pallas_call; relax it only when the grouped kernel is routed
+        # so the knob-off trace (and its jaxpr) is untouched
+        extra["legacy_check_rep"] = False
     mapped = shard_map(
         fn, mesh=mesh,
         in_specs=(P(ep_axis), P(), P(ep_axis), P(ep_axis), P(ep_axis),
                   P(ep_axis)),
-        out_specs=(P(ep_axis), P(), P()))
+        out_specs=(P(ep_axis), P(), P()), **extra)
     out, aux, dropped = mapped(x2d, gate_w, w1, b1, w2, b2)
     # couple the scalar outputs into `out`'s dataflow with a zero-weight
     # term: a caller differentiating only `out` then sends DENSE zero
@@ -474,6 +608,7 @@ class MoELayer(Layer):
                           else "einsum"))
             self.aux_loss = aux
             self.router_stats = {"dropped_frac": dropped}
+            _record_router_metrics(aux, dropped, T * self.gate.top_k)
             return self._wrap_out(x, out)
 
         E = self.num_experts
@@ -490,6 +625,12 @@ class MoELayer(Layer):
             capacity = max(1, int(self.capacity_factor * self.gate.top_k
                                   * T / E))
         logits = unwrap(self.gate.logits(x2d))
+        from paddle_tpu.robustness import fault_fires
+        if fault_fires("moe.expert_imbalance", experts=E):
+            # hot-expert pathology drill: every token prefers expert 0 —
+            # the imbalance gauge and aux loss must surface the skew
+            logits = logits + jnp.where(jnp.arange(E) == 0, 10.0,
+                                        0.0).astype(logits.dtype)
         if self.dispatch_mode == "ragged":
             # dropless sort + grouped-matmul dispatch: no capacity buffers,
             # FLOPs over exactly T*k rows; the single-program fast path
@@ -503,6 +644,7 @@ class MoELayer(Layer):
                 activation=lambda v: unwrap(self.experts.activation(v)))
             self.aux_loss = aux
             self.router_stats = {"dropped_frac": dropped}
+            _record_router_metrics(aux, dropped, T * self.gate.top_k)
             return self._wrap_out(x, out.reshape(B, S, d))
         if self.dispatch_mode == "index":
             # gather/scatter dispatch: O(T·k·d) — the single-program fast
@@ -513,30 +655,41 @@ class MoELayer(Layer):
                 raise ValueError("index dispatch requires the stacked "
                                  "ExpertFFN experts")
 
-            def experts_fn(buf):
+            def experts_fn(buf, counts=None):
                 return _expert_ffn(
                     buf, unwrap(self.experts.w1), unwrap(self.experts.b1),
                     unwrap(self.experts.w2), unwrap(self.experts.b2),
-                    lambda v: unwrap(self.experts.activation(v)))
+                    lambda v: unwrap(self.experts.activation(v)),
+                    counts=counts)
 
             out, aux, dropped = moe_forward_index(
                 x2d, logits, experts_fn, E=E, top_k=self.gate.top_k,
                 capacity=capacity)
             self.aux_loss = aux
             self.router_stats = {"dropped_frac": dropped}
+            _record_router_metrics(aux, dropped, T * self.gate.top_k)
             return self._wrap_out(x, out.reshape(B, S, d))
         combine, dispatch, aux = top_k_gating(
             logits, k=self.gate.top_k, capacity=capacity)
         self.aux_loss = aux
         self.router_stats = {"dropped_frac": 1.0 - dispatch.sum().astype(
             jnp.float32) / (T * self.gate.top_k)}
+        _record_router_metrics(aux, self.router_stats["dropped_frac"],
+                               T * self.gate.top_k,
+                               load=dispatch.sum(axis=(0, 2)))
 
         # dispatch: [T,E,C] x [T,d] -> [E,C,d]; GSPMD lowers the contraction
         # to the expert all_to_all when E is sharded on ep
         expert_in = jnp.einsum("tec,td->ecd",
                                dispatch.astype(data.dtype), x2d)
         expert_in = constrain(expert_in, P(self.ep_axis, None, None))
-        expert_out = unwrap(self.experts(expert_in))
+        if _grouped_moe_enabled() and isinstance(self.experts, ExpertFFN):
+            # cumsum slot assignment front-packs each expert's bucket, so
+            # the filled-slot count per expert is a valid-row prefix
+            counts = dispatch.astype(jnp.int32).sum(axis=(0, 2))
+            expert_out = unwrap(self.experts(expert_in, counts=counts))
+        else:
+            expert_out = unwrap(self.experts(expert_in))
         # combine: [T,E,C] x [E,C,d] -> [T,d]
         out = jnp.einsum("tec,ecd->td", combine.astype(data.dtype),
                          expert_out)

@@ -652,7 +652,8 @@ class FleetAggregator:
     def table(self) -> str:
         """The fleet at a glance: one row per host (step EMA, steps,
         goodput, restarts, serving role/queue/slot occupancy, SLO
-        attainment, staleness), plus the straggler footer — hosts whose
+        attainment, MoE expert-load imbalance, staleness), plus the
+        straggler footer — hosts whose
         step-time EMA sits above the fleet median."""
         roster = self.hosts()
         # SDC quarantine roster (robustness.recovery): a blamed host's
@@ -669,7 +670,8 @@ class FleetAggregator:
         header = (f"{'host':<14} {'up':<6} {'age_s':>6} {'gen':>4} "
                   f"{'restarts':>8} {'steps':>7} {'step_ms':>8} "
                   f"{'goodput':>8} {'role':>8} {'queue':>6} "
-                  f"{'slots':>7} {'slo_ttft':>8} {'slo_tpot':>8}")
+                  f"{'slots':>7} {'slo_ttft':>8} {'slo_tpot':>8} "
+                  f"{'moe_imb':>7}")
         lines = [header, "-" * len(header)]
         emas: Dict[str, float] = {}
         for host in sorted(self._snapshots):
@@ -692,6 +694,8 @@ class FleetAggregator:
             active = self._snap_value(snap,
                                       "paddle_tpu_serving_active_slots")
             slots = self._snap_value(snap, "paddle_tpu_serving_slots")
+            moe_imb = self._snap_value(snap,
+                                       "paddle_tpu_moe_expert_imbalance")
             occupancy = (f"{active:.0f}/{slots:.0f}"
                          if active is not None and slots else "-")
 
@@ -711,7 +715,8 @@ class FleetAggregator:
                 f"{fmt(goodput):>8} {(role or '-'):>8} "
                 f"{fmt(queue):>6} {occupancy:>7} "
                 f"{fmt(ttft, pct=True):>8} "
-                f"{fmt(tpot, pct=True):>8}")
+                f"{fmt(tpot, pct=True):>8} "
+                f"{fmt(moe_imb):>7}")
         if emas:
             med = statistics.median(emas.values())
             stragglers = sorted(

@@ -782,6 +782,93 @@ def quant_block_sizes(t: int, k: int, n: int, wdtype: str,
     return tuple(autotune("quant_matmul", key, cands, bench, default))
 
 
+# -- grouped expert-matmul (MoE) ---------------------------------------------
+
+def _grouped_candidates(g, c, d, h, dtype) -> list:
+    """(block_c, block_f) candidates for the grouped expert FFN: the
+    f (hidden) axis is the sequential dim, so VMEM holds the x/y tiles,
+    the fp32 accumulator, and double-buffered [d, bf]/[bf, d] weight
+    tiles — the same working set as the fused MLP plus nothing (the
+    counts operand is one int32 word per group)."""
+    item = 2 if ("bfloat16" in dtype or "float16" in dtype) else 4
+    quantum = 16 if item == 2 else 8
+    out = []
+    for bf in (128, 256, 512):
+        if h % bf:
+            continue
+        for bc in (8, 16, 32, 64, 128, 256, 512):
+            if bc % quantum or c % bc or bc > c:
+                continue
+            vmem = (2 * bc * d * item            # x, double-buffered
+                    + bc * d * 4                 # fp32 accumulator
+                    + 2 * bc * d * item          # y, double-buffered
+                    + 4 * d * bf * item)         # w1 + w2 tiles, 2x
+            if vmem < 10 * (1 << 20):
+                out.append((bc, bf))
+    if not out:
+        from paddle_tpu.ops.pallas.grouped_matmul import \
+            _default_grouped_blocks
+        out = [_default_grouped_blocks(c, d, h, dtype)]
+    return out
+
+
+def grouped_key(g, c, d, h, dtype, backend=None, interpret=None):
+    return (f"g{g}c{c}d{d}h{h}x{dtype}"
+            f"@{backend or backend_tag(interpret)}")
+
+
+def grouped_block_sizes(g: int, c: int, d: int, h: int,
+                        dtype: str) -> Tuple[int, int]:
+    """Measured (block_c, block_f) for the grouped expert FFN at this
+    [g, c, d] x stacked [g, d, h] shape.  Benched with full counts
+    (worst case: no empty-block skip) so the winner is robust to
+    routing balance."""
+    from paddle_tpu.ops.pallas.grouped_matmul import _default_grouped_blocks
+    default = _default_grouped_blocks(c, d, h, dtype)
+    cands = _grouped_candidates(g, c, d, h, dtype)
+    cands, _ = _verify_prune("grouped_matmul", (g, c, d, h, dtype), cands)
+    if len(cands) == 1:
+        return tuple(cands[0])
+    key = grouped_key(g, c, d, h, dtype)
+
+    def bench(blocks):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+
+        from paddle_tpu.ops.pallas.grouped_matmul import \
+            grouped_expert_ffn_pallas
+
+        bc, bf = blocks
+        iters = 8
+        rng = np.random.default_rng(0)
+        dt = jnp.dtype(dtype)
+        x = jnp.asarray(rng.standard_normal((g, c, d)), dt)
+        w1 = jnp.asarray(rng.standard_normal((g, d, h)) * 0.02, dt)
+        b1 = jnp.zeros((g, h), dt)
+        w2 = jnp.asarray(rng.standard_normal((g, h, d)) * 0.02, dt)
+        b2 = jnp.zeros((g, d), dt)
+        counts = jnp.full((g,), c, jnp.int32)
+
+        @jax.jit
+        def run(x_, w1_, b1_, w2_, b2_, cnt_):
+            def body(i, carry):
+                o = grouped_expert_ffn_pallas(
+                    x_ * (1 + carry * 1e-12).astype(dt), w1_, b1_, w2_,
+                    b2_, cnt_, act=jax.nn.gelu, block_c=bc, block_f=bf,
+                    interpret=False)
+                return carry + jnp.sum(jnp.abs(o).astype(jnp.float32))
+            return lax.fori_loop(0, iters, body, 0.0)
+
+        np.asarray(run(x, w1, b1, w2, b2, counts))    # compile + warm
+        t0 = time.perf_counter()
+        np.asarray(run(x, w1, b1, w2, b2, counts))
+        return (time.perf_counter() - t0) / iters
+
+    return tuple(autotune("grouped_matmul", key, cands, bench, default))
+
+
 # -- offline sweep -----------------------------------------------------------
 
 # the bench llama (bench.py on-TPU config: 810M-param Llama-3 proportions,
@@ -818,6 +905,14 @@ SWEEP_SHAPES = {
         (256, 1024, 1024, "int8", "bfloat16"),
         (256, 1024, 3584, "float8_e4m3fn", "bfloat16"),
         (16, 1024, 1024, "int8", "bfloat16"),
+    ],
+    # grouped expert-matmul (MoE): the bench_moe llama's E=8 experts at
+    # bench widths — capacity from b4/s2048 top-2 routing at
+    # capacity_factor 1.25 (C = 1.25*2*8192/8 = 2560), plus the
+    # short-context variant
+    "grouped_matmul": [
+        (8, 2560, 1024, 3584, "bfloat16"),
+        (8, 1280, 1024, 3584, "bfloat16"),
     ],
 }
 
@@ -887,6 +982,17 @@ def _sweep_one(op, shape, dry_run, backend):
         if not dry_run:
             return key, quant_block_sizes(t, k, n, wdtype, xdtype), \
                 len(cands), npruned
+    elif op == "grouped_matmul":
+        g, c, d, h, dtype = shape
+        from paddle_tpu.ops.pallas.grouped_matmul import \
+            _default_grouped_blocks
+        cands = _grouped_candidates(g, c, d, h, dtype)
+        default = _default_grouped_blocks(c, d, h, dtype)
+        key = grouped_key(g, c, d, h, dtype, backend=backend)
+        _, npruned = _verify_prune(op, shape, cands)
+        if not dry_run:
+            return key, grouped_block_sizes(g, c, d, h, dtype), \
+                len(cands), npruned
     else:
         raise ValueError(f"unknown sweep op {op!r}")
     # dry run: the heuristic default stands in for the measured winner —
@@ -915,6 +1021,9 @@ def _sweep_candidates(op, shape):
     if op == "quant_matmul":
         t, k, n, wdtype, xdtype = shape
         return _quant_candidates(t, k, n, wdtype, xdtype)
+    if op == "grouped_matmul":
+        g, c, d, h, dtype = shape
+        return _grouped_candidates(g, c, d, h, dtype)
     raise ValueError(f"unknown sweep op {op!r}")
 
 

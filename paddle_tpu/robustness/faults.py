@@ -78,6 +78,20 @@ point                                 site
                                       mid-run (bool-style; the trigger
                                       ``bench.py --recovery-drill`` arms
                                       to measure MTTR)
+``moe.expert_imbalance``              skews the MoE router's logits
+                                      toward expert 0 (bool-style
+                                      hot-expert pathology; the routing
+                                      observability gauges —
+                                      ``paddle_tpu_moe_expert_imbalance``
+                                      and the fleet ``moe_imb`` column —
+                                      must light up, and capacity
+                                      overflow counters must tick)
+``sp.ring_peer``                      raises at ring-attention setup,
+                                      before the hop scan is traced (lost
+                                      ring neighbor analog; the trace
+                                      fails loudly, nothing is cached,
+                                      and clearing the fault restores
+                                      the path)
 ====================================  =====================================
 
 Env syntax (comma-separated specs, colon-separated options)::

@@ -480,3 +480,99 @@ class TestAutoshardCLI:
         assert "ranked plans" in out
         assert "round-trip: clean" in out
         assert "planner wins or ties" in out
+
+
+# ------------------------------------------------ expert-parallel axis
+
+class TestExpertAxis:
+    """ISSUE 18 layer 3: ``ep`` in the candidate space — gated on
+    stacked experts, dispatch a2a charged by the overlap-aware
+    collective model, emitted plans round-trip the checker clean."""
+
+    def _moe(self, d=64, E=8, h=128):
+        import paddle_tpu.distributed as dist
+        pp.seed(0)
+        return dist.MoELayer(d_model=d, num_experts=E, d_hidden=h,
+                             capacity_factor=2.0)
+
+    def test_enumeration_gated_on_experts(self):
+        dense = list(enumerate_candidates(8))
+        assert not any(c.ep > 1 for c in dense)
+        moe = list(enumerate_candidates(8, num_experts=8))
+        eps = [c for c in moe if c.ep > 1]
+        assert eps
+        assert all(c.n_devices == 8 for c in moe)
+        assert all(8 % c.ep == 0 for c in eps)
+        labels = {c.label for c in moe}
+        assert "dp1xfsdp1xtp1xep8" in labels
+        assert "dp2xfsdp2xtp1xep2" in labels
+
+    def test_ep_must_divide_expert_count(self):
+        cands = list(enumerate_candidates(8, num_experts=6))
+        assert {c.ep for c in cands} == {1, 2}   # 4, 8 do not divide 6
+
+    def test_stacked_expert_template(self):
+        cand = MeshCandidate(dp=1, fsdp=2, tp=2, ep=2)
+        specs, why = specs_for_candidate(
+            cand, {"experts.w1": (8, 64, 128), "experts.b1": (8, 128),
+                   "experts.w2": (8, 128, 64), "experts.b2": (8, 64),
+                   "gate.gate": (64, 8)},
+            batch_shape=(8, 16))
+        assert why is None
+        assert specs["experts.w1"] == P("ep", "fsdp", "tp")
+        assert specs["experts.b1"] == P("ep", "tp")
+        assert specs["experts.w2"] == P("ep", "tp", "fsdp")
+        assert specs["experts.b2"] == P("ep", "fsdp")
+        assert specs["gate.gate"] == P()
+
+    def test_ep_axis_degrades_on_dense_mesh(self):
+        """A stacked-expert name scored on an ep-less candidate must not
+        leak the ep axis into the spec."""
+        cand = MeshCandidate(dp=2, fsdp=2, tp=2)
+        specs, _ = specs_for_candidate(
+            cand, {"experts.w1": (8, 64, 128)}, batch_shape=(8, 16))
+        assert specs["experts.w1"] == P(None, "fsdp", "tp")
+
+    def test_batch_shards_over_ep(self):
+        cand = MeshCandidate(dp=2, fsdp=1, tp=1, ep=4)
+        assert cand.batch_spec() == P(("dp", "fsdp", "ep"))
+        assert cand.mesh_shape()["ep"] == 4
+        assert cand.axis_names == ("dp", "fsdp", "tp", "ep")
+        # dense candidates keep the canonical 3-axis mesh
+        assert MeshCandidate(dp=8).axis_names == ("dp", "fsdp", "tp")
+
+    def test_plan_scores_and_charges_dispatch_a2a(self):
+        moe = self._moe()
+        x = pp.randn([8, 16, 64])
+        res = autoshard.plan(moe, x, n_devices=8)
+        eps = [s for s in res.scored
+               if s.candidate.ep > 1 and s.pruned is None]
+        assert eps, "no ep candidate survived"
+        # the dispatch/combine pair + backward twins are charged on every
+        # ep candidate, at no more than the undiscounted ring time
+        for s in eps:
+            assert s.n_collectives >= 4, s.candidate.label
+            assert s.collective_bytes > 0
+            assert 0.0 < s.collective_s <= s.collective_raw_s + 1e-12
+        # and the charge follows collective_seconds: pure-EP moves the
+        # most tokens over the widest axis, so it pays more a2a than a
+        # variant that splits the same devices with dp
+        by_label = {s.candidate.label: s for s in eps}
+        assert by_label["dp1xfsdp1xtp1xep8"].collective_bytes >= \
+            by_label["dp4xfsdp1xtp1xep2"].collective_bytes
+
+    def test_ep_plans_roundtrip_checker_clean(self):
+        moe = self._moe()
+        x = pp.randn([8, 16, 64])
+        res = autoshard.plan(moe, x, n_devices=8, topk=10)
+        ep_plans = [p for p in res.plans if p.candidate.ep > 1]
+        assert ep_plans, "no ep plan in the top k"
+        for p in ep_plans:
+            rep = p.verify(moe, x)
+            assert not rep.errors() and not rep.warnings(), (
+                p.candidate.label + "\n" + rep.format())
+            assert ("all_to_all", ("ep",)) in p.expected_collectives
+            mesh = p.jax_mesh()
+            assert dict(mesh.shape)["ep"] == p.candidate.ep
+            sh = p.shardings()
+            assert sh["experts.w1"].spec == p.param_specs["experts.w1"]

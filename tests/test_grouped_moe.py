@@ -1,0 +1,321 @@
+"""Grouped expert-matmul Pallas kernel + PADDLE_TPU_GROUPED_MOE routing
+(ISSUE 18 tentpole, layer 1).
+
+Covers: interpret-mode fwd/bwd numerics of the grouped kernel against the
+masked einsum reference (fp32 and bf16, full and partial ``counts``), the
+exactly-zero contract for rows past a group's count, knob routing (off
+restores the previous dense-einsum jaxpr byte-for-byte; on swaps in one
+pallas_call) across every MoE dispatch mode, the static kernel-verify
+catalog rows, autotune-v2 candidates/key/sweep plumbing, and the
+cost-model bytes acceptance (< 0.5x of the dense einsum pair at the bench
+shape).
+
+Everything runs interpret-mode on CPU (conftest pins JAX_PLATFORMS).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+import paddle_tpu as pp  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.core.dispatch import unwrap  # noqa: E402
+from paddle_tpu.ops.pallas import autotune as at  # noqa: E402
+from paddle_tpu.ops.pallas import grouped_matmul as GM  # noqa: E402
+
+
+def _weights(rng, E, d, h, dtype=jnp.float32):
+    return (jnp.asarray(rng.standard_normal((E, d, h)) * 0.1, dtype),
+            jnp.asarray(rng.standard_normal((E, h)) * 0.1, dtype),
+            jnp.asarray(rng.standard_normal((E, h, d)) * 0.1, dtype),
+            jnp.asarray(rng.standard_normal((E, d)) * 0.1, dtype))
+
+
+def _tokens(rng, G, C, d, dtype=jnp.float32):
+    return jnp.asarray(rng.standard_normal((G, C, d)), dtype)
+
+
+# ---------------------------------------------------------------------------
+# kernel numerics
+# ---------------------------------------------------------------------------
+
+
+class TestGroupedKernel:
+    @pytest.mark.parametrize("G,C,d,h,E", [(4, 16, 8, 16, 4),
+                                           (8, 16, 8, 16, 4),
+                                           (2, 24, 16, 48, 2)])
+    def test_fwd_matches_reference_full_counts(self, G, C, d, h, E):
+        rng = np.random.default_rng(0)
+        x = _tokens(rng, G, C, d)
+        w1, b1, w2, b2 = _weights(rng, E, d, h)
+        got = GM.grouped_expert_ffn(x, w1, b1, w2, b2)
+        want = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_partial_counts_skip_and_zero(self):
+        """Rows past a group's count come back exactly zero and the valid
+        prefix matches the masked reference — the block-size-independent
+        contract every dispatch path relies on."""
+        rng = np.random.default_rng(1)
+        G, C, d, h, E = 4, 16, 8, 16, 4
+        x = _tokens(rng, G, C, d)
+        w1, b1, w2, b2 = _weights(rng, E, d, h)
+        counts = jnp.asarray([0, 3, 16, 9], jnp.int32)
+        got = GM.grouped_expert_ffn(x, w1, b1, w2, b2, counts=counts,
+                                    block_c=8, block_f=16)
+        want = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2, counts)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        rows = np.arange(C)[None, :, None]
+        pad = np.asarray(got) * (rows >= np.asarray(counts)[:, None, None])
+        assert not pad.any()                     # exactly zero, not small
+
+    def test_block_size_independent(self):
+        rng = np.random.default_rng(2)
+        G, C, d, h, E = 2, 32, 8, 32, 2
+        x = _tokens(rng, G, C, d)
+        w1, b1, w2, b2 = _weights(rng, E, d, h)
+        counts = jnp.asarray([5, 32], jnp.int32)
+        outs = [np.asarray(GM.grouped_expert_ffn(
+            x, w1, b1, w2, b2, counts=counts, block_c=bc, block_f=bf))
+            for bc, bf in [(8, 16), (16, 32), (32, 32)]]
+        for o in outs[1:]:
+            np.testing.assert_allclose(o, outs[0], rtol=2e-5, atol=2e-5)
+
+    def test_bf16_matches_reference(self):
+        rng = np.random.default_rng(3)
+        G, C, d, h, E = 4, 16, 8, 16, 4
+        x = _tokens(rng, G, C, d, jnp.bfloat16)
+        w1, b1, w2, b2 = _weights(rng, E, d, h, jnp.bfloat16)
+        counts = jnp.asarray([16, 7, 0, 12], jnp.int32)
+        got = GM.grouped_expert_ffn(x, w1, b1, w2, b2, counts=counts)
+        want = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2, counts)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=3e-2, atol=3e-2)
+
+    def test_groups_replicate_expert_weights(self):
+        """G > E: group g must use expert g // (G // E)'s weights (the
+        all_to_all layout where each expert owns n_shards source chunks)."""
+        rng = np.random.default_rng(4)
+        G, C, d, h, E = 8, 8, 8, 16, 2
+        x = _tokens(rng, G, C, d)
+        w1, b1, w2, b2 = _weights(rng, E, d, h)
+        got = GM.grouped_expert_ffn(x, w1, b1, w2, b2)
+        want = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_masked_reference(self):
+        rng = np.random.default_rng(5)
+        G, C, d, h, E = 4, 16, 8, 16, 4
+        x = _tokens(rng, G, C, d)
+        w1, b1, w2, b2 = _weights(rng, E, d, h)
+        counts = jnp.asarray([16, 3, 0, 10], jnp.int32)
+
+        def loss_k(x, w1, b1, w2, b2):
+            y = GM.grouped_expert_ffn(x, w1, b1, w2, b2, counts=counts)
+            return (y.astype(jnp.float32) ** 2).sum()
+
+        def loss_r(x, w1, b1, w2, b2):
+            y = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2, counts)
+            return (y.astype(jnp.float32) ** 2).sum()
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(x, w1, b1, w2, b2)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(x, w1, b1, w2, b2)
+        for name, a, b in zip("x w1 b1 w2 b2".split(), gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-5, atol=5e-5,
+                                       err_msg=name)
+
+    def test_jit_and_counter(self):
+        rng = np.random.default_rng(6)
+        x = _tokens(rng, 4, 16, 8)
+        w1, b1, w2, b2 = _weights(rng, 4, 8, 16)
+        got = jax.jit(lambda *a: GM.grouped_expert_ffn(*a))(
+            x, w1, b1, w2, b2)
+        want = GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        from paddle_tpu.observability import default_registry
+        c = default_registry().counter(
+            "paddle_tpu_grouped_moe_path_total",
+            "grouped expert-FFN implementation chosen at trace time",
+            labelnames=("path",))
+        before = c.labels(path="grouped").value()
+        GM.record_path("grouped")
+        assert c.labels(path="grouped").value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# knob routing: off restores the dense einsum jaxpr exactly
+# ---------------------------------------------------------------------------
+
+
+def _moe_layer(d=8, E=4, seed=0):
+    pp.seed(seed)
+    return dist.MoELayer(d_model=d, num_experts=E, d_hidden=16,
+                         capacity_factor=2.0)
+
+
+class TestKnobRouting:
+    def _layer_jaxpr(self, monkeypatch, knob, dispatch_mode="einsum"):
+        from paddle_tpu.core.functional import functional_call, params_of
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", knob)
+        moe = _moe_layer()
+        moe.dispatch_mode = dispatch_mode
+        p = params_of(moe)
+        x = jnp.zeros((2, 8, 8), jnp.float32)
+
+        def f(p, x):    # fresh closure: make_jaxpr caches by identity
+            return unwrap(functional_call(moe, p, pp.Tensor(x)))
+
+        return str(jax.make_jaxpr(f)(p, x))
+
+    @pytest.mark.parametrize("mode", ["einsum", "index"])
+    def test_knob_off_restores_previous_jaxpr(self, monkeypatch, mode):
+        """Acceptance: PADDLE_TPU_GROUPED_MOE unset/0 keeps the exact
+        dense-einsum lowering — no pallas_call, byte-identical jaxpr
+        before and after a knob-on trace; =1 routes one pallas_call."""
+        j_base = self._layer_jaxpr(monkeypatch, "0", mode)
+        j_on = self._layer_jaxpr(monkeypatch, "1", mode)
+        j_off = self._layer_jaxpr(monkeypatch, "0", mode)
+        assert "pallas_call" not in j_base
+        assert "pallas_call" in j_on
+        assert j_base == j_off
+
+    @pytest.mark.parametrize("mode", ["einsum", "index"])
+    def test_knob_on_parity(self, monkeypatch, mode):
+        rng = np.random.default_rng(7)
+        moe = _moe_layer()
+        moe.dispatch_mode = mode
+        x = pp.Tensor(jnp.asarray(
+            rng.standard_normal((2, 8, 8)), jnp.float32))
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", "0")
+        off = moe(x).numpy()
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", "1")
+        on = moe(x).numpy()
+        np.testing.assert_allclose(on, off, rtol=2e-5, atol=2e-5)
+
+    def test_ineligible_shape_falls_back(self, monkeypatch):
+        """G not divisible by E never reaches the kernel even knob-on."""
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", "1")
+        assert not GM.grouped_ffn_eligible(3, 16, 8, 16, 2)
+        assert GM.grouped_ffn_eligible(4, 16, 8, 16, 2)
+
+    @pytest.mark.slow  # 8-way a2a traces x2; CI MoE gate runs it
+    @pytest.mark.parametrize("mode", ["all_to_all", "all_to_all_index"])
+    def test_knob_on_parity_a2a(self, monkeypatch, mode):
+        pp.seed(8)
+        d, E = 8, 8
+        mesh = Mesh(np.array(jax.devices()[:8]), ("ep",))
+        moe = dist.MoELayer(d_model=d, num_experts=E, d_hidden=16,
+                            dispatch_mode=mode, mesh=mesh, dropless=True)
+        x = pp.randn([2, 8, d])
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", "0")
+        off = moe(x).numpy()
+        monkeypatch.setenv("PADDLE_TPU_GROUPED_MOE", "1")
+        on = moe(x).numpy()
+        np.testing.assert_allclose(on, off, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# static verification + autotune plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestStaticAndAutotune:
+    def test_verify_static_clean_at_bench_shapes(self):
+        from paddle_tpu.analysis import kernel_verify as kv
+        for g, c, d, h, dtype in at.SWEEP_SHAPES["grouped_matmul"]:
+            diags = GM.verify_static(g, c, d, h, dtype=dtype)
+            assert kv.verdict_of(diags) == "ok", (
+                (g, c, d, h), [d_.message for d_ in diags])
+
+    def test_catalog_includes_grouped_rows(self):
+        from paddle_tpu.analysis import kernel_verify as kv
+        rows = [r for r in kv.catalog_report()
+                if r["kernel"] == "grouped_matmul"]
+        assert len(rows) >= 2
+        for r in rows:
+            assert r["verdict"] == "OK", r
+
+    def test_candidates_prune_clean(self):
+        g, c, d, h, dtype = at.SWEEP_SHAPES["grouped_matmul"][0]
+        cands = at._grouped_candidates(g, c, d, h, dtype)
+        assert cands
+        kept, npruned = at._verify_prune(
+            "grouped_matmul", (g, c, d, h, dtype), cands)
+        assert npruned == 0         # every enumerated candidate is legal
+        assert list(kept) == list(cands)
+        for bc, bf in cands:
+            assert c % bc == 0 and h % bf == 0
+
+    def test_key_distinguishes_shapes_and_backend(self):
+        k1 = at.grouped_key(8, 2560, 1024, 3584, "bfloat16",
+                            interpret=True)
+        k2 = at.grouped_key(8, 1280, 1024, 3584, "bfloat16",
+                            interpret=True)
+        assert k1 != k2 and "grouped" not in k1  # op name lives in _put
+        assert k1.endswith("@" + at.backend_tag(interpret=True))
+
+    def test_dry_sweep_persists_winner(self, monkeypatch, tmp_path):
+        path = tmp_path / "autotune.json"
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(path))
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_SEED", "0")
+        at.reload()
+        try:
+            rc = at.main(["--sweep", "--dry-run", "--cache", str(path),
+                          "--ops", "grouped_matmul"])
+            assert rc == 0
+            at.reload()
+            entries = at.cached_entries()
+            mine = {k: v for k, v in entries.items()
+                    if k.startswith("grouped_matmul|")}
+            assert len(mine) == len(at.SWEEP_SHAPES["grouped_matmul"])
+            for val in mine.values():
+                bc, bf = tuple(val)
+                assert bc > 0 and bf > 0
+        finally:
+            monkeypatch.delenv("PADDLE_TPU_AUTOTUNE_CACHE")
+            monkeypatch.delenv("PADDLE_TPU_AUTOTUNE_SEED")
+            at.reload()
+
+
+# ---------------------------------------------------------------------------
+# cost model: < 0.5x dense-einsum bytes at the bench shape
+# ---------------------------------------------------------------------------
+
+
+class TestCostModelBytes:
+    def _cost(self, fn, *args):
+        from paddle_tpu.analysis import check
+        rep = check(fn, *args, passes=["cost-model"])
+        return rep.extras["cost"]
+
+    def test_grouped_under_half_dense_bytes(self):
+        """Acceptance: at the bench shape the grouped kernel's cost-model
+        HBM bytes are < 0.5x the dense einsum pair — the [G, C, h] hidden
+        intermediate never touches HBM."""
+        g, c, d, h, dtype = at.SWEEP_SHAPES["grouped_matmul"][0]
+        x = jnp.zeros((g, c, d), jnp.bfloat16)
+        w1 = jnp.zeros((g, d, h), jnp.bfloat16)
+        b1 = jnp.zeros((g, h), jnp.bfloat16)
+        w2 = jnp.zeros((g, h, d), jnp.bfloat16)
+        b2 = jnp.zeros((g, d), jnp.bfloat16)
+
+        def grouped(x, w1, b1, w2, b2):
+            return GM.grouped_expert_ffn(x, w1, b1, w2, b2)
+
+        def dense(x, w1, b1, w2, b2):
+            return GM.grouped_expert_ffn_reference(x, w1, b1, w2, b2)
+
+        cg = self._cost(grouped, x, w1, b1, w2, b2)
+        cd = self._cost(dense, x, w1, b1, w2, b2)
+        assert cg.total_bytes < 0.5 * cd.total_bytes, \
+            (cg.total_bytes, cd.total_bytes)

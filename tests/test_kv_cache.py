@@ -366,6 +366,7 @@ class TestPagedEngineParity:
         assert results[r0][1] == ref[:4]
         assert len(results[r1][1]) == 3
 
+    @pytest.mark.slow  # two-engine replay compile; CI serving gate runs it
     def test_prefix_reuse_skips_prefill_and_matches(self, tiny_model):
         from paddle_tpu.observability import default_registry
         rng = np.random.default_rng(16)
@@ -449,6 +450,7 @@ class TestSpeculativeDecoding:
         assert list(draft) == [3, 9, 1]     # continuation after [1, 2]
         assert _ngram_propose(np.array([1, 2, 3]), 3) is None
 
+    @pytest.mark.slow  # spec-decode verify compile; CI serving gate runs it
     def test_spec_parity_and_accept_rate(self, tiny_model):
         rng = np.random.default_rng(20)
         base = np.tile(rng.integers(0, 256, (6,)), 5)   # repetitive

@@ -1,0 +1,172 @@
+"""MoE routing observability + chaos drills (ISSUE 18 satellites).
+
+The router metrics record on eager forwards only (jitted programs stay
+byte-identical to the uninstrumented trace), the ``moe.expert_imbalance``
+drill must light up the imbalance gauge and the capacity counters, and
+the ``sp.ring_peer`` drill must fail the ring-attention setup loudly —
+nothing cached — and restore on clear."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as pp
+import paddle_tpu.distributed as dist
+from paddle_tpu import robustness
+from paddle_tpu.nn.functional.attention import _sdpa_reference
+from paddle_tpu.observability import default_registry
+from paddle_tpu.robustness import InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    robustness.clear_faults()
+    yield
+    robustness.clear_faults()
+
+
+def _counter_total(name):
+    m = default_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(child.value() for _, child in m.series())
+
+
+def _gauge(name):
+    m = default_registry().get(name)
+    return None if m is None else m.value()
+
+
+def _moe(d=16, E=4, top_k=2, capacity_factor=1.25, gate="gshard",
+         seed=0):
+    pp.seed(seed)
+    return dist.MoELayer(d_model=d, num_experts=E, d_hidden=32,
+                         gate=gate, top_k=top_k,
+                         capacity_factor=capacity_factor)
+
+
+def _x(b=4, s=32, d=16, seed=1):
+    rng = np.random.default_rng(seed)
+    return pp.Tensor(jnp.asarray(
+        rng.standard_normal((b, s, d)), jnp.float32))
+
+
+class TestRouterMetrics:
+    def test_eager_forward_records_gauges_and_drops(self):
+        """A capacity-squeezed eager forward sets the aux-loss /
+        load / imbalance gauges and ticks the dropped-token and
+        overflow counters."""
+        moe = _moe(capacity_factor=0.25)    # capacity 16 << 256 slots
+        dropped0 = _counter_total("paddle_tpu_moe_dropped_tokens_total")
+        overflow0 = _counter_total(
+            "paddle_tpu_moe_capacity_overflow_total")
+        moe(_x())
+        assert _counter_total(
+            "paddle_tpu_moe_dropped_tokens_total") > dropped0
+        assert _counter_total(
+            "paddle_tpu_moe_capacity_overflow_total") == overflow0 + 1
+        aux = _gauge("paddle_tpu_moe_aux_loss")
+        assert aux is not None and np.isfinite(aux) and aux > 0
+        imb = _gauge("paddle_tpu_moe_expert_imbalance")
+        assert imb is not None and imb >= 1.0
+        load = default_registry().get("paddle_tpu_moe_expert_load")
+        assert load is not None
+        experts_seen = {vals[0] for vals, _ in load.series()}
+        assert {"0", "1", "2", "3"} <= experts_seen
+
+    def test_jitted_forward_skips_recording(self):
+        """Under jit the router stats are tracers: the tracer guard must
+        skip recording so the traced program stays identical to the
+        uninstrumented one (knob-off jaxpr acceptance depends on it)."""
+        from paddle_tpu.core.dispatch import unwrap
+        from paddle_tpu.core.functional import functional_call, params_of
+        moe = _moe(capacity_factor=0.25, seed=3)
+        p = params_of(moe)
+        x = _x(seed=4)
+
+        @jax.jit
+        def f(p, xv):
+            return unwrap(functional_call(moe, p, pp.Tensor(xv)))
+
+        before = _counter_total(
+            "paddle_tpu_moe_capacity_overflow_total")
+        f(p, unwrap(x)).block_until_ready()
+        assert _counter_total(
+            "paddle_tpu_moe_capacity_overflow_total") == before
+
+
+class TestExpertImbalanceDrill:
+    def test_drill_spikes_imbalance_and_clears(self):
+        """``moe.expert_imbalance`` (bool-style) skews every token onto
+        expert 0: the imbalance gauge must spike to ~E, the fault
+        registry must record the fires, and clearing the fault restores
+        balanced routing."""
+        moe = _moe(top_k=1, gate="naive", capacity_factor=4.0, seed=5)
+        moe(_x(seed=6))
+        clean = _gauge("paddle_tpu_moe_expert_imbalance")
+        assert clean is not None
+
+        robustness.inject("moe.expert_imbalance")
+        moe(_x(seed=6))
+        assert robustness.fault_stats(
+            "moe.expert_imbalance")["fires"] >= 1
+        drilled = _gauge("paddle_tpu_moe_expert_imbalance")
+        # every token's top-1 is expert 0 -> load [T,0,0,0], max/mean=E
+        assert drilled == pytest.approx(moe.num_experts, rel=1e-6)
+        assert drilled > clean
+
+        robustness.clear_faults("moe.expert_imbalance")
+        moe(_x(seed=6))
+        assert _gauge("paddle_tpu_moe_expert_imbalance") == \
+            pytest.approx(clean, rel=1e-6)
+
+    def test_drill_ticks_injection_counter(self):
+        before = _counter_total("paddle_tpu_fault_injections_total")
+        robustness.inject("moe.expert_imbalance", times=1)
+        _moe(seed=7)(_x(seed=8))
+        assert _counter_total(
+            "paddle_tpu_fault_injections_total") == before + 1
+
+
+class TestRingPeerDrill:
+    """``sp.ring_peer`` fires at ring setup, before the hop scan is
+    traced: the trace fails loudly with InjectedFault (nothing cached,
+    no silent wrong answer) and clearing the fault restores the path."""
+
+    def _qkv(self, s=64):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        shape = (2, s, 4, 16)
+        return tuple(jax.random.normal(k, shape, jnp.float32) * 0.5
+                     for k in ks)
+
+    def test_dense_ring_drill(self):
+        q, k, v = self._qkv()
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        ring = dist.make_ring_attention(mesh, causal=True, impl="dense")
+        robustness.inject("sp.ring_peer")
+        with pytest.raises(InjectedFault):
+            jax.jit(ring)(q, k, v)
+        assert robustness.fault_stats("sp.ring_peer")["fires"] >= 1
+
+        robustness.clear_faults("sp.ring_peer")
+        got = jax.jit(ring)(q, k, v)
+        want = _sdpa_reference(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.slow  # flash compile x2 on 4-way mesh; CI gate runs it
+    def test_flash_ring_drill(self):
+        q, k, v = self._qkv(s=128)
+        mesh = Mesh(np.array(jax.devices()[:4]), ("sp",))
+        ring = dist.make_ring_attention(mesh, causal=True, impl="flash")
+        robustness.inject("sp.ring_peer")
+        with pytest.raises(InjectedFault):
+            jax.jit(ring)(q, k, v)
+
+        robustness.clear_faults("sp.ring_peer")
+        got = jax.jit(ring)(q, k, v)
+        want = _sdpa_reference(q, k, v, is_causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

@@ -688,3 +688,109 @@ class TestElasticPeerRecovery:
                 state["w"], np.full((4,), 6.0, np.float32))
         finally:
             mgr.close()
+
+
+def _moe_ring_state(seed=0, E=4, d=8, h=16, b=2, s=64, sp=4, heads=4,
+                    dhead=8):
+    """An ISSUE-18 shaped train state: stacked [E, ...] expert slabs
+    (bf16 params + f32 optimizer moments, the MP layout AdamW keeps)
+    plus per-device ring-attention activations (seq-sharded KV and the
+    running log-sum-exp of the flash fold)."""
+    import ml_dtypes
+    rng = np.random.default_rng(seed)
+
+    def f32(*shape):
+        return rng.standard_normal(shape).astype(np.float32)
+
+    return {
+        "params": {
+            "experts": {
+                "w1": f32(E, d, h).astype(ml_dtypes.bfloat16),
+                "b1": f32(E, h).astype(ml_dtypes.bfloat16),
+                "w2": f32(E, h, d).astype(ml_dtypes.bfloat16),
+                "b2": f32(E, d).astype(ml_dtypes.bfloat16),
+            },
+            "gate": {"w": f32(d, E)},
+        },
+        "opt_state": {
+            "experts.w1": {"m": f32(E, d, h), "v": f32(E, d, h)},
+        },
+        "ring": {
+            # one sp-shard of the sequence axis per device
+            "kv_shard": f32(b, s // sp, heads, dhead),
+            "lse": f32(b, heads, s // sp),
+        },
+        "step": 42,
+    }
+
+
+class TestMoERingShapedState:
+    """ISSUE 18 satellite: the recovery wire formats must round-trip
+    the new workloads' state exactly — stacked [E, ...] expert weights
+    (including bf16) and ring-sharded [b, s/sp, h, d] activations."""
+
+    def test_pack_unpack_roundtrip_exact(self):
+        state = _moe_ring_state(seed=11)
+        out, scalars = rec.unpack_state(rec.pack_state(state, step=42,
+                                                       rank=2))
+        assert scalars["step"] == 42 and scalars["rank"] == 2
+        w1 = out["params"]["experts"]["w1"]
+        assert w1.dtype == state["params"]["experts"]["w1"].dtype
+        assert w1.shape == (4, 8, 16)
+        assert w1.tobytes() == \
+            state["params"]["experts"]["w1"].tobytes()
+        np.testing.assert_array_equal(
+            out["ring"]["kv_shard"], state["ring"]["kv_shard"])
+        np.testing.assert_array_equal(
+            out["opt_state"]["experts.w1"]["v"],
+            state["opt_state"]["experts.w1"]["v"])
+
+    def test_checkpoint_flatten_roundtrip(self):
+        state = _moe_ring_state(seed=12)
+        flat = rec.flatten_for_checkpoint(state)
+        assert "__tree__" in flat
+        out = rec.unflatten_from_checkpoint(flat)
+        assert out["step"] == 42
+        assert out["params"]["experts"]["w2"].tobytes() == \
+            state["params"]["experts"]["w2"].tobytes()
+        np.testing.assert_array_equal(out["ring"]["lse"],
+                                      state["ring"]["lse"])
+
+    def test_digest_catches_flip_in_one_expert_slab(self):
+        params = _moe_ring_state(seed=13)["params"]
+        d0 = rec.params_digest(params)
+        raw = np.asarray(params["experts"]["w1"]).view(np.uint16).copy()
+        # one bf16 mantissa bit, somewhere inside one expert's slab
+        raw.reshape(-1)[raw.size // 2] ^= 1
+        import ml_dtypes
+        flipped = {
+            "experts": dict(params["experts"],
+                            w1=raw.view(ml_dtypes.bfloat16).reshape(
+                                params["experts"]["w1"].shape)),
+            "gate": params["gate"],
+        }
+        assert rec.params_digest(flipped) != d0
+        assert rec.params_digest(params) == d0      # original untouched
+
+    def test_peer_snapshot_roundtrip(self):
+        store = LocalStore()
+        snap = rec.PeerSnapshotter(store, rank=0, world_size=2,
+                                   interval_steps=1)
+        state = _moe_ring_state(seed=14)
+        assert snap.snapshot(42, state)
+        step, out, meta = rec.restore_from_peers(store, 0)
+        assert step == 42 and meta["rank"] == 0
+        assert out["params"]["experts"]["b1"].tobytes() == \
+            state["params"]["experts"]["b1"].tobytes()
+        np.testing.assert_array_equal(
+            out["ring"]["kv_shard"], state["ring"]["kv_shard"])
+
+    def test_sdc_digest_equal_across_replicas(self):
+        """Two bitwise-identical MoE replicas digest equal; a skewed
+        expert slab diverges — the condition the SDC sentinel's
+        cross-replica check keys on."""
+        a = _moe_ring_state(seed=15)["params"]
+        b = _moe_ring_state(seed=15)["params"]
+        assert rec.params_digest(a) == rec.params_digest(b)
+        b["experts"]["w2"] = b["experts"]["w2"] * 2
+        assert rec.params_digest(a) != rec.params_digest(b)

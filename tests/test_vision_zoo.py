@@ -44,6 +44,7 @@ def test_lenet_trains():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow  # deep-stack compile; CI model-zoo gate runs it
 def test_mobilenet_residual_structure():
     m = models.MobileNetV2(scale=0.35, num_classes=2)
     res_blocks = [l for l in m.features
