@@ -159,6 +159,28 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
                 f"fleet.speedup {float(cf['speedup']):.3f} < prev "
                 f"{float(pf['speedup']):.3f} - {tolerance:.0%} "
                 "tolerance")
+    # session survivability (guarded once both artifacts ran
+    # --sessions): the resident-sessions-over-HBM-capacity ratio is
+    # better-higher and must not shrink beyond tolerance, and resumed
+    # sessions must stay token-identical — parking can never trade
+    # capacity for wrong tokens
+    psess, csess = pd.get("sessions") or {}, cd.get("sessions") or {}
+    if psess and csess:
+        pr = psess.get("sessions_resident_ratio")
+        cr = csess.get("sessions_resident_ratio")
+        if pr and cr is not None and \
+                float(cr) < float(pr) * (1.0 - tolerance):
+            regressions.append(
+                f"sessions.sessions_resident_ratio {float(cr):.2f} < "
+                f"prev {float(pr):.2f} - {tolerance:.0%} tolerance")
+        if csess.get("token_identity") is False:
+            regressions.append(
+                "sessions.token_identity is False: a resumed session "
+                "decoded different tokens")
+        if csess.get("recompute_fallback_identity") is False:
+            regressions.append(
+                "sessions.recompute_fallback_identity is False: the "
+                "tier-miss recompute path decoded different tokens")
     regressions += _compare_calibration(cur, prev, tolerance)
     return regressions
 

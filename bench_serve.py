@@ -157,6 +157,130 @@ def _workload(args, vocab):
     return prompts, arrivals
 
 
+def _session_drill(model, args, vocab, qw_mode="0", qkv_mode="0"):
+    """Session-survivability drill (ISSUE 19): far more live sessions
+    than the HBM pool holds, parked through the KV tier manager (host
+    RAM + peer store) and resumed token-identically.
+
+    A deliberately tiny paged pool (sized for ``slots`` concurrent
+    sessions) serves ``--sessions`` logical sessions: each decodes a
+    couple of tokens, parks (KV spilled to the tier), and later
+    resumes (KV promoted back into fresh blocks).  The
+    ``sessions_resident`` trajectory counts parked+active sessions
+    after each park; its peak over the pool's HBM-equivalent session
+    capacity is the survivability headline
+    (``sessions_resident_ratio``).  A no-parking reference engine
+    proves every resumed session's greedy tokens are identical, and
+    one extra session resumes through an injected ``kv_tier.fetch``
+    fault to prove the recompute fallback is token-identical too."""
+    from paddle_tpu.inference.kv_tier import KVTierManager
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.observability.fleet import LocalStore
+    from paddle_tpu.robustness import clear_faults, inject
+
+    n = args.sessions
+    rng = np.random.default_rng(args.seed + 101)
+    Lp, max_new, bs, slots = 24, 8, 8, 2
+    prompts = [rng.integers(0, vocab, (Lp,)).astype(np.int32)
+               for _ in range(n + 1)]          # +1 fault-drill session
+    bps = -(-(Lp + max_new) // bs)             # blocks per session
+    num_blocks = 1 + slots * bps + 2           # ~slots sessions fit
+    kw = dict(slots=slots, max_len=64, prefill_buckets=(32,),
+              paged_kv=True, kv_block_size=bs, prefill_chunk=16,
+              num_kv_blocks=num_blocks,
+              quant_weights=qw_mode, quant_kv=qkv_mode)
+    tier = KVTierManager(store=LocalStore())
+    eng = ContinuousBatchingEngine(model, kv_tier=tier, **kw)
+
+    # reference: identical engine, nothing ever parked (sessions run
+    # one at a time so the tiny pool suffices) — the identity oracle
+    ref_eng = ContinuousBatchingEngine(model, **kw)
+    ref = []
+    for p in prompts:
+        r = ref_eng.add_request(p, max_new_tokens=max_new)
+        ref.append(ref_eng.run()[r][1])
+    ref_eng.close()
+
+    def _out_len(rid):
+        for req in eng._active:
+            if req is not None and req.rid == rid:
+                return len(req.out)
+        return -1
+
+    t0 = time.perf_counter()
+    trajectory, parked = [], []
+    # phase 1 — admit, decode >=2 tokens, park: the resident session
+    # set grows far past what the pool could ever hold
+    for i in range(n):
+        rid = eng.add_request(prompts[i], max_new_tokens=max_new)
+        while _out_len(rid) < 2:
+            eng.step()
+        key = eng.park(rid)
+        assert key is not None, f"park failed for session {i}"
+        parked.append(rid)
+        trajectory.append(
+            len(eng.parked_rids())
+            + sum(1 for q in eng._active if q is not None))
+    resident_peak = max(trajectory) if trajectory else 0
+    # phase 2 — resume everything (tier promote) and decode to the end
+    for rid in parked:
+        eng.resume(rid)
+    done = eng.run()
+    resume_s, parked_s = [], []
+    identity = True
+    for i, rid in enumerate(parked):
+        if list(done[rid][1]) != list(ref[i]):
+            identity = False
+            print(f"SESSION MISMATCH {i}: parked={list(done[rid][1])} "
+                  f"ref={list(ref[i])}", file=sys.stderr)
+        st = eng.request_status(rid)
+        t = st.timings if st is not None else {}
+        resume_s.append(t.get("resume_s", 0.0))
+        parked_s.append(t.get("parked_s", 0.0))
+    # phase 3 — one session resumes through a dropped tier fetch: the
+    # recompute fallback must regenerate the same tokens, never hang
+    rid = eng.add_request(prompts[n], max_new_tokens=max_new)
+    while _out_len(rid) < 2:
+        eng.step()
+    eng.park(rid)
+    inject("kv_tier.fetch", times=1)
+    try:
+        eng.resume(rid)
+        fb = eng.run()[rid][1]
+    finally:
+        clear_faults()
+    recompute_ok = list(fb) == list(ref[n])
+    if not recompute_ok:
+        print(f"RECOMPUTE-FALLBACK MISMATCH: {list(fb)} != "
+              f"{list(ref[n])}", file=sys.stderr)
+    hbm_eq = max(1, (num_blocks - 1) // bps)
+    detail = {
+        "sessions": n,
+        "slots": slots,
+        "kv_blocks_total": num_blocks - 1,
+        "blocks_per_session": bps,
+        "hbm_equivalent_sessions": hbm_eq,
+        "resident_peak": resident_peak,
+        "sessions_resident_ratio": round(resident_peak / hbm_eq, 2),
+        "resident_trajectory": trajectory,
+        "drill_wall_s": round(time.perf_counter() - t0, 4),
+        "cold_resume": {
+            "resume_p50_s": _percentiles(resume_s, ps=(50,))["p50"],
+            "resume_p99_s": _percentiles(resume_s, ps=(99,))["p99"],
+            "parked_p50_s": _percentiles(parked_s, ps=(50,))["p50"],
+        },
+        "token_identity": bool(identity),
+        "recompute_fallback_identity": bool(recompute_ok),
+        "parks": _series("paddle_tpu_serving_session_parks_total"),
+        "resumes": _series("paddle_tpu_serving_session_resumes_total"),
+        "tier_fetch": _series("paddle_tpu_kv_tier_fetch_total"),
+        "tier_spills": _series("paddle_tpu_kv_tier_spills_total"),
+        "tier": tier.stats(),
+    }
+    eng.close()
+    return detail
+
+
 def _run_workload(eng, prompts, arrivals, max_new):
     """Drive the engine under the arrival schedule (wall clock).
     Returns (results {rid: tokens}, rids, t_start, t_end)."""
@@ -251,6 +375,12 @@ def main(argv=None):
     ap.add_argument("--decode-sync", type=int, default=4,
                     help="decode-tier steps_per_sync under "
                          "disaggregation")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="run the session-survivability drill: park N "
+                         "sessions through the KV tier (host+peer), "
+                         "resume them token-identically, and record "
+                         "the sessions_resident trajectory in "
+                         "detail.sessions")
     ap.add_argument("--decode-slots", type=int, default=0,
                     help="decode-tier slot pool size (0 = same as "
                          "--slots; decode holds sequences far longer "
@@ -365,6 +495,25 @@ def main(argv=None):
         serving = base
         serving_eng = eng
 
+    sessions_detail = None
+    if args.sessions:
+        sessions_detail = _session_drill(model, args, cfg.vocab_size,
+                                         qw_mode or "0",
+                                         qkv_mode or "0")
+        print("sessions_resident trajectory (parked+active): "
+              + " ".join(str(v) for v in
+                         sessions_detail["resident_trajectory"]),
+              file=sys.stderr)
+        print(f"sessions_resident "
+              f"peak={sessions_detail['resident_peak']} "
+              f"hbm_equivalent="
+              f"{sessions_detail['hbm_equivalent_sessions']} "
+              f"ratio={sessions_detail['sessions_resident_ratio']} "
+              f"token_identity={sessions_detail['token_identity']} "
+              f"recompute_fallback="
+              f"{sessions_detail['recompute_fallback_identity']}",
+              file=sys.stderr)
+
     results, rids = serving["results"], serving["rids"]
     reused_tokens = serving["reused_tokens"]
     accept_rates = serving["accept_rates"]
@@ -412,6 +561,8 @@ def main(argv=None):
     }
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
+    if sessions_detail is not None:
+        detail["sessions"] = sessions_detail
     # replica cold-start ledger (ROADMAP 5): wall time to acquire every
     # serving executable (trace+compile live, or deserialize on a
     # compile-cache hit), TTFT of the first request after warmup, and

@@ -263,7 +263,8 @@ class PrefixCache:
     without touching blocks any live sequence still reads.
     """
 
-    def __init__(self, block_size: int, allocator: BlockAllocator):
+    def __init__(self, block_size: int, allocator: BlockAllocator,
+                 on_evict=None):
         self.block_size = block_size
         self._alloc = allocator
         self._root = _TrieNode((), -1, None)
@@ -272,12 +273,29 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # demote-before-free: called with the victim node (its token
+        # chain is reachable by walking .parent) right before the block
+        # is freed, so a KV tier manager can spill it to host RAM
+        self.on_evict = on_evict
 
     def __len__(self):
         return len(self._lru)
 
     def _touch(self, node: _TrieNode):
         self._lru.move_to_end(id(node))
+
+    @staticmethod
+    def node_tokens(node: _TrieNode) -> List[int]:
+        """Full token chain (root → node) for a trie node — the lookup
+        key a demoted block must be refiled under in a lower tier."""
+        chunks = []
+        while node is not None and node.key:
+            chunks.append(node.key)
+            node = node.parent
+        out: List[int] = []
+        for key in reversed(chunks):
+            out.extend(int(t) for t in key)
+        return out
 
     def match(self, tokens: np.ndarray) -> List[int]:
         """Physical block ids covering the longest cached full-block
@@ -342,6 +360,12 @@ class PrefixCache:
                     break
             if victim is None:
                 break
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(victim)
+                except Exception:  # noqa: BLE001 — demotion is
+                    # best-effort; eviction must free memory regardless
+                    pass
             self._alloc.free(victim.bid)
             victim.parent.children.pop(victim.key, None)
             del self._lru[id(victim)]

@@ -176,6 +176,7 @@ class _Replica:
         self.assigned: Dict[int, _FleetRequest] = {}
         self.dead = False
         self.draining = False
+        self.ticks = 0                # service polls (ckpt cadence)
 
     @property
     def load(self) -> int:
@@ -218,7 +219,9 @@ class ServingRouter:
                  serialize_handoffs: bool = True,
                  warm_on_spawn: Optional[bool] = None,
                  prefill_steps_per_poll: int = 4,
-                 autoscaler: Optional["SloAutoscaler"] = None):
+                 autoscaler: Optional["SloAutoscaler"] = None,
+                 kv_tier=None,
+                 session_checkpoint_steps: int = 0):
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if not 0 <= prefill_replicas < replicas:
@@ -253,6 +256,20 @@ class ServingRouter:
         self._autoscaler = autoscaler
         if autoscaler is not None:
             autoscaler.bind(self)
+        # session survivability (kv_tier.py): every engine shares this
+        # tier manager; with checkpointing on, in-flight decode sessions
+        # are replicated to the peer tier every N service polls, so a
+        # replica death migrates them to survivors instead of
+        # re-prefilling (see _on_replica_death)
+        self._kv_tier = kv_tier
+        self._ckpt_steps = max(0, int(session_checkpoint_steps))
+        if self._ckpt_steps and kv_tier is None:
+            raise ValueError("session_checkpoint_steps requires "
+                             "kv_tier=")
+        if kv_tier is not None:
+            self._engine_kwargs.setdefault("paged_kv", True)
+            self._engine_kwargs.setdefault("kv_tier", kv_tier)
+        self._parked_sessions: Dict[int, "_FleetRequest"] = {}
 
         self._queue: deque = deque()
         self._requests: Dict[int, _FleetRequest] = {}
@@ -527,8 +544,11 @@ class ServingRouter:
 
     @property
     def pending(self) -> int:
+        # parked sessions are intentionally dormant: they don't hold
+        # slots and only re-enter the pipeline on resume(), so run()
+        # must not spin on them
         return sum(1 for r in self._requests.values()
-                   if r.phase != "done")
+                   if r.phase not in ("done", "parked"))
 
     def finished(self):
         while self._done:
@@ -578,6 +598,14 @@ class ServingRouter:
     # -- scheduling internals ------------------------------------------------
     def _expire(self):
         now = time.perf_counter()
+        # a parked session's deadline keeps ticking: expiry drops its
+        # tier payload and retires it as "timeout"
+        for rid, freq in list(self._parked_sessions.items()):
+            if freq.deadline is not None and now > freq.deadline:
+                del self._parked_sessions[rid]
+                if self._kv_tier is not None:
+                    self._kv_tier.discard(f"sess/{rid}")
+                self._finalize(freq, [], "timeout")
         if not self._queue:
             return
         keep = deque()
@@ -681,6 +709,19 @@ class ServingRouter:
             self._on_replica_death(
                 rep, reason=f"{type(e).__name__}: {str(e)[:120]}")
             return
+        rep.ticks += 1
+        if self._ckpt_steps and rep.assigned \
+                and rep.ticks % self._ckpt_steps == 0:
+            # replicate in-flight decode sessions to the peer tier under
+            # their FLEET rid — the key a survivor will fetch them by
+            try:
+                rep.engine.checkpoint_sessions(
+                    key_of=lambda erid, rep=rep: (
+                        f"sess/{rep.assigned[erid].rid}"
+                        if erid in rep.assigned else None))
+            except Exception:  # noqa: BLE001 — checkpoint is
+                # best-effort; a miss just means fresh prefill on death
+                pass
         for eng_rid, _prompt, out in rep.engine.finished():
             freq = rep.assigned.pop(eng_rid, None)
             if freq is None:
@@ -751,6 +792,38 @@ class ServingRouter:
             else:
                 self._queue.appendleft(freq)
 
+    def _migrate_session(self, rep: _Replica,
+                         freq: "_FleetRequest") -> bool:
+        """Death-recovery session migration: fetch the dead replica's
+        checkpointed session from the KV tier and requeue it as a
+        resume handoff — a survivor imports the blocks and continues
+        decoding, token-identical (greedy chain determinism; a stale
+        checkpoint just replays a few steps).  Returns False on tier
+        miss or an injected ``session.migrate`` fault: the caller then
+        degrades to the fresh-prefill requeue (recompute — slower,
+        never wrong tokens, never a hang)."""
+        if self._kv_tier is None:
+            return False
+        from paddle_tpu.robustness.faults import fault_point
+        try:
+            fault_point("session.migrate", rid=freq.rid, replica=rep.id)
+            payload = self._kv_tier.fetch(f"sess/{freq.rid}")
+        except RuntimeError:
+            self._recorder.record("router.migrate_fault", rid=freq.rid,
+                                  replica=rep.id)
+            return False
+        if payload is None or payload.get("kv") is None:
+            return False
+        freq.handoff = payload
+        freq.phase = "handoff"
+        self._metrics["requeues"].labels(reason="session_migrate").inc()
+        self._recorder.record("router.session_migrate", rid=freq.rid,
+                              from_replica=rep.id,
+                              tokens_out=int(
+                                  len(payload.get("tokens_out", ()))))
+        self._queue.appendleft(freq)
+        return True
+
     def _on_replica_death(self, rep: _Replica, reason: str):
         rep.dead = True
         self._metrics["deaths"].inc()
@@ -759,15 +832,20 @@ class ServingRouter:
                               in_flight=len(rep.assigned))
         for eng_rid, freq in list(rep.assigned.items()):
             freq.attempts += 1
-            freq.phase = "queued"
             freq.handoff = None
             freq.replica = None
             freq.engine_rid = None
-            self._metrics["requeues"].labels(
-                reason="replica_death").inc()
             if freq.attempts > self._max_retries:
+                freq.phase = "queued"
+                self._metrics["requeues"].labels(
+                    reason="replica_death").inc()
                 self._finalize(freq, [], "error")
+            elif self._migrate_session(rep, freq):
+                pass  # requeued as a resume handoff (no recompute)
             else:
+                freq.phase = "queued"
+                self._metrics["requeues"].labels(
+                    reason="replica_death").inc()
                 self._queue.appendleft(freq)
         rep.assigned.clear()
         self._rebuild_ring()
@@ -780,10 +858,66 @@ class ServingRouter:
     def kill_replica(self, replica_id: str, reason: str = "drill"):
         """Declare a replica dead NOW (the replica-kill drill's direct
         entry; the chaos path is the ``serving.replica_kill`` fault
-        point).  In-flight requests re-queue for fresh prefill."""
+        point).  With a KV tier attached, checkpointed in-flight
+        sessions migrate to survivors over the handoff wire (resume,
+        not re-prefill); anything unreplicated re-queues for fresh
+        prefill."""
         rep = self._replicas.get(replica_id)
         if rep is not None and not rep.dead:
             self._on_replica_death(rep, reason=reason)
+
+    # ------------------------------------------------- session surface
+    def park(self, rid: int) -> bool:
+        """Park a decoding session fleet-wide: its owning engine spills
+        the KV to the tier keyed by the FLEET rid and frees the slot;
+        the router keeps resume ownership, so :meth:`resume` may land
+        it on a different replica (migration without a death)."""
+        freq = self._requests.get(rid)
+        if freq is None or freq.phase != "decode" or \
+                self._kv_tier is None:
+            return False
+        rep = self._replicas.get(freq.replica)
+        if rep is None or rep.dead:
+            return False
+        key = rep.engine.park(freq.engine_rid, key=f"sess/{rid}",
+                              detach=True)
+        if key is None:
+            return False
+        rep.assigned.pop(freq.engine_rid, None)
+        freq.engine_rid = None
+        freq.replica = None
+        freq.phase = "parked"
+        self._parked_sessions[rid] = freq
+        self._recorder.record("router.park", rid=rid, replica=rep.id)
+        return True
+
+    def resume(self, rid: int) -> bool:
+        """Resume a fleet-parked session on whichever replica dispatch
+        picks.  Tier hit → resume handoff (promotion); tier miss
+        (fault/lost) → fresh prefill from the original prompt —
+        token-identical either way (greedy chain determinism)."""
+        freq = self._parked_sessions.pop(rid, None)
+        if freq is None or freq.phase != "parked":
+            return False
+        payload = self._kv_tier.fetch(f"sess/{rid}") \
+            if self._kv_tier is not None else None
+        if self._kv_tier is not None:
+            self._kv_tier.discard(f"sess/{rid}")
+        if payload is not None and payload.get("kv") is not None:
+            freq.handoff = payload
+            freq.phase = "handoff"
+        else:
+            freq.handoff = None
+            freq.phase = "queued"
+        self._queue.append(freq)
+        self._recorder.record(
+            "router.resume", rid=rid,
+            path="promote" if freq.handoff is not None else "recompute")
+        return True
+
+    def parked_rids(self):
+        """Fleet rids of sessions parked at the router."""
+        return list(self._parked_sessions.keys())
 
     def _finalize(self, freq: _FleetRequest, out: List[int],
                   status: str, engine_status=None):
@@ -793,6 +927,8 @@ class ServingRouter:
         timings = dict(getattr(engine_status, "timings", None) or {})
         timings.setdefault("route_s", 0.0)
         timings.setdefault("handoff_s", 0.0)
+        timings.setdefault("parked_s", 0.0)
+        timings.setdefault("resume_s", 0.0)
         timings["router_enqueued"] = freq.enqueued_at
         timings["attempts"] = float(freq.attempts)
         trace_id = freq.span.trace_id if freq.span is not None else None

@@ -144,6 +144,15 @@ def _paged_metrics():
             "paddle_tpu_serving_spec_tokens_total",
             "speculative-decoding draft tokens",
             labelnames=("kind",)),
+        "parks": reg.counter(
+            "paddle_tpu_serving_session_parks_total",
+            "sessions demoted out of HBM (slot freed, KV spilled to "
+            "the tier manager)", labelnames=("kind",)),
+        "resumes": reg.counter(
+            "paddle_tpu_serving_session_resumes_total",
+            "parked-session resumes by path: 'promote' re-imported the "
+            "tier payload, 'recompute' re-prefilled after a tier miss",
+            labelnames=("path",)),
     }
 
 
@@ -218,6 +227,16 @@ class _Request:
     router_t0: Optional[float] = None  # router enqueue (end-to-end TTFT)
     route_s: float = 0.0            # router queue -> slot admission
     handoff_s: float = 0.0          # prefill->decode block transfer
+    # session survivability (KV tier): park/resume lifecycle stamps
+    parked_at: float = 0.0          # perf_counter at park (0 = not parked)
+    parked_s: float = 0.0           # cumulative wall time spent parked
+    resume_at: float = 0.0          # perf_counter at resume() call
+    resume_s: float = 0.0           # cumulative resume->decoding latency
+    auto_parked: bool = False       # parked by the scheduler, not caller
+    # recompute fallback bookkeeping: the client-visible prompt and
+    # token budget before the prompt was extended with generated tokens
+    orig_prompt: Optional[np.ndarray] = None
+    orig_max_new: int = 0
 
 
 class RequestStatus(str):
@@ -255,7 +274,10 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
         # engine's admission (it happened on the prefill replica)
         t["prefill_s"] = req.first_token_at - req.admitted_at
     if req.retired_at and req.first_token_at:
-        t["decode_s"] = req.retired_at - req.first_token_at
+        # parked wall time is not decode time; the clamp also keeps a
+        # stale first_token stamp (resumed sessions) from going negative
+        t["decode_s"] = max(
+            0.0, req.retired_at - req.first_token_at - req.parked_s)
     if req.retired_at and req.enqueued_at:
         t["total_s"] = req.retired_at - req.enqueued_at
     # paged-engine evidence: how much prefill the prefix cache skipped,
@@ -271,6 +293,11 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
     # ALWAYS present so TTFT decomposition needs no feature detection
     t["route_s"] = float(req.route_s)
     t["handoff_s"] = float(req.handoff_s)
+    # session survivability evidence: wall time spent parked out of HBM
+    # and the resume->decoding latency (tier promote or recompute) —
+    # 0.0 for never-parked requests, but always present
+    t["parked_s"] = float(req.parked_s)
+    t["resume_s"] = float(req.resume_s)
     return t
 
 
@@ -305,7 +332,9 @@ class ContinuousBatchingEngine:
                  spec_ngram: int = 3,
                  role: str = "mixed",
                  quant_weights: Optional[str] = None,
-                 quant_kv: Optional[str] = None):
+                 quant_kv: Optional[str] = None,
+                 kv_tier=None,
+                 auto_park_s: Optional[float] = None):
         from paddle_tpu.core.functional import functional_call, params_of
         from paddle_tpu.generation import GenerationConfig as _GC
 
@@ -447,6 +476,24 @@ class ContinuousBatchingEngine:
                                  f"max_len), got {prefill_chunk}")
             self._interleave_decode = False
             self._blocks_used_peak = 0
+        # session survivability (kv_tier.py): demoted sessions live in
+        # the tier manager; _parked maps rid -> (request, tier key) for
+        # sessions this engine still owns the resume of
+        self._kv_tier = kv_tier
+        self._auto_park_s = auto_park_s
+        if (kv_tier is not None or auto_park_s is not None) \
+                and not self.paged:
+            raise ValueError(
+                "kv_tier / auto_park_s require the paged KV engine "
+                "(paged_kv=True or PADDLE_TPU_PAGED_KV=1)")
+        if auto_park_s is not None and kv_tier is None:
+            raise ValueError("auto_park_s requires kv_tier=")
+        self._parked: Dict[int, tuple] = {}
+        if self.paged and self._kv_tier is not None \
+                and self._prefix is not None:
+            # demote-before-free: cold prefix blocks spill to the host
+            # tier instead of vanishing; admission promotes them back
+            self._prefix.on_evict = self._demote_prefix_node
         # prefill-only requests park their prompt blocks here at
         # retirement (rid -> (request, SequenceBlocks, first_token));
         # the router exports/discards them (prefill/decode handoff)
@@ -530,6 +577,10 @@ class ContinuousBatchingEngine:
                       "device bytes held by the paged KV pools "
                       "(K/V payload + quant scale arrays)"
                       ).set_function(lambda e=self: e._pool.nbytes)
+            reg.gauge("paddle_tpu_serving_sessions_parked",
+                      "sessions demoted to the KV tier and awaiting "
+                      "resume on this engine").set_function(
+                lambda e=self: len(e._parked))
 
         # serving traces must see eval-mode (dropout off); remembered so
         # close() / context exit can hand the model back for training
@@ -994,7 +1045,12 @@ class ContinuousBatchingEngine:
 
     @property
     def pending(self) -> int:
-        return len(self._queue) + sum(r is not None for r in self._active)
+        # AUTO-parked sessions count: the scheduler owes them a resume,
+        # so run() must keep stepping.  Caller-parked sessions don't —
+        # they are dormant until the caller's resume().
+        return len(self._queue) + sum(r is not None for r in self._active) \
+            + sum(1 for req, _k in self._parked.values()
+                  if req.auto_parked)
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -1007,7 +1063,9 @@ class ContinuousBatchingEngine:
         Lp = len(req.prompt)
         Lb = self._bucket(Lp)
         req.admitted_at = time.perf_counter()
-        if req.router_t0 is not None:
+        if req.router_t0 is not None and not req.parked_s:
+            # once a session has been parked, admission latency is
+            # resume latency (resume_s), not routing latency
             req.route_s = req.admitted_at - req.router_t0
         ids = np.zeros((1, Lb), np.int32)
         ids[0, :Lp] = req.prompt
@@ -1079,6 +1137,12 @@ class ContinuousBatchingEngine:
         m = self._metrics
         if self._prefix is not None:
             matched = self._prefix.match(req.prompt)
+            if self._kv_tier is not None:
+                # promotion fused into admission: extend the matched
+                # chain block-by-block from the tier (host RAM / peer)
+                # — a demoted prefix re-enters HBM exactly like a
+                # handoff import, never via re-prefill
+                matched = self._promote_prefix_tail(req.prompt, matched)
             # only FULL blocks strictly before the last prompt token are
             # adopted: the final token always re-forwards (its logits
             # seed generation) and must land in a private block — shared
@@ -1109,7 +1173,9 @@ class ContinuousBatchingEngine:
         reused = len(reuse_bids) * bs
         req.prefix_reused = reused
         req.admitted_at = time.perf_counter()
-        if req.router_t0 is not None:
+        if req.router_t0 is not None and not req.parked_s:
+            # once a session has been parked, admission latency is
+            # resume latency (resume_s), not routing latency
             req.route_s = req.admitted_at - req.router_t0
         if reused:
             m["prefix_tokens"].inc(reused)
@@ -1135,12 +1201,28 @@ class ContinuousBatchingEngine:
         h = req.handoff
         bs = self._block_size
         Lp = len(req.prompt)
+        # session payloads (park/resume, replica migration) carry the
+        # whole decode state: KV rows 0..pos-1, the generated tokens so
+        # far, and the next decode input — the remaining budget is what
+        # the payload hasn't emitted yet
+        session = bool(h.get("session"))
+        if session:
+            out_prev = [int(t) for t in
+                        np.asarray(h["tokens_out"]).reshape(-1)]
+            covered = int(h["pos"])
+            remaining = req.max_new_tokens - len(out_prev)
+        else:
+            out_prev = [int(h["first_token"])]
+            covered = Lp
+            remaining = req.max_new_tokens - 1
+        # span sizing mirrors fresh admission with the emitted prefix
+        # already paid for: entry budget + the step the entry token took
         if self.spec_tokens:
-            gen_span = req.max_new_tokens + self.spec_tokens
+            gen_span = max(0, remaining) + 1 + self.spec_tokens
         else:
             K = self.steps_per_sync
-            gen_span = -(-req.max_new_tokens // K) * K
-        total = Lp + gen_span
+            gen_span = -(-max(1, remaining + 1) // K) * K
+        total = covered + gen_span
         m = self._metrics
         reuse_bids: List[int] = []
         if self._prefix is not None:
@@ -1165,7 +1247,7 @@ class ContinuousBatchingEngine:
         seq = SequenceBlocks(self._allocator, bs)
         seq.adopt_shared(reuse_bids)
         seq.ensure_capacity(total)
-        nprompt = -(-Lp // bs)       # blocks the payload covers
+        nprompt = -(-covered // bs)  # blocks the payload covers
         t0 = time.perf_counter()
         if nprompt > len(reuse_bids):
             self._pool.import_blocks(
@@ -1173,7 +1255,7 @@ class ContinuousBatchingEngine:
                 src_start=len(reuse_bids))
         req.handoff_s = float(h.get("transfer_s", 0.0)) \
             + (time.perf_counter() - t0)
-        req.route_s = float(h.get("route_s", 0.0))
+        req.route_s = req.route_s or float(h.get("route_s", 0.0))
         self._seq[slot] = seq
         self._bt[slot, :] = 0
         self._bt[slot, :len(seq.bids)] = seq.bids
@@ -1186,27 +1268,37 @@ class ContinuousBatchingEngine:
             # prefilled ones: register them so later affine requests
             # (or handoffs) skip even the copy
             self._prefix.register(req.prompt, seq.bids, limit_tokens=Lp)
-        req.admitted_at = time.perf_counter()
+        now = time.perf_counter()
+        req.admitted_at = req.admitted_at or now
         m["admissions"].inc()
-        first = int(h["first_token"])
         # the first token was produced (and counted: tokens counter,
-        # TTFT observation, slo ttft verdict) on the PREFILL replica —
-        # only the lifecycle stamps carry over
-        req.first_token_at = float(h.get("first_token_at") or
-                                   time.perf_counter())
-        req.out.append(first)
+        # TTFT observation, slo ttft verdict) on the ORIGINATING
+        # replica/session — only the lifecycle stamps carry over, and a
+        # resumed session keeps its original anchor (no TTFT re-anchor)
+        if not req.first_token_at:
+            req.first_token_at = float(h.get("first_token_at") or now)
+        req.out = list(out_prev)
+        if req.resume_at:
+            req.resume_s += now - req.resume_at
+            req.resume_at = 0.0
+        if session:
+            m["resumes"].labels(path="promote").inc()
+            last = int(h["last_token"])
+        else:
+            last = out_prev[-1]
         self._active[slot] = req
-        self._pos[slot] = Lp
-        self._budget[slot] = req.max_new_tokens - 1
-        self._last_tok[slot] = first
+        self._pos[slot] = covered
+        self._budget[slot] = remaining
+        self._last_tok[slot] = last
         self._blocks_used_peak = max(self._blocks_used_peak,
                                      self._allocator.used_blocks)
         self._recorder.record("serving.admit", rid=req.rid, slot=slot,
                               prompt_len=Lp, resume=True,
+                              session=session, pos=covered,
                               prefix_reused=reused,
                               handoff_s=round(req.handoff_s, 6),
                               blocks=len(seq.bids))
-        if (self.eos is not None and first == self.eos) \
+        if (self.eos is not None and last == self.eos) \
                 or self._budget[slot] <= 0:
             self._retire(slot)
         return True
@@ -1242,6 +1334,224 @@ class ContinuousBatchingEngine:
         ent = self._handoff_ready.pop(rid, None)
         if ent is not None:
             ent[1].release()
+
+    # ------------------------------------------------- session tiering
+    def _session_payload(self, slot: int, req: _Request) -> Dict:
+        """Snapshot an active decoding slot as a resumable session
+        payload: KV rows 0..pos-1 plus the host-side decode state.  Pure
+        read — the slot keeps running (checkpoint) or is freed right
+        after (park)."""
+        bs = self._block_size
+        pos = int(self._pos[slot])
+        nkv = -(-pos // bs)
+        seq = self._seq[slot]
+        return {
+            "session": True,
+            "prompt": np.asarray(req.prompt, np.int32),
+            "tokens_out": np.asarray(req.out, np.int32),
+            "pos": int(pos),
+            "last_token": int(self._last_tok[slot]),
+            "block_size": int(bs),
+            "first_token_at": float(req.first_token_at),
+            "route_s": float(req.route_s),
+            "kv": self._pool.export_blocks(seq.bids[:nkv]),
+        }
+
+    def park(self, rid: int, key: Optional[str] = None,
+             detach: bool = False, _auto: bool = False) -> Optional[str]:
+        """Demote an actively decoding session out of HBM: its KV spills
+        to the tier manager, the slot (and its blocks) free, and
+        :meth:`resume` later promotes it back — token-identical, the
+        greedy chain continues from the parked position.  Returns the
+        tier key, or None when the rid is not parkable (unknown,
+        queued, or mid-prefill).  ``detach=True`` hands resume ownership
+        to the caller (the router): the engine forgets the request
+        entirely."""
+        if not self.paged or self._kv_tier is None:
+            raise ValueError("park() requires the paged engine with a "
+                             "kv_tier= manager attached")
+        slot = next((i for i, r in enumerate(self._active)
+                     if r is not None and r.rid == rid), None)
+        if slot is None or slot in self._prefilling:
+            return None
+        req = self._active[slot]
+        key = key or f"rid{rid}"
+        # spill BEFORE the free — demotion, not deletion.  An injected
+        # kv_tier.spill fault degrades to a drop: resume then misses the
+        # tier and falls back to recompute (never a hang, never wrong
+        # tokens — the replayed greedy chain is the same chain)
+        self._kv_tier.spill(key, self._session_payload(slot, req),
+                            kind="session")
+        seq = self._seq[slot]
+        self._active[slot] = None
+        self._seq[slot] = None
+        self._bt[slot, :] = 0
+        seq.release()
+        req.parked_at = time.perf_counter()
+        req.auto_parked = _auto
+        self._metrics["parks"].labels(
+            kind="auto" if _auto else "manual").inc()
+        self._recorder.record("serving.park", rid=rid, slot=slot,
+                              key=key, auto=_auto,
+                              tokens_out=len(req.out))
+        if not detach:
+            self._parked[rid] = (req, key)
+        return key
+
+    def resume(self, rid: int) -> int:
+        """Re-enqueue a parked session.  Tier hit → the payload rides
+        the resume-admission import (a promotion, like a handoff).
+        Tier miss (spill faulted, fetch faulted, entry lost) → the
+        recompute fallback: the prompt is extended with the tokens
+        already emitted and re-prefilled; greedy argmax regenerates the
+        same chain, so the final output is token-identical either way."""
+        ent = self._parked.pop(rid, None)
+        if ent is None:
+            raise KeyError(f"rid {rid} is not parked on this engine")
+        req, key = ent
+        now = time.perf_counter()
+        if req.parked_at:
+            req.parked_s += now - req.parked_at
+            req.parked_at = 0.0
+        req.resume_at = now
+        payload = self._kv_tier.fetch(key) \
+            if self._kv_tier is not None else None
+        self._kv_tier.discard(key)
+        if payload is not None and payload.get("kv") is not None:
+            req.handoff = payload
+            req.mode = "resume"
+        else:
+            self._prepare_recompute(req)
+        self._queue.append(req)
+        self._recorder.record(
+            "serving.resume", rid=rid, key=key,
+            path="promote" if req.handoff is not None else "recompute")
+        return rid
+
+    def _prepare_recompute(self, req: _Request):
+        """Tier-miss fallback: fold the already-emitted tokens into the
+        prompt so a fresh (chunked, prefix-cache-assisted) prefill
+        rebuilds the KV.  The re-prefill's sampled token is the token
+        the session last emitted — greedy argmax over the identical
+        context — so it is re-appended and the output stream is
+        unchanged."""
+        base = req.orig_prompt if req.orig_prompt is not None \
+            else req.prompt
+        if not req.orig_max_new:
+            req.orig_max_new = req.max_new_tokens
+        req.orig_prompt = base
+        g = len(req.out)   # >= 1: parked sessions are post-first-token
+        req.prompt = np.concatenate(
+            [base, np.asarray(req.out[:-1], np.int32)]).astype(np.int32)
+        req.out = req.out[:g - 1]
+        # the re-prefill regenerates token g-1 as its sampled first
+        # token, so the budget regains exactly that one step
+        req.max_new_tokens = req.orig_max_new - (g - 1)
+        req.handoff = None
+        req.mode = "full"
+        self._metrics["resumes"].labels(path="recompute").inc()
+
+    def checkpoint_sessions(self, key_of=None) -> int:
+        """Spill every actively decoding session's current KV + state to
+        the tier WITHOUT disturbing it — the peer-tier replica that
+        makes replica death survivable (the router fetches these for
+        its survivors).  ``key_of(rid)`` maps engine rids to fleet-wide
+        tier keys; None skips a session.  Returns sessions shipped."""
+        if not self.paged or self._kv_tier is None:
+            return 0
+        shipped = 0
+        for slot, req in enumerate(self._active):
+            if req is None or slot in self._prefilling or not req.out:
+                continue
+            key = key_of(req.rid) if key_of is not None else \
+                f"rid{req.rid}"
+            if key is None:
+                continue
+            if self._kv_tier.spill(key, self._session_payload(slot, req),
+                                   kind="session"):
+                shipped += 1
+        return shipped
+
+    def parked_rids(self):
+        """Rids of sessions this engine parked and still owns."""
+        return list(self._parked.keys())
+
+    def _maybe_auto_park(self):
+        """Deadline-aware auto-park: when every slot is busy and work is
+        queued, the active session with the MOST deadline headroom (>=
+        auto_park_s; no deadline = infinitely patient) yields its slot;
+        when slots are free and the queue is empty, the oldest
+        auto-parked session comes back.  Strictly work-conserving:
+        each park admits a queued request, each drain resumes one."""
+        free = any(r is None for r in self._active)
+        if free and not self._queue and self._parked:
+            for rid, (req, _key) in list(self._parked.items()):
+                if req.auto_parked:
+                    self.resume(rid)
+                    return
+            return
+        if not self._queue or free:
+            return
+        now = time.perf_counter()
+        best, best_h = None, float(self._auto_park_s)
+        for i, r in enumerate(self._active):
+            if r is None or i in self._prefilling or not r.out:
+                continue
+            h = (r.deadline - now) if r.deadline is not None \
+                else float("inf")
+            if h >= best_h:
+                best, best_h = r.rid, h
+        if best is not None:
+            self.park(best, _auto=True)
+
+    def _demote_prefix_node(self, node):
+        """PrefixCache.on_evict hook: spill the victim block to the
+        tier under its chain key before the allocator frees it."""
+        from paddle_tpu.inference.kv_tier import prefix_block_key
+        tokens = self._prefix.node_tokens(node)
+        payload = {
+            "prefix": True,
+            "block_size": int(self._block_size),
+            "kv": self._pool.export_blocks([node.bid]),
+        }
+        self._kv_tier.spill(prefix_block_key(tokens), payload,
+                            kind="prefix")
+
+    def _promote_prefix_tail(self, prompt, matched: List[int]
+                             ) -> List[int]:
+        """Extend a prefix-cache match with blocks promoted from the KV
+        tier: fetch chain keys block-by-block past the in-HBM match,
+        import each hit into a fresh block, and hand it to the trie —
+        after this the admission path sees the promoted blocks as
+        ordinary prefix-cache hits."""
+        from paddle_tpu.inference.kv_tier import prefix_block_key
+        bs = self._block_size
+        nfull = (len(prompt) - 1) // bs  # blocks usable for reuse
+        bids = list(matched)
+        while len(bids) < nfull:
+            upto = (len(bids) + 1) * bs
+            payload = self._kv_tier.fetch(
+                prefix_block_key(prompt[:upto]))
+            if payload is None or payload.get("kv") is None:
+                break
+            bid = self._allocator.alloc()
+            if bid is None:
+                break
+            try:
+                self._pool.import_blocks(payload["kv"], [bid])
+            except Exception:  # noqa: BLE001 — geometry/dtype mismatch
+                self._allocator.free(bid)
+                break
+            new = self._prefix.register(
+                np.asarray(prompt[:upto], np.int32), bids + [bid],
+                limit_tokens=upto)
+            # the trie holds its own ref on a newly inserted block;
+            # drop ours either way (new == 0 returns it to the pool)
+            self._allocator.free(bid)
+            if not new:
+                break
+            bids.append(bid)
+        return bids
 
     def _prefill_chunk_step(self, slot: int):
         """Advance `slot`'s prefill by one fixed-width chunk.  The final
@@ -1284,12 +1594,22 @@ class ContinuousBatchingEngine:
             # prompt's full blocks (the trie takes its own ref on each)
             self._prefix.register(req.prompt, self._seq[slot].bids,
                                   limit_tokens=Lp)
-        req.first_token_at = time.perf_counter()
+        now = time.perf_counter()
+        if not req.first_token_at:
+            # a recompute-resumed session keeps its ORIGINAL first-token
+            # stamp: the client saw that token long ago, TTFT must not
+            # re-anchor on the replay
+            req.first_token_at = now
+            origin = req.router_t0 or req.enqueued_at
+            if origin:
+                m["ttft"].observe(now - origin)
+        if req.resume_at:
+            # recompute fallback finished its re-prefill: the session
+            # is decoding again — that replay wall time is resume_s
+            req.resume_s += now - req.resume_at
+            req.resume_at = 0.0
         req.out.append(first)
         m["tokens"].inc()
-        origin = req.router_t0 or req.enqueued_at
-        if origin:
-            m["ttft"].observe(time.perf_counter() - origin)
         if req.mode == "prefill_only":
             # park the prompt blocks for the router's KV transfer: the
             # slot frees NOW (the prefill tier keeps admitting) but the
@@ -1458,6 +1778,11 @@ class ContinuousBatchingEngine:
         fault_point("serving.engine_step",
                     active=sum(r is not None for r in self._active),
                     queued=len(self._queue))
+        if self._auto_park_s is not None:
+            # deadline-aware session scheduling: park the most patient
+            # active session when queued work is slot-starved; bring
+            # auto-parked sessions back once the queue drains
+            self._maybe_auto_park()
         free = [i for i, r in enumerate(self._active) if r is None]
         if free and self._queue:
             if self._admit_paged(free[0], self._queue[0]):
@@ -1508,7 +1833,11 @@ class ContinuousBatchingEngine:
             status, timings=_request_timings(req), trace_id=trace_id)
         while len(self._status) > 8192:   # bounded, like everything else
             self._status.pop(next(iter(self._status)))
-        self._done.append((req.rid, req.prompt, list(req.out)))
+        # a recompute-resumed session folded generated tokens into its
+        # prompt; the client-visible prompt is the original
+        prompt = req.orig_prompt if req.orig_prompt is not None \
+            else req.prompt
+        self._done.append((req.rid, prompt, list(req.out)))
         self._metrics["retirements"].inc()
         self._count_slo(req)
         ev = dict(rid=req.rid, slot=slot, generated=len(req.out),
@@ -1583,6 +1912,21 @@ class ContinuousBatchingEngine:
                     keep.append(req)
             self._queue.clear()
             self._queue.extend(keep)
+        # parked sessions keep their deadline: one that expires in the
+        # tier retires as "timeout" and its payload is dropped
+        for rid, (req, key) in list(self._parked.items()):
+            if req.deadline is not None and now > req.deadline:
+                del self._parked[rid]
+                if req.parked_at:
+                    req.parked_s += now - req.parked_at
+                    req.parked_at = 0.0
+                if self._kv_tier is not None:
+                    self._kv_tier.discard(key)
+                self._metrics["timeouts"].inc()
+                self._recorder.record("serving.timeout", rid=rid,
+                                      slot=None, parked=True,
+                                      generated=len(req.out))
+                self._finish(req, status="timeout")
 
     def _recover(self, exc: BaseException):
         """Engine-step exception containment: fail the in-flight batch
@@ -1610,6 +1954,8 @@ class ContinuousBatchingEngine:
             if self._prefix is not None:
                 self._prefix = PrefixCache(self._block_size,
                                            self._allocator)
+                if self._kv_tier is not None:
+                    self._prefix.on_evict = self._demote_prefix_node
             self._pool.reset()
             self._bt[:] = 0
             self._seq = [None] * self.slots

@@ -671,7 +671,7 @@ class FleetAggregator:
                   f"{'restarts':>8} {'steps':>7} {'step_ms':>8} "
                   f"{'goodput':>8} {'role':>8} {'queue':>6} "
                   f"{'slots':>7} {'slo_ttft':>8} {'slo_tpot':>8} "
-                  f"{'moe_imb':>7}")
+                  f"{'moe_imb':>7} {'kvtier':>7}")
         lines = [header, "-" * len(header)]
         emas: Dict[str, float] = {}
         for host in sorted(self._snapshots):
@@ -696,6 +696,9 @@ class FleetAggregator:
             slots = self._snap_value(snap, "paddle_tpu_serving_slots")
             moe_imb = self._snap_value(snap,
                                        "paddle_tpu_moe_expert_imbalance")
+            # KV blocks demoted below HBM (host RAM + peer store) —
+            # the session-survivability headroom this host carries
+            kvtier = self._snap_value(snap, "paddle_tpu_kv_tier_blocks")
             occupancy = (f"{active:.0f}/{slots:.0f}"
                          if active is not None and slots else "-")
 
@@ -716,7 +719,8 @@ class FleetAggregator:
                 f"{fmt(queue):>6} {occupancy:>7} "
                 f"{fmt(ttft, pct=True):>8} "
                 f"{fmt(tpot, pct=True):>8} "
-                f"{fmt(moe_imb):>7}")
+                f"{fmt(moe_imb):>7} "
+                f"{fmt(kvtier):>7}")
         if emas:
             med = statistics.median(emas.values())
             stragglers = sorted(

@@ -34,7 +34,8 @@ from paddle_tpu.robustness.faults import (  # noqa: F401
 from paddle_tpu.robustness import recovery  # noqa: F401
 from paddle_tpu.robustness.recovery import (  # noqa: F401
     PeerSnapshotter, SDCSentinel, buddy_map, buddy_of,
-    deterministic_replay, is_quarantined, params_digest, quarantine_host,
+    deterministic_replay, is_quarantined, params_digest,
+    probe_quarantine, quarantine_host, quarantine_ttl_s,
     quarantined_hosts, restore_from_peers, resume_train_state)
 
 __all__ = [
@@ -43,6 +44,6 @@ __all__ = [
     "fault_registry", "fault_stats", "inject", "reset_registry",
     "recovery", "PeerSnapshotter", "SDCSentinel", "buddy_map", "buddy_of",
     "deterministic_replay", "is_quarantined", "params_digest",
-    "quarantine_host", "quarantined_hosts", "restore_from_peers",
-    "resume_train_state",
+    "probe_quarantine", "quarantine_host", "quarantine_ttl_s",
+    "quarantined_hosts", "restore_from_peers", "resume_train_state",
 ]

@@ -92,6 +92,21 @@ point                                 site
                                       fails loudly, nothing is cached,
                                       and clearing the fault restores
                                       the path)
+``kv_tier.spill``                     drops a KV demotion inside
+                                      ``KVTierManager.spill`` (the
+                                      eviction/park still frees HBM; the
+                                      later fetch misses and the session
+                                      recomputes — degraded latency,
+                                      never wrong tokens)
+``kv_tier.fetch``                     turns a KV tier fetch into a miss
+                                      (promotion/resume falls back to
+                                      recompute prefill; the greedy
+                                      chain replays token-identically)
+``session.migrate``                   fails the router's death-recovery
+                                      session fetch (the in-flight
+                                      request degrades to the pre-tier
+                                      path: fresh prefill on a
+                                      survivor)
 ====================================  =====================================
 
 Env syntax (comma-separated specs, colon-separated options)::

@@ -733,8 +733,34 @@ def quarantine_host(store, host: str, reason: str = "sdc"):
                              reason=reason)
 
 
+def quarantine_ttl_s() -> Optional[float]:
+    """Probation window from ``PADDLE_TPU_QUARANTINE_TTL_S``: a
+    quarantined host older than this reads as re-admitted.  Unset,
+    empty, or <= 0 means no expiry (the pre-TTL behavior:
+    :func:`clear_quarantine` is the only way back in)."""
+    raw = os.environ.get("PADDLE_TPU_QUARANTINE_TTL_S", "").strip()
+    try:
+        ttl = float(raw)
+    except ValueError:
+        return None
+    return ttl if ttl > 0 else None
+
+
+def _quarantine_expired(rec: dict, now: Optional[float] = None) -> bool:
+    ttl = quarantine_ttl_s()
+    if ttl is None:
+        return False
+    stamp = rec.get("time")
+    if not isinstance(stamp, (int, float)):
+        # a record without a timestamp can't age out — fail closed
+        return False
+    return (now if now is not None else time.time()) - stamp > ttl
+
+
 def quarantined_hosts(store) -> Dict[str, dict]:
-    """host -> {reason, time} for every quarantined host."""
+    """host -> {reason, time} for every host still serving its
+    quarantine.  With ``PADDLE_TPU_QUARANTINE_TTL_S`` set, entries past
+    the TTL are filtered out — served their probation."""
     try:
         if not store.check(_QUAR_ROSTER):
             return {}
@@ -742,24 +768,63 @@ def quarantined_hosts(store) -> Dict[str, dict]:
                                       wait=False).decode().split(",") if h]
     except Exception:
         return {}
+    now = time.time()
     out: Dict[str, dict] = {}
     for h in names:
         try:
-            out[h] = json.loads(store.get(f"{_QUAR_ROSTER}/{h}",
-                                          wait=False).decode())
+            rec = json.loads(store.get(f"{_QUAR_ROSTER}/{h}",
+                                       wait=False).decode())
         except Exception:
-            out[h] = {}
+            rec = {}
+        if not _quarantine_expired(rec, now):
+            out[h] = rec
     return out
 
 
 def is_quarantined(store, host: str) -> bool:
+    """Read-only roster check, TTL-aware: an expired entry reads as
+    re-admitted (so an elastic agent's pre-registration probe passes)
+    without mutating the shared roster — :func:`probe_quarantine` is
+    the cleanup path."""
     try:
         if not store.check(_QUAR_ROSTER):
             return False
-        return host in store.get(_QUAR_ROSTER,
-                                 wait=False).decode().split(",")
+        if host not in store.get(_QUAR_ROSTER,
+                                 wait=False).decode().split(","):
+            return False
+        try:
+            rec = json.loads(store.get(f"{_QUAR_ROSTER}/{host}",
+                                       wait=False).decode())
+        except Exception:
+            return True   # roster says quarantined; unreadable record
+            #               can't prove the probation is over
+        return not _quarantine_expired(rec)
     except Exception:
         return False
+
+
+def probe_quarantine(store, host: str) -> bool:
+    """Clean-probe re-admission: returns True when ``host`` may rejoin
+    the fleet, and — when its quarantine has EXPIRED under
+    ``PADDLE_TPU_QUARANTINE_TTL_S`` — rewrites the roster so every
+    later reader agrees.  This closes the loop `quarantine → TTL
+    probation → clean probe → rejoin` without operator involvement;
+    :func:`clear_quarantine` remains the immediate override."""
+    from paddle_tpu.observability import flight_recorder
+    if not is_quarantined(store, host):
+        try:
+            names = store.get(_QUAR_ROSTER, wait=False).decode() \
+                if store.check(_QUAR_ROSTER) else ""
+        except Exception:
+            names = ""
+        if host in names.split(","):
+            # expired entry still on the roster: retire it for good
+            clear_quarantine(store, host)
+            flight_recorder().record("recovery.quarantine_expired",
+                                     host=host,
+                                     ttl_s=quarantine_ttl_s())
+        return True
+    return False
 
 
 def clear_quarantine(store, host: Optional[str] = None):

@@ -445,6 +445,34 @@ class TestQuarantineRoster:
         rec.clear_quarantine(store)
         assert rec.quarantined_hosts(store) == {}
 
+    def test_ttl_probation_and_probe_readmit(self, monkeypatch):
+        """PADDLE_TPU_QUARANTINE_TTL_S (ISSUE 19 satellite): a
+        quarantined host past its TTL reads as re-admitted without an
+        operator's clear_quarantine, and probe_quarantine retires the
+        expired roster entry so every later reader agrees."""
+        store = LocalStore()
+        monkeypatch.delenv("PADDLE_TPU_QUARANTINE_TTL_S",
+                           raising=False)
+        rec.quarantine_host(store, "hostA", reason="sdc@3")
+        assert rec.quarantine_ttl_s() is None
+        assert rec.is_quarantined(store, "hostA")   # no TTL: forever
+        monkeypatch.setenv("PADDLE_TPU_QUARANTINE_TTL_S", "30")
+        assert rec.quarantine_ttl_s() == 30.0
+        assert rec.is_quarantined(store, "hostA")   # still serving
+        monkeypatch.setenv("PADDLE_TPU_QUARANTINE_TTL_S", "0.01")
+        time.sleep(0.05)
+        assert not rec.is_quarantined(store, "hostA")
+        assert "hostA" not in rec.quarantined_hosts(store)
+        # probe: admittable AND the stale roster entry is retired
+        assert rec.probe_quarantine(store, "hostA")
+        monkeypatch.delenv("PADDLE_TPU_QUARANTINE_TTL_S")
+        assert not rec.is_quarantined(store, "hostA")  # gone for good
+        assert rec.probe_quarantine(store, "neverQuarantined")
+        # invalid / non-positive TTLs read as "no expiry"
+        for bad in ("", "soon", "0", "-5"):
+            monkeypatch.setenv("PADDLE_TPU_QUARANTINE_TTL_S", bad)
+            assert rec.quarantine_ttl_s() is None
+
     def test_quarantined_agent_sits_out(self):
         from paddle_tpu.distributed.elastic import (MultiNodeElasticAgent,
                                                     free_port)
