@@ -181,6 +181,27 @@ def compare_serve_records(cur: dict, prev: dict, tolerance: float = 0.25):
             regressions.append(
                 "sessions.recompute_fallback_identity is False: the "
                 "tier-miss recompute path decoded different tokens")
+    # tail attribution (guarded once both artifacts carry the
+    # forensics section): the dominant overhead cause flipping between
+    # rounds, or the cold-resume share of request overhead growing past
+    # tolerance, means the serving tail changed shape — not just got
+    # uniformly slower — and deserves a named regression
+    pt, ct = pd.get("tail_attribution") or {}, \
+        cd.get("tail_attribution") or {}
+    if pt and ct:
+        pdom, cdom = pt.get("dominant_cause"), ct.get("dominant_cause")
+        if pdom and cdom and pdom != cdom and cdom != "none":
+            regressions.append(
+                f"tail_attribution.dominant_cause flipped "
+                f"{pdom} -> {cdom}")
+        pcold = pt.get("cold_resume_share")
+        ccold = ct.get("cold_resume_share")
+        if ccold is not None and \
+                float(ccold) > float(pcold or 0.0) + tolerance:
+            regressions.append(
+                f"tail_attribution.cold_resume_share "
+                f"{float(ccold):.3f} > prev {float(pcold or 0.0):.3f} "
+                f"+ {tolerance:.2f}")
     regressions += _compare_calibration(cur, prev, tolerance)
     return regressions
 

@@ -100,11 +100,13 @@ def _run_stats(eng, prompts, arrivals, args):
     reused_tokens = 0.0
     accept_rates = []
     route_s, handoff_s = [], []
+    timings = []
     for rid in rids:
         st = eng.request_status(rid)
         out = results.get(rid, [])
         total_tokens += len(out)
         t = st.timings if st is not None else {}
+        timings.append(t)
         if t.get("ttft_s"):
             ttfts.append(t["ttft_s"])
         if t.get("decode_s") and len(out) > 1:
@@ -123,7 +125,8 @@ def _run_stats(eng, prompts, arrivals, args):
             "ttfts": ttfts, "tpots": tpots,
             "reused_tokens": reused_tokens,
             "accept_rates": accept_rates,
-            "route_s": route_s, "handoff_s": handoff_s}
+            "route_s": route_s, "handoff_s": handoff_s,
+            "timings": timings}
 
 
 def _workload(args, vocab):
@@ -559,6 +562,12 @@ def main(argv=None):
         "spec_accept_rate_mean": (float(np.mean(accept_rates))
                                   if accept_rates else None),
     }
+    # per-cause tail attribution (ISSUE 20): fold every request's
+    # timings through the forensics cause decomposition so --compare
+    # can flag a dominant-cause flip or a cold-resume share regression
+    from paddle_tpu.observability import forensics
+    detail["tail_attribution"] = forensics.summarize_attributions(
+        [forensics.attribute(t) for t in serving["timings"]])
     if fleet_detail is not None:
         detail["fleet"] = fleet_detail
     if sessions_detail is not None:

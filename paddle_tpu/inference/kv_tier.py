@@ -76,8 +76,11 @@ class KVTierManager:
 
     def __init__(self, store=None, host_capacity_bytes: Optional[int] = None,
                  prefix: str = "kvtier", chunk_bytes: Optional[int] = None):
+        from paddle_tpu.observability.forensics import emit_decision
         from paddle_tpu.observability.metrics import default_registry
         from paddle_tpu.robustness.recovery import DEFAULT_CHUNK_BYTES
+        # tier decision provenance (forensics): ring-only, no wire
+        self._emit_decision = emit_decision
         self.store = store
         self.prefix = prefix
         self.host_capacity_bytes = host_capacity_bytes
@@ -88,6 +91,10 @@ class KVTierManager:
         # local view of what we shipped to the peer store: key -> meta
         self._peer: Dict[str, dict] = {}
         self._peer_bytes = 0
+        # keys whose fetch-miss decision was already emitted: admission
+        # probes re-fetch the same absent key every engine step, and one
+        # cold key must not flood the bounded flight-recorder ring
+        self._miss_emitted: set = set()
         reg = default_registry()
         self._g_entries = reg.gauge(
             "paddle_tpu_kv_tier_entries",
@@ -153,6 +160,9 @@ class KVTierManager:
             self._c_spill.labels(tier="host", result="fault").inc()
             flight_recorder().record("kv_tier.spill_fault", key=key,
                                      payload_kind=kind)
+            self._emit_decision("tier", op="spill", chosen="drop",
+                                key=key, payload_kind=kind,
+                                result="fault")
             return False
         blob = serialize_handoff(payload)
         meta = {"kind": kind, "blocks": self._payload_blocks(payload),
@@ -182,6 +192,11 @@ class KVTierManager:
                 flight_recorder().record("kv_tier.peer_spill_failed",
                                          key=key, error=type(e).__name__)
         self._refresh_gauges()
+        self._miss_emitted.discard(key)
+        self._emit_decision(
+            "tier", op="spill",
+            chosen="host+peer" if key in self._peer else "host",
+            key=key, payload_kind=kind, bytes=len(blob), result="ok")
         return True
 
     # ------------------------------------------------------------ fetch
@@ -197,6 +212,10 @@ class KVTierManager:
         except RuntimeError:
             self._c_fetch.labels(tier="host", result="fault").inc()
             flight_recorder().record("kv_tier.fetch_fault", key=key)
+            if key not in self._miss_emitted:
+                self._miss_emitted.add(key)
+                self._emit_decision("tier", op="fetch", chosen="miss",
+                                    key=key, result="fault")
             return None
         t0 = time.perf_counter()
         ent = self._host.get(key)
@@ -205,6 +224,9 @@ class KVTierManager:
             self._c_fetch.labels(tier="host", result="hit").inc()
             out = deserialize_handoff(ent[0])
             self._h_promote.observe(time.perf_counter() - t0)
+            self._miss_emitted.discard(key)
+            self._emit_decision("tier", op="fetch", chosen="host",
+                                key=key, result="hit")
             return out
         self._c_fetch.labels(tier="host", result="miss").inc()
         if self.store is not None:
@@ -220,8 +242,15 @@ class KVTierManager:
                 self._refresh_gauges()
                 out = deserialize_handoff(bytes(blob))
                 self._h_promote.observe(time.perf_counter() - t0)
+                self._miss_emitted.discard(key)
+                self._emit_decision("tier", op="fetch", chosen="peer",
+                                    key=key, result="hit")
                 return out
             self._c_fetch.labels(tier="peer", result="miss").inc()
+        if key not in self._miss_emitted:
+            self._miss_emitted.add(key)
+            self._emit_decision("tier", op="fetch", chosen="miss",
+                                key=key, result="miss")
         return None
 
     # ---------------------------------------------------- housekeeping

@@ -164,6 +164,7 @@ class _FleetRequest:
     engine_rid: Optional[int] = None
     handoff: Optional[dict] = None    # pending resume payload
     result: List[int] = field(default_factory=list)
+    dispatched_at: float = 0.0        # last dispatch (requeue forensics)
 
 
 class _Replica:
@@ -287,8 +288,11 @@ class ServingRouter:
         from paddle_tpu.observability import default_registry, \
             flight_recorder
         from paddle_tpu.observability.tracing import tracer
+        from paddle_tpu.observability.forensics import emit_decision
         self._recorder = flight_recorder()
         self._tracer = tracer()
+        # scheduler decision provenance (forensics): ring-only, no wire
+        self._emit_decision = emit_decision
         reg = default_registry()
         reg.gauge("paddle_tpu_router_queue_depth",
                   "requests waiting at the router for dispatch"
@@ -659,6 +663,11 @@ class ServingRouter:
                     attempts=freq.attempts)
                 fatal = isinstance(e, ValueError) \
                     or freq.attempts > self._max_retries
+                self._emit_decision(
+                    "requeue", rid=freq.rid,
+                    chosen="abort" if fatal else "requeue",
+                    reason="dispatch_error", replica=target.id,
+                    error=type(e).__name__, attempts=freq.attempts)
                 if fatal:
                     self._finalize(freq, [], "error")
                 else:
@@ -671,11 +680,24 @@ class ServingRouter:
             freq.phase = "decode" if resume or not self.disaggregated \
                 else "prefill"
             freq.handoff = None
+            freq.dispatched_at = time.perf_counter()
             target.assigned[eng_rid] = freq
             if target.decode_capable():
                 self._register_chain(freq.chain, target.id)
             self._metrics["dispatch"].labels(replica=target.id,
                                              kind=kind).inc()
+            # decision provenance: the chosen replica plus every
+            # rejected candidate WITH its load score
+            pool = [r for r in self._replicas.values() if r.live
+                    and (r.prefill_capable() if kind == "prefill"
+                         else r.decode_capable())]
+            self._emit_decision(
+                "route", rid=freq.rid,
+                chosen={"replica": target.id, "load": target.load},
+                alternatives=[{"replica": r.id, "load": r.load}
+                              for r in pool if r.id != target.id],
+                policy=kind, resume=resume, attempts=freq.attempts,
+                queue_depth=len(self._queue))
         self._queue = deferred
 
     def _service(self, rep: _Replica):
@@ -742,6 +764,13 @@ class ServingRouter:
             freq.handoff = None
             freq.replica = None
             self._metrics["requeues"].labels(reason="engine_error").inc()
+            self._emit_decision(
+                "requeue", rid=freq.rid, chosen="requeue",
+                reason="engine_error", replica=rep.id,
+                attempts=freq.attempts,
+                wasted_s=round(max(0.0, time.perf_counter()
+                                   - freq.dispatched_at), 6)
+                if freq.dispatched_at else 0.0)
             self._queue.appendleft(freq)
         else:
             self._finalize(freq, out, status, engine_status=st)
@@ -773,6 +802,9 @@ class ServingRouter:
             freq.replica = None
             self._metrics["handoffs"].labels(result="ok").inc()
             self._metrics["handoff_s"].observe(transfer_s)
+            self._emit_decision("handoff", rid=freq.rid, chosen="ok",
+                                from_replica=rep.id,
+                                transfer_s=round(transfer_s, 6))
             self._queue.appendleft(freq)
         except Exception as e:
             try:
@@ -787,6 +819,10 @@ class ServingRouter:
             self._recorder.record(
                 "router.handoff_failed", rid=freq.rid, replica=rep.id,
                 error=type(e).__name__, attempts=freq.attempts)
+            self._emit_decision(
+                "handoff", rid=freq.rid, chosen="fallback",
+                from_replica=rep.id, error=type(e).__name__,
+                attempts=freq.attempts)
             if freq.attempts > self._max_retries:
                 self._finalize(freq, [], "error")
             else:
@@ -821,6 +857,9 @@ class ServingRouter:
                               from_replica=rep.id,
                               tokens_out=int(
                                   len(payload.get("tokens_out", ()))))
+        self._emit_decision("requeue", rid=freq.rid, chosen="migrate",
+                            reason="session_migrate",
+                            replica=rep.id, attempts=freq.attempts)
         self._queue.appendleft(freq)
         return True
 
@@ -830,15 +869,22 @@ class ServingRouter:
         self._recorder.record("router.replica_death", replica=rep.id,
                               reason=reason,
                               in_flight=len(rep.assigned))
+        now = time.perf_counter()
         for eng_rid, freq in list(rep.assigned.items()):
             freq.attempts += 1
             freq.handoff = None
             freq.replica = None
             freq.engine_rid = None
+            wasted = round(max(0.0, now - freq.dispatched_at), 6) \
+                if freq.dispatched_at else 0.0
             if freq.attempts > self._max_retries:
                 freq.phase = "queued"
                 self._metrics["requeues"].labels(
                     reason="replica_death").inc()
+                self._emit_decision(
+                    "requeue", rid=freq.rid, chosen="abort",
+                    reason="replica_death", replica=rep.id,
+                    attempts=freq.attempts, wasted_s=wasted)
                 self._finalize(freq, [], "error")
             elif self._migrate_session(rep, freq):
                 pass  # requeued as a resume handoff (no recompute)
@@ -846,6 +892,10 @@ class ServingRouter:
                 freq.phase = "queued"
                 self._metrics["requeues"].labels(
                     reason="replica_death").inc()
+                self._emit_decision(
+                    "requeue", rid=freq.rid, chosen="recompute",
+                    reason="replica_death", replica=rep.id,
+                    attempts=freq.attempts, wasted_s=wasted)
                 self._queue.appendleft(freq)
         rep.assigned.clear()
         self._rebuild_ring()
@@ -889,6 +939,8 @@ class ServingRouter:
         freq.phase = "parked"
         self._parked_sessions[rid] = freq
         self._recorder.record("router.park", rid=rid, replica=rep.id)
+        self._emit_decision("park", rid=rid, chosen="park", auto=False,
+                            key=f"sess/{rid}", replica=rep.id)
         return True
 
     def resume(self, rid: int) -> bool:
@@ -910,9 +962,10 @@ class ServingRouter:
             freq.handoff = None
             freq.phase = "queued"
         self._queue.append(freq)
-        self._recorder.record(
-            "router.resume", rid=rid,
-            path="promote" if freq.handoff is not None else "recompute")
+        path = "promote" if freq.handoff is not None else "recompute"
+        self._recorder.record("router.resume", rid=rid, path=path)
+        self._emit_decision("resume", rid=rid, chosen=path, path=path,
+                            key=f"sess/{rid}")
         return True
 
     def parked_rids(self):
@@ -924,11 +977,12 @@ class ServingRouter:
         from paddle_tpu.inference.serving import RequestStatus
         freq.phase = "done"
         freq.result = list(out)
+        from paddle_tpu.inference.serving import TIMING_KEYS
         timings = dict(getattr(engine_status, "timings", None) or {})
-        timings.setdefault("route_s", 0.0)
-        timings.setdefault("handoff_s", 0.0)
-        timings.setdefault("parked_s", 0.0)
-        timings.setdefault("resume_s", 0.0)
+        # canonical schema (ISSUE 20): every engine-level key present,
+        # 0.0 when the request never reached an engine at all
+        for key in TIMING_KEYS:
+            timings.setdefault(key, 0.0)
         timings["router_enqueued"] = freq.enqueued_at
         timings["attempts"] = float(freq.attempts)
         trace_id = freq.span.trace_id if freq.span is not None else None
@@ -941,6 +995,17 @@ class ServingRouter:
         self._recorder.record("router.retire", rid=freq.rid,
                               status=status, generated=len(freq.result),
                               attempts=freq.attempts)
+        # fleet-level retirement decision: authoritative for this rid
+        # (the engine-local retirement is marked routed=True), carrying
+        # the merged timings so a federated explain() needs no local
+        # RequestStatus
+        self._emit_decision(
+            "retire", rid=freq.rid, chosen=status, status=status,
+            source="router", generated=len(freq.result),
+            attempts=freq.attempts, timings=timings)
+        from paddle_tpu.observability.forensics import \
+            observe_retirement
+        observe_retirement(timings)
         if freq.span is not None:
             freq.span.set_attribute("status", status)
             freq.span.set_attribute("generated", len(freq.result))
@@ -1055,6 +1120,10 @@ class SloAutoscaler:
             self._stamp(now, "up")
             router._recorder.record("router.autoscale", direction="up",
                                     replica=rid, detail=detail)
+            router._emit_decision(
+                "autoscale", chosen={"direction": "up", "replica": rid},
+                alternatives=[{"direction": "hold"}], detail=detail,
+                queue=queue, live=len(live))
             return "up"
         idle = (queue == 0
                 and all(r.load <= router._spill_bound(r) // 2
@@ -1067,6 +1136,11 @@ class SloAutoscaler:
                 self._stamp(now, "down")
                 router._recorder.record("router.autoscale",
                                         direction="down", replica=rid)
+                router._emit_decision(
+                    "autoscale",
+                    chosen={"direction": "down", "replica": rid},
+                    alternatives=[{"direction": "hold"}],
+                    queue=queue, live=len(live))
                 return "down"
         return None
 

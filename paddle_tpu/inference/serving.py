@@ -255,9 +255,36 @@ class RequestStatus(str):
         return obj
 
 
+#: Canonical ``RequestStatus.timings`` schema.  Every retirement
+#: carries EVERY key — absolute perf_counter stamps read 0.0 for a
+#: phase never reached and derived durations read 0.0 when not
+#: applicable — so TTFT/TPOT decomposition (forensics ``attribute``)
+#: and clients need no feature detection and no per-layer
+#: ``setdefault`` patches.  New timing fields MUST be added here; the
+#: schema regression test (tests/test_forensics.py) fails otherwise.
+TIMING_KEYS = (
+    "enqueued", "admitted", "first_token", "retired",
+    "queue_s", "ttft_s", "prefill_s", "decode_s", "total_s",
+    "generated", "prefix_tokens_reused", "speculative_accept_rate",
+    "route_s", "handoff_s", "parked_s", "resume_s",
+)
+
+#: Keys layered on by the router's fleet-level retirement — the only
+#: permitted extras beyond :data:`TIMING_KEYS`.
+ROUTER_TIMING_KEYS = ("router_enqueued", "attempts")
+
+#: Re-emit a starving request's "defer" decision every this many
+#: deferred admission attempts.  Each deferred step also records a
+#: kv_alloc_exhausted event (plus fault.injected under chaos), so the
+#: period must satisfy period x churn-per-step < ring capacity (256 x
+#: 2 = 512 < 1024 default) for the latest defer to survive eviction.
+DEFER_EMIT_EVERY = 256
+
+
 def _request_timings(req: "_Request") -> Dict[str, float]:
     """Lifecycle stamps (perf_counter; 0.0 = phase never reached) plus
-    the derived durations clients actually reason about."""
+    the derived durations clients actually reason about.  Always
+    returns exactly the :data:`TIMING_KEYS` schema."""
     t = {"enqueued": req.enqueued_at, "admitted": req.admitted_at,
          "first_token": req.first_token_at, "retired": req.retired_at}
     if req.admitted_at and req.enqueued_at:
@@ -298,6 +325,9 @@ def _request_timings(req: "_Request") -> Dict[str, float]:
     # 0.0 for never-parked requests, but always present
     t["parked_s"] = float(req.parked_s)
     t["resume_s"] = float(req.resume_s)
+    t["generated"] = float(len(req.out))
+    for key in TIMING_KEYS:
+        t.setdefault(key, 0.0)
     return t
 
 
@@ -536,8 +566,19 @@ class ContinuousBatchingEngine:
         from paddle_tpu.observability import default_registry, \
             flight_recorder
         from paddle_tpu.observability.tracing import tracer
+        from paddle_tpu.observability.forensics import emit_decision
         self._recorder = flight_recorder()
         self._tracer = tracer()
+        # scheduler decision provenance (forensics): ring-only, no wire
+        self._emit_decision = emit_decision
+        # rid -> deferred admission attempts this wait. The defer
+        # decision re-emits every _DEFER_EMIT_EVERY attempts: one
+        # starving request must not flood the bounded ring with an
+        # event per step, but each deferred step also records
+        # kv_alloc_exhausted (+ fault.injected when rigged), so a
+        # single emission would be evicted by its own wait's churn —
+        # the period keeps the latest defer inside the ring window.
+        self._defer_attempts: Dict[int, int] = {}
         reg = default_registry()
         reg.gauge("paddle_tpu_serving_queue_depth",
                   "requests waiting for a slot").set_function(
@@ -1163,6 +1204,14 @@ class ContinuousBatchingEngine:
                 "serving.kv_alloc_exhausted", rid=req.rid, need=need,
                 free=self._allocator.free_blocks,
                 injected=bool(exhausted))
+            n = self._defer_attempts.get(req.rid, 0) + 1
+            self._defer_attempts[req.rid] = n
+            if n % DEFER_EMIT_EVERY == 1:
+                self._emit_decision(
+                    "admit", rid=req.rid, chosen="defer",
+                    reason="kv_alloc_exhausted", need=need,
+                    free=self._allocator.free_blocks,
+                    injected=bool(exhausted), attempts=n)
             return False
         seq = SequenceBlocks(self._allocator, bs)
         seq.adopt_shared(reuse_bids)
@@ -1172,7 +1221,10 @@ class ContinuousBatchingEngine:
         self._bt[slot, :len(seq.bids)] = seq.bids
         reused = len(reuse_bids) * bs
         req.prefix_reused = reused
-        req.admitted_at = time.perf_counter()
+        # a recompute-resumed session keeps its ORIGINAL admission
+        # stamp (like _admit_resume): queue_s/prefill_s describe the
+        # first life; the re-admission wait + replay is resume_s
+        req.admitted_at = req.admitted_at or time.perf_counter()
         if req.router_t0 is not None and not req.parked_s:
             # once a session has been parked, admission latency is
             # resume latency (resume_s), not routing latency
@@ -1187,6 +1239,10 @@ class ContinuousBatchingEngine:
         self._recorder.record("serving.admit", rid=req.rid, slot=slot,
                               prompt_len=Lp, prefix_reused=reused,
                               blocks=len(seq.bids))
+        self._defer_attempts.pop(req.rid, None)
+        self._emit_decision("admit", rid=req.rid, chosen="slot",
+                            slot=slot, prefix_reused=reused,
+                            blocks=len(seq.bids))
         return True
 
     def _admit_resume(self, slot: int, req: _Request) -> bool:
@@ -1243,6 +1299,14 @@ class ContinuousBatchingEngine:
                 "serving.kv_alloc_exhausted", rid=req.rid, need=need,
                 free=self._allocator.free_blocks,
                 injected=bool(exhausted))
+            n = self._defer_attempts.get(req.rid, 0) + 1
+            self._defer_attempts[req.rid] = n
+            if n % DEFER_EMIT_EVERY == 1:
+                self._emit_decision(
+                    "admit", rid=req.rid, chosen="defer",
+                    reason="kv_alloc_exhausted", resume=True, need=need,
+                    free=self._allocator.free_blocks,
+                    injected=bool(exhausted), attempts=n)
             return False
         seq = SequenceBlocks(self._allocator, bs)
         seq.adopt_shared(reuse_bids)
@@ -1298,6 +1362,11 @@ class ContinuousBatchingEngine:
                               prefix_reused=reused,
                               handoff_s=round(req.handoff_s, 6),
                               blocks=len(seq.bids))
+        self._defer_attempts.pop(req.rid, None)
+        self._emit_decision("admit", rid=req.rid, chosen="slot",
+                            slot=slot, resume=True, session=session,
+                            pos=covered,
+                            handoff_s=round(req.handoff_s, 6))
         if (self.eos is not None and last == self.eos) \
                 or self._budget[slot] <= 0:
             self._retire(slot)
@@ -1394,6 +1463,12 @@ class ContinuousBatchingEngine:
         self._recorder.record("serving.park", rid=rid, slot=slot,
                               key=key, auto=_auto,
                               tokens_out=len(req.out))
+        if not _auto:
+            # the auto-park decision (victim + rejected candidates'
+            # headroom) is emitted by _maybe_auto_park
+            self._emit_decision("park", rid=rid, chosen="park",
+                                auto=False, key=key,
+                                tokens_out=len(req.out))
         if not detach:
             self._parked[rid] = (req, key)
         return key
@@ -1423,9 +1498,11 @@ class ContinuousBatchingEngine:
         else:
             self._prepare_recompute(req)
         self._queue.append(req)
-        self._recorder.record(
-            "serving.resume", rid=rid, key=key,
-            path="promote" if req.handoff is not None else "recompute")
+        path = "promote" if req.handoff is not None else "recompute"
+        self._recorder.record("serving.resume", rid=rid, key=key,
+                              path=path)
+        self._emit_decision("resume", rid=rid, chosen=path, path=path,
+                            key=key, parked_s=round(req.parked_s, 6))
         return rid
 
     def _prepare_recompute(self, req: _Request):
@@ -1494,14 +1571,25 @@ class ContinuousBatchingEngine:
             return
         now = time.perf_counter()
         best, best_h = None, float(self._auto_park_s)
+        cands = []
         for i, r in enumerate(self._active):
             if r is None or i in self._prefilling or not r.out:
                 continue
             h = (r.deadline - now) if r.deadline is not None \
                 else float("inf")
+            cands.append({"rid": r.rid,
+                          "headroom_s": round(h, 4)
+                          if h != float("inf") else None})
             if h >= best_h:
                 best, best_h = r.rid, h
         if best is not None:
+            self._emit_decision(
+                "park", rid=best, auto=True,
+                chosen={"rid": best,
+                        "headroom_s": round(best_h, 4)
+                        if best_h != float("inf") else None},
+                alternatives=[c for c in cands if c["rid"] != best],
+                queue_depth=len(self._queue))
             self.park(best, _auto=True)
 
     def _demote_prefix_node(self, node):
@@ -1829,8 +1917,9 @@ class ContinuousBatchingEngine:
                 status: str = "ok"):
         req.retired_at = time.perf_counter()
         trace_id = req.span.trace_id if req.span is not None else None
+        timings = _request_timings(req)
         self._status[req.rid] = RequestStatus(
-            status, timings=_request_timings(req), trace_id=trace_id)
+            status, timings=timings, trace_id=trace_id)
         while len(self._status) > 8192:   # bounded, like everything else
             self._status.pop(next(iter(self._status)))
         # a recompute-resumed session folded generated tokens into its
@@ -1845,6 +1934,21 @@ class ContinuousBatchingEngine:
         if trace_id is not None:
             ev["trace_id"] = trace_id
         self._recorder.record("serving.retire", **ev)
+        # the retirement decision carries the full canonical timings —
+        # this is what lets explain()/tail_report() attribute latency
+        # from a federated (cross-process) event stream alone.  Routed
+        # requests are marked so the router's fleet-level retirement
+        # stays authoritative (no double counting in tail windows).
+        self._emit_decision(
+            "retire", rid=req.rid, chosen=status, status=status,
+            source="engine", routed=req.router_t0 is not None,
+            generated=len(req.out), timings=timings)
+        if req.router_t0 is None:
+            # routed requests: the router's retirement (merged fleet
+            # timings) feeds the overage counter instead
+            from paddle_tpu.observability.forensics import \
+                observe_retirement
+            observe_retirement(timings, targets=self._slo_targets)
         if req.span is not None:
             req.span.set_attribute("status", status)
             req.span.set_attribute("generated", len(req.out))
@@ -1899,6 +2003,8 @@ class ContinuousBatchingEngine:
                 self._metrics["timeouts"].inc()
                 self._recorder.record("serving.timeout", rid=req.rid,
                                       slot=slot, generated=len(req.out))
+                self._emit_decision("expire", rid=req.rid,
+                                    chosen="timeout", where="slot")
                 self._retire(slot, status="timeout")
         if self._queue:
             keep = deque()
@@ -1907,6 +2013,9 @@ class ContinuousBatchingEngine:
                     self._metrics["timeouts"].inc()
                     self._recorder.record("serving.timeout", rid=req.rid,
                                           slot=None, generated=0)
+                    self._emit_decision("expire", rid=req.rid,
+                                        chosen="timeout",
+                                        where="queue")
                     self._finish(req, status="timeout")
                 else:
                     keep.append(req)
@@ -1926,6 +2035,8 @@ class ContinuousBatchingEngine:
                 self._recorder.record("serving.timeout", rid=rid,
                                       slot=None, parked=True,
                                       generated=len(req.out))
+                self._emit_decision("expire", rid=rid,
+                                    chosen="timeout", where="parked")
                 self._finish(req, status="timeout")
 
     def _recover(self, exc: BaseException):

@@ -35,6 +35,16 @@ package):
   attribution table** joining measured device time against the static
   cost model (the fusion target list), and an HBM live-buffer census /
   watermark with leak detection.
+* **forensics** — request forensics (ISSUE 20): every scheduler
+  decision in the serving stack (route, admit, park victim, tier
+  spill/fetch, resume path, requeue, autoscale, retire) emits a
+  bounded :class:`DecisionEvent` into the flight-recorder ring and
+  federates over the ``obs/`` store channel like spans;
+  :func:`explain` decomposes one request's TTFT/TPOT into named
+  causes, :func:`tail_report` aggregates a window into per-cause
+  shares, and the ``tail_regression`` watchdog rule alerts with the
+  dominant cause named.  CLI:
+  ``python -m paddle_tpu.observability.forensics``.
 * **calibration** — the measurement ledger (ISSUE 17): a persistent,
   content-addressed corpus of every measured kernel/segment/step time
   (fed by the device profiler, the autotune bench closures, and the
@@ -71,9 +81,15 @@ from paddle_tpu.observability.tracing import (Span, SpanContext, Tracer,
                                               inject_context,
                                               inject_spans, trace_span,
                                               tracer)
-from paddle_tpu.observability.watchdog import (Alert, Watchdog,
-                                               default_rules,
+from paddle_tpu.observability.watchdog import (Alert, TailRegressionRule,
+                                               Watchdog, default_rules,
                                                rules_from_spec)
+from paddle_tpu.observability.forensics import (DecisionEvent, attribute,
+                                                decision_events,
+                                                emit_decision, explain,
+                                                extract_decisions,
+                                                inject_decisions,
+                                                tail_report)
 from paddle_tpu.observability.fleet import (FleetAggregator, LocalStore,
                                             MetricsPublisher,
                                             fleet_host_id,
@@ -102,7 +118,10 @@ __all__ = [
     "Span", "SpanContext", "Tracer", "tracer", "trace_span",
     "inject_context", "extract_context", "inject_spans",
     "extract_spans",
-    "Alert", "Watchdog", "default_rules", "rules_from_spec",
+    "Alert", "TailRegressionRule", "Watchdog", "default_rules",
+    "rules_from_spec",
+    "DecisionEvent", "attribute", "decision_events", "emit_decision",
+    "explain", "extract_decisions", "inject_decisions", "tail_report",
     "FleetAggregator", "LocalStore", "MetricsPublisher",
     "fleet_host_id", "merge_snapshots",
     "GoodputMonitor", "compute_goodput", "goodput_monitor",

@@ -19,6 +19,12 @@ continuous-batching serving loop over a tiny Llama — then:
    time regression, and shows the breach: exactly one ``slo_breach``
    alert event with the flight-recorder + slowest-trace dump.
 
+``--forensics`` appends the request-forensics phase (ISSUE 20): a
+serving drill that exercises every scheduler decision kind (route,
+admit, park, resume, handoff, requeue, tier, autoscale, retire,
+expire), rigs one deliberately slow request, and prints its
+``explain()`` table with the dominant cause named.
+
 Exit code 0 only when every expected artifact is present.
 """
 
@@ -55,6 +61,10 @@ def main(argv=None) -> int:
                     default="/tmp/paddle_tpu_fleet_trace.json",
                     help="merged multi-host Perfetto export path "
                          "(--fleet)")
+    ap.add_argument("--forensics", action="store_true",
+                    help="exercise the request-forensics phase: every "
+                         "decision kind + a rigged slow request's "
+                         "explain() table")
     args = ap.parse_args(argv)
 
     # head-based sampling must be on before the first instrument builds
@@ -256,6 +266,14 @@ def main(argv=None) -> int:
         if rc:
             return rc
 
+    # -- request forensics (ISSUE 20): every scheduler decision kind
+    # exercised at least once, then one rigged slow request explained
+    # with its dominant cause named
+    if args.forensics:
+        rc = _forensics_phase(args)
+        if rc:
+            return rc
+
     print("[demo] OK", file=sys.stderr)
     return 0
 
@@ -355,6 +373,123 @@ def _fleet_phase(args) -> int:
         return 1
     print(f"[demo] fleet trace: {len(xs)} spans across {len(tracks)} "
           f"host tracks -> {args.fleet_trace_out}", file=sys.stderr)
+    return 0
+
+
+def _forensics_phase(args) -> int:
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as pp
+    from paddle_tpu.inference.kv_tier import KVTierManager
+    from paddle_tpu.inference.router import ServingRouter, SloAutoscaler
+    from paddle_tpu.inference.serving import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import flight_recorder, forensics
+    from paddle_tpu.observability.fleet import LocalStore
+    from paddle_tpu.observability.forensics import (DECISION_KINDS,
+                                                    decision_events)
+    from paddle_tpu.robustness import clear_faults, inject
+
+    # the earlier phases filled the ring with their own serving events
+    # (and their engine rids collide with this phase's); start clean so
+    # the explain below joins exactly this drill's decisions
+    flight_recorder().clear()
+    clear_faults()
+
+    pp.seed(0)
+    cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    kw = dict(slots=2, max_len=64, prefill_buckets=(32,),
+              paged_kv=True, kv_block_size=8, prefill_chunk=16)
+
+    # -- engine-side kinds: admit (defer + slot), park, resume, tier,
+    # retire, expire — plus the RIGGED SLOW REQUEST: KV-alloc
+    # exhaustion starves its admission, so queue_wait must come out as
+    # its dominant cause
+    eng = ContinuousBatchingEngine(
+        model, kv_tier=KVTierManager(store=LocalStore()), **kw)
+    slow = eng.add_request(np.arange(1, 17, dtype=np.int32),
+                           max_new_tokens=4)
+    inject("serving.kv_alloc", times=5000)
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.25:
+        eng.step()
+    clear_faults()
+    eng.run()
+    exp = forensics.explain(slow, status=eng.request_status(slow))
+    print("[demo] forensics: rigged slow request explained —",
+          file=sys.stderr)
+    print("\n".join("    " + ln for ln in exp.table().splitlines()),
+          file=sys.stderr)
+    if exp.dominant_cause != "queue_wait":
+        print(f"[demo] FAIL: rigged request's dominant cause is "
+              f"{exp.dominant_cause}, expected queue_wait "
+              f"({exp.causes})", file=sys.stderr)
+        return 1
+
+    parked = eng.add_request(np.arange(2, 18, dtype=np.int32),
+                             max_new_tokens=8)
+    for _ in range(400):
+        eng.step()
+        slot = next((i for i, r in enumerate(eng._active)
+                     if r is not None and r.rid == parked), None)
+        if slot is not None and slot not in eng._prefilling \
+                and len(eng._active[slot].out) >= 2:
+            break
+    eng.park(parked)
+    eng.resume(parked)
+    eng.add_request(np.arange(3, 19, dtype=np.int32),
+                    max_new_tokens=40, timeout_s=0.02)
+    eng.run()
+    eng.close()
+
+    # -- fleet-side kinds: route (with rejected candidates), handoff
+    # (disaggregated prefill -> decode), requeue (replica death),
+    # autoscale (rigged queue-pressure breach), router retire
+    rt = ServingRouter(model, replicas=3, prefill_replicas=1,
+                       engine_kwargs=dict(kw),
+                       kv_tier=KVTierManager(store=LocalStore()),
+                       session_checkpoint_steps=1)
+    rids = [rt.add_request(np.arange(1 + i, 17 + i, dtype=np.int32),
+                           max_new_tokens=8) for i in range(3)]
+    victim = None
+    for _ in range(500):
+        rt.step()
+        for rep in rt._replicas.values():
+            if rep.dead or not rep.decode_capable():
+                continue
+            if any(r is not None and i not in rep.engine._prefilling
+                   and len(r.out) >= 2
+                   for i, r in enumerate(rep.engine._active)):
+                victim = rep.id
+                break
+        if victim is not None:
+            break
+    if victim is not None:
+        rt.kill_replica(victim)
+    rt.run()
+    scaler = SloAutoscaler(queue_high=0, min_requests=10 ** 6,
+                           cooldown_s=0.0)
+    scaler.bind(rt)
+    scaler.evaluate_once()        # empty queue >= queue_high 0: scale up
+    _ = rids
+
+    counts = {}
+    for dec in decision_events():
+        counts[dec.kind] = counts.get(dec.kind, 0) + 1
+    missing = [k for k in DECISION_KINDS if not counts.get(k)]
+    if missing:
+        print(f"[demo] FAIL: decision kinds never emitted: {missing} "
+              f"(saw {counts})", file=sys.stderr)
+        return 1
+    print("[demo] forensics: every decision kind emitted — "
+          + " ".join(f"{k}={counts[k]}" for k in DECISION_KINDS),
+          file=sys.stderr)
     return 0
 
 

@@ -150,7 +150,8 @@ class MetricsPublisher:
                  host: Optional[str] = None,
                  interval: Optional[float] = None, prefix: str = "obs",
                  publish_traces: bool = True,
-                 publish_goodput: bool = True, max_failures: int = 3):
+                 publish_goodput: bool = True,
+                 publish_decisions: bool = True, max_failures: int = 3):
         if registry is None:
             from paddle_tpu.observability.metrics import default_registry
             registry = default_registry()
@@ -163,6 +164,7 @@ class MetricsPublisher:
         self.interval = interval
         self.prefix = prefix
         self.publish_traces = publish_traces
+        self.publish_decisions = publish_decisions
         self.max_failures = max_failures
         self._tracer = tracer_
         self._seq = 0
@@ -221,6 +223,14 @@ class MetricsPublisher:
             inject_spans(self.store,
                          f"{self.prefix}/trace/{self.host}",
                          host=self.host, tracer_=self._tracer)
+        if self.publish_decisions:
+            # scheduler decision provenance federates exactly like
+            # spans: bounded window, own key, tolerant extraction
+            from paddle_tpu.observability.forensics import \
+                inject_decisions
+            inject_decisions(self.store,
+                             f"{self.prefix}/forensics/{self.host}",
+                             host=self.host)
         self._metrics["publishes"].inc()
         return payload
 
@@ -439,6 +449,7 @@ class FleetAggregator:
         self.prefix = prefix
         self._snapshots: Dict[str, dict] = {}
         self._traces: Dict[str, dict] = {}
+        self._decisions: Dict[str, dict] = {}
         # host -> (last seq, monotonic stamp of last seq ADVANCE): the
         # staleness clock is the aggregator's own — no cross-host wall
         # clock comparison anywhere
@@ -449,7 +460,8 @@ class FleetAggregator:
 
     # -- ingestion ----------------------------------------------------------
     def ingest(self, payload: dict,
-               trace_payload: Optional[dict] = None) -> str:
+               trace_payload: Optional[dict] = None,
+               decision_payload: Optional[dict] = None) -> str:
         """Feed one host's snapshot directly (no store) — the in-process
         path the demo and tests use; ``poll()`` is the store-backed
         twin."""
@@ -461,6 +473,8 @@ class FleetAggregator:
         self._snapshots[host] = payload
         if trace_payload is not None:
             self._traces[host] = trace_payload
+        if decision_payload is not None:
+            self._decisions[host] = decision_payload
         return host
 
     def poll(self) -> List[str]:
@@ -470,6 +484,7 @@ class FleetAggregator:
         error."""
         if self.store is None:
             return sorted(self._snapshots)
+        from paddle_tpu.observability.forensics import extract_decisions
         from paddle_tpu.observability.tracing import extract_spans
         key = f"{self.prefix}/hosts"
         try:
@@ -493,7 +508,31 @@ class FleetAggregator:
                                f"{self.prefix}/trace/{host}")
             if tp is not None:
                 self._traces[host] = tp
+            dp = extract_decisions(self.store,
+                                   f"{self.prefix}/forensics/{host}")
+            if dp is not None:
+                self._decisions[host] = dp
         return sorted(self._snapshots)
+
+    def decision_events(self) -> List[dict]:
+        """Every host's published decision events, host-tagged and
+        time-ordered — the event stream :func:`forensics.explain` and
+        :func:`forensics.tail_report` take for a fleet-wide view."""
+        merged: List[dict] = []
+        for host, payload in self._decisions.items():
+            for ev in payload.get("events", ()):
+                ev = dict(ev)
+                ev.setdefault("host", payload.get("host") or host)
+                merged.append(ev)
+        merged.sort(key=lambda e: (float(e.get("time", 0.0)),
+                                   int(e.get("seq", 0))))
+        return merged
+
+    def explain(self, rid):
+        """Fleet-wide request forensics from the federated decision
+        stream (see :func:`forensics.explain`)."""
+        from paddle_tpu.observability.forensics import explain
+        return explain(rid, events=self.decision_events())
 
     def hosts(self) -> Dict[str, dict]:
         """Roster view: seq, seconds since the seq last advanced, and
@@ -573,10 +612,14 @@ class FleetAggregator:
         with wall-clock endpoints, so tracks align on one timeline; the
         per-span ``trace_id``/``span_id``/``parent_id`` args survive the
         merge — an elastic generation's cross-host spans share a
-        trace id and join in Perfetto queries."""
+        trace id and join in Perfetto queries.  Federated scheduler
+        decisions render as instant events on each host's track, with
+        flow arrows chaining one rid's decisions across hosts
+        (router -> prefill -> handoff -> decode)."""
         events: List[dict] = []
-        for pid, host in enumerate(sorted(self._traces)):
-            payload = self._traces[host]
+        hosts = sorted(set(self._traces) | set(self._decisions))
+        for pid, host in enumerate(hosts):
+            payload = self._traces.get(host) or {}
             spans = payload.get("spans", [])
             events.append({"name": "process_name", "ph": "M",
                            "pid": pid, "tid": 0,
@@ -600,6 +643,12 @@ class FleetAggregator:
                              "span_id": s.get("span_id"),
                              "parent_id": s.get("parent_id"),
                              "host": host, **attrs}})
+            dpayload = self._decisions.get(host)
+            if dpayload is not None:
+                from paddle_tpu.observability.forensics import \
+                    decisions_to_chrome
+                events.extend(decisions_to_chrome(
+                    dpayload.get("events", ()), pid=pid))
         trace = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path:
             with open(path, "w") as f:

@@ -85,7 +85,7 @@ __all__ = ["Rule", "StepTimeDriftRule", "RecompileStormRule",
            "QueueSaturationRule", "SkipStreakRule", "HeartbeatGapRule",
            "MfuDriftRule", "CompileStormRule", "StragglerRule",
            "GoodputFloorRule", "SloAttainmentRule", "RestartStormRule",
-           "MttrRule", "CalibrationDriftRule",
+           "MttrRule", "CalibrationDriftRule", "TailRegressionRule",
            "Alert", "Watchdog", "default_rules", "rules_from_spec",
            "RULE_TYPES"]
 
@@ -622,6 +622,77 @@ class CalibrationDriftRule(Rule):
                 f"(sweep day) or fix the roofline constants")
 
 
+class TailRegressionRule(Rule):
+    """Tail-latency regression with the dominant cause NAMED in the
+    alert.  Watches the per-cause SLO overage counter the forensics
+    layer feeds at every retirement
+    (``paddle_tpu_slo_overage_seconds_total{kind,cause}`` — see
+    :func:`~paddle_tpu.observability.forensics.observe_retirement`)
+    and fires when one interval accrues at least ``min_overage_s`` of
+    fresh overage AND that is more than ``growth`` times the baseline
+    (EMA of healthy intervals) — p99 regressed, and the breach detail
+    says WHY: the cause with the largest share of the window's
+    overage, plus a note when the dominant cause flipped since the
+    last window.  Fleet-flavored (needs the serving overage counter),
+    so registered in ``RULE_TYPES`` but not ``default_rules()``."""
+
+    def __init__(self,
+                 metric: str = "paddle_tpu_slo_overage_seconds_total",
+                 min_overage_s: float = 0.5, growth: float = 3.0,
+                 name: str = "tail_regression"):
+        self.name = name
+        self.metric = metric
+        self.min_overage_s = float(min_overage_s)
+        self.growth = float(growth)
+        self._last: Optional[Dict[tuple, float]] = None
+        self._baseline: Optional[float] = None
+        self._last_dominant: Optional[str] = None
+
+    def evaluate(self, registry, now: float) -> Optional[str]:
+        m = registry.get(self.metric)
+        if m is None:
+            return None
+        cur = {labels: child.value() for labels, child in m.series()}
+        last, self._last = self._last, cur
+        if last is None:
+            return None
+        by_cause: Dict[str, float] = {}
+        total = 0.0
+        for labels, v in cur.items():
+            d = v - last.get(labels, 0.0)
+            if d <= 0:
+                continue
+            # labelnames=("kind", "cause") -> values in that order
+            cause = labels[1] if len(labels) > 1 else (
+                labels[0] if labels else "?")
+            by_cause[cause] = by_cause.get(cause, 0.0) + d
+            total += d
+        if total <= 0:
+            return None
+        dominant = max(by_cause, key=by_cause.get)
+        share = by_cause[dominant] / total
+        baseline, prev_dom = self._baseline, self._last_dominant
+        self._last_dominant = dominant
+        if baseline is None:
+            self._baseline = total
+            return None
+        breach = total >= self.min_overage_s and \
+            total > self.growth * baseline
+        if not breach:
+            # healthy interval: fold into the baseline EMA (a breach
+            # is deliberately NOT folded in — a sustained regression
+            # must keep firing, not normalize itself away)
+            self._baseline = 0.7 * baseline + 0.3 * total
+            return None
+        detail = (f"{total:.2f}s fresh SLO overage this interval "
+                  f"(> {self.growth:g}x baseline {baseline:.2f}s); "
+                  f"dominant cause: {dominant} ({share:.0%} of "
+                  f"overage)")
+        if prev_dom is not None and prev_dom != dominant:
+            detail += f" — flipped from {prev_dom}"
+        return detail
+
+
 RULE_TYPES = {
     "step_time_drift": StepTimeDriftRule,
     "recompile_storm": RecompileStormRule,
@@ -636,6 +707,7 @@ RULE_TYPES = {
     "restart_storm": RestartStormRule,
     "mttr": MttrRule,
     "calibration_drift": CalibrationDriftRule,
+    "tail_regression": TailRegressionRule,
 }
 
 

@@ -260,7 +260,9 @@ class TestServingTracing:
         assert st == "timeout"
         assert st.timings["enqueued"] > 0
         assert st.timings["admitted"] == 0.0    # never reached a slot
-        assert "queue_s" not in st.timings
+        # canonical schema: every TIMING_KEYS key is present; a phase
+        # never reached reads 0.0 (ISSUE 20 timings hardening)
+        assert st.timings["queue_s"] == 0.0
 
 
 # ------------------------------------------------ train step span tree
